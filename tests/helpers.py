@@ -1,0 +1,44 @@
+"""Shared test fixtures: job builders with controllable speedup curves."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from vodascheduler_tpu.common.job import (
+    JobConfig,
+    JobInfo,
+    JobMetrics,
+    JobSpec,
+    TrainingJob,
+    base_job_info,
+)
+from vodascheduler_tpu.common.types import JobStatus
+
+
+def make_job(
+    name: str,
+    submit_time: float = 0.0,
+    min_chips: int = 1,
+    max_chips: int = 4,
+    num_chips: int = 0,
+    epochs: int = 10,
+    priority: int = 0,
+    remaining: float = 0.0,
+    speedup: Optional[Dict[int, float]] = None,
+    first_start_time: Optional[float] = None,
+    status: JobStatus = JobStatus.WAITING,
+    pool: str = "default",
+) -> TrainingJob:
+    cfg = JobConfig(num_chips=num_chips or min_chips, min_num_chips=min_chips,
+                    max_num_chips=max_chips, epochs=epochs)
+    spec = JobSpec(name=name, pool=pool, config=cfg, priority=priority)
+    job = TrainingJob.from_spec(spec, submit_time=submit_time)
+    job.status = status
+    info = base_job_info(name, job.category, pool)
+    info.estimated_remaining_seconds = remaining
+    if speedup is not None:
+        info.speedup = dict(speedup)
+    job.info = info
+    if first_start_time is not None:
+        job.metrics.first_start_time = first_start_time
+    return job

@@ -1,0 +1,308 @@
+"""Unit tests for the 8 scheduling algorithms.
+
+The reference shipped zero algorithm tests (SURVEY.md §4); behavior here is
+pinned against the reference's documented semantics (pkg/algorithm/*.go).
+"""
+
+import math
+
+import pytest
+
+from tests.helpers import make_job
+from vodascheduler_tpu.algorithms import (
+    ALGORITHM_NAMES,
+    AFSL,
+    ElasticFIFO,
+    ElasticSRJF,
+    ElasticTiresias,
+    FIFO,
+    FfDLOptimizer,
+    InvalidAllocationError,
+    SRJF,
+    Tiresias,
+    new_algorithm,
+    validate_result,
+)
+from vodascheduler_tpu.algorithms.tiresias import (
+    TIRESIAS_THRESHOLDS_SEC,
+    tiresias_demote_priority,
+    tiresias_promote_priority,
+)
+
+
+class TestFactory:
+    def test_all_names_resolve(self):
+        for name in ALGORITHM_NAMES:
+            algo = new_algorithm(name, "sched-test")
+            assert algo.name == name
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError):
+            new_algorithm("NotAnAlgorithm")
+
+    def test_needs_job_info_flags(self):
+        # Reference: NeedJobInfo per algorithm file.
+        expect = {
+            "FIFO": False, "ElasticFIFO": False,
+            "SRJF": True, "ElasticSRJF": True,
+            "Tiresias": False, "ElasticTiresias": True,
+            "FfDLOptimizer": True, "AFS-L": True,
+        }
+        for name, flag in expect.items():
+            assert new_algorithm(name).needs_job_info is flag
+
+
+class TestValidateResult:
+    def test_rejects_negative(self):
+        jobs = [make_job("a")]
+        with pytest.raises(InvalidAllocationError):
+            validate_result(4, {"a": -1}, jobs)
+
+    def test_rejects_below_min(self):
+        jobs = [make_job("a", min_chips=2, max_chips=4)]
+        with pytest.raises(InvalidAllocationError):
+            validate_result(4, {"a": 1}, jobs)
+
+    def test_rejects_above_max(self):
+        jobs = [make_job("a", min_chips=1, max_chips=2)]
+        with pytest.raises(InvalidAllocationError):
+            validate_result(4, {"a": 3}, jobs)
+
+    def test_rejects_oversubscription(self):
+        jobs = [make_job("a", max_chips=8), make_job("b", max_chips=8)]
+        with pytest.raises(InvalidAllocationError):
+            validate_result(4, {"a": 4, "b": 4}, jobs[:1] + jobs[1:])
+
+    def test_accepts_zero_and_valid(self):
+        jobs = [make_job("a", min_chips=2, max_chips=4)]
+        validate_result(4, {"a": 0}, jobs)
+        validate_result(4, {"a": 3}, jobs)
+
+
+class TestFIFO:
+    def test_submit_order_min_allocation(self):
+        jobs = [make_job("b", submit_time=2, min_chips=2),
+                make_job("a", submit_time=1, min_chips=3)]
+        result = FIFO().schedule(jobs, 4)
+        # a first (earlier submit) gets min=3; b's min=2 > 1 left -> 0.
+        assert result == {"a": 3, "b": 0}
+
+    def test_non_elastic_never_exceeds_min(self):
+        jobs = [make_job("a", min_chips=1, max_chips=8)]
+        assert FIFO().schedule(jobs, 8) == {"a": 1}
+
+    def test_empty(self):
+        assert FIFO().schedule([], 8) == {}
+
+
+class TestElasticFIFO:
+    def test_leftover_round_robin(self):
+        jobs = [make_job("a", submit_time=1, min_chips=1, max_chips=3),
+                make_job("b", submit_time=2, min_chips=1, max_chips=3)]
+        result = ElasticFIFO().schedule(jobs, 5)
+        # mins: a=1,b=1; leftovers 3 round-robin in submit order: a,b,a.
+        assert result == {"a": 3, "b": 2}
+
+    def test_capped_at_max(self):
+        jobs = [make_job("a", min_chips=1, max_chips=2)]
+        assert ElasticFIFO().schedule(jobs, 8) == {"a": 2}
+
+    def test_zero_allocated_job_stays_zero(self):
+        # The reference panics on this shape (see base.distribute_leftover);
+        # we keep B at 0 rather than giving it a sub-minimum share.
+        jobs = [make_job("a", submit_time=1, min_chips=1, max_chips=10),
+                make_job("b", submit_time=2, min_chips=3, max_chips=3)]
+        result = ElasticFIFO().schedule(jobs, 3)
+        assert result == {"a": 3, "b": 0}
+
+
+class TestSRJF:
+    def test_shortest_remaining_first(self):
+        jobs = [make_job("long", remaining=1000, min_chips=2),
+                make_job("short", remaining=10, min_chips=2)]
+        result = SRJF().schedule(jobs, 3)
+        assert result == {"short": 2, "long": 0}
+
+
+class TestElasticSRJF:
+    def test_leftover_to_shortest_first(self):
+        jobs = [make_job("long", remaining=1000, min_chips=1, max_chips=4),
+                make_job("short", remaining=10, min_chips=1, max_chips=4)]
+        result = ElasticSRJF().schedule(jobs, 6)
+        # mins 1+1, leftover 4 round-robins short,long,short,long.
+        assert result == {"short": 3, "long": 3}
+
+
+class TestTiresias:
+    def test_priority_queues_then_start_time(self):
+        jobs = [
+            make_job("low", num_chips=2, min_chips=2, max_chips=4, priority=1,
+                     first_start_time=1.0),
+            make_job("hi-late", num_chips=2, min_chips=2, max_chips=4, priority=0,
+                     first_start_time=5.0),
+            make_job("hi-early", num_chips=2, min_chips=2, max_chips=4, priority=0,
+                     first_start_time=2.0),
+        ]
+        result = Tiresias().schedule(jobs, 4)
+        # Queue 0 first, FIFO by first start time: hi-early, hi-late.
+        assert result == {"hi-early": 2, "hi-late": 2, "low": 0}
+
+    def test_allocates_fixed_num_proc(self):
+        jobs = [make_job("a", num_chips=3, min_chips=1, max_chips=8)]
+        assert Tiresias().schedule(jobs, 8) == {"a": 3}
+
+    def test_never_started_sorts_last(self):
+        jobs = [make_job("started", num_chips=2, min_chips=2, first_start_time=1.0,
+                         max_chips=4),
+                make_job("fresh", num_chips=2, min_chips=2, max_chips=4)]
+        result = Tiresias().schedule(jobs, 2)
+        assert result == {"started": 2, "fresh": 0}
+
+    def test_demote_promote_helpers(self):
+        assert tiresias_demote_priority(0) == 1
+        assert tiresias_demote_priority(1) == 1  # bottom queue stays
+        assert tiresias_promote_priority(1) == 0
+        assert TIRESIAS_THRESHOLDS_SEC[0] == 3600.0
+        assert math.isinf(TIRESIAS_THRESHOLDS_SEC[1])
+
+
+class TestElasticTiresias:
+    def test_leftover_goes_to_max_marginal_gain(self):
+        # diminishing returns for a, linear for b -> extra chips go to b.
+        jobs = [
+            make_job("a", num_chips=1, min_chips=1, max_chips=4,
+                     speedup={0: 0, 1: 1.0, 2: 1.1, 3: 1.15, 4: 1.18, 5: 1.2},
+                     first_start_time=1.0),
+            make_job("b", num_chips=1, min_chips=1, max_chips=4,
+                     speedup={0: 0, 1: 1.0, 2: 2.0, 3: 3.0, 4: 4.0, 5: 5.0},
+                     first_start_time=2.0),
+        ]
+        result = ElasticTiresias().schedule(jobs, 6)
+        assert result == {"a": 2, "b": 4}
+
+    def test_no_gain_no_allocation(self):
+        jobs = [make_job("a", num_chips=1, min_chips=1, max_chips=8,
+                         speedup={0: 0, 1: 1.0, 2: 1.0, 3: 1.0, 4: 1.0})]
+        result = ElasticTiresias().schedule(jobs, 8)
+        assert result == {"a": 1}  # base NumProc only; zero marginal gain
+
+    def test_compaction_shrinks_low_priority(self):
+        # A low-priority job holding 4 chips + 12 pending jobs too big to
+        # ever start (min 8 > capacity 6): the deep pending backlog (>10)
+        # triggers compaction, shrinking the fat job to its min. Flat
+        # speedup keeps the greedy phase from re-growing it.
+        fat = make_job("fat", num_chips=4, min_chips=1, max_chips=4, priority=1,
+                       first_start_time=1.0,
+                       speedup={n: 1.0 if n else 0.0 for n in range(0, 9)})
+        pendings = [make_job(f"p{i}", num_chips=8, min_chips=8, max_chips=8,
+                             speedup={n: float(n) for n in range(0, 10)})
+                    for i in range(12)]
+        result = ElasticTiresias().schedule([fat] + pendings, 6)
+        assert result["fat"] == 1
+        assert all(result[f"p{i}"] == 0 for i in range(12))
+
+    def test_running_job_absorbs_leftover_below_its_min(self):
+        # The reference's candidate filter would strand the last chip
+        # (free=1 < min=2) even though the job is already running.
+        jobs = [make_job("run", num_chips=2, min_chips=2, max_chips=4,
+                         first_start_time=1.0,
+                         speedup={n: float(n) for n in range(10)})]
+        assert ElasticTiresias().schedule(jobs, 3) == {"run": 3}
+
+    def test_pending_job_needs_full_min(self):
+        jobs = [
+            make_job("running", num_chips=1, min_chips=1, max_chips=2,
+                     speedup={0: 0, 1: 1.0, 2: 1.2, 3: 1.2}, first_start_time=1.0),
+            make_job("pending", num_chips=4, min_chips=4, max_chips=8,
+                     speedup={n: float(n) for n in range(0, 10)}),
+        ]
+        # capacity 3: pending can't start (min 4 > 3 free after running=1)
+        result = ElasticTiresias().schedule(jobs, 3)
+        assert result["pending"] == 0
+        assert result["running"] == 2
+
+
+class TestFfDLOptimizer:
+    def test_maximizes_total_speedup(self):
+        jobs = [
+            make_job("lin", submit_time=1, min_chips=1, max_chips=4,
+                     speedup={0: 0, 1: 1.0, 2: 2.0, 3: 3.0, 4: 4.0}),
+            make_job("flat", submit_time=2, min_chips=1, max_chips=4,
+                     speedup={0: 0, 1: 1.2, 2: 1.25, 3: 1.28, 4: 1.3}),
+        ]
+        result = FfDLOptimizer().schedule(jobs, 4)
+        # lin=3 + flat=1 -> 3 + 1.2 = 4.2 beats lin=4 alone (4.0).
+        assert result == {"lin": 3, "flat": 1}
+
+    def test_respects_min(self):
+        jobs = [make_job("a", min_chips=4, max_chips=8,
+                         speedup={n: float(n) for n in range(0, 10)})]
+        assert FfDLOptimizer().schedule(jobs, 3) == {"a": 0}
+        assert FfDLOptimizer().schedule(jobs, 4) == {"a": 4}
+
+    def test_deep_queue_does_not_crash(self):
+        # Reference panics "infeasible" when the FIFO-trimmed queue cannot
+        # all be placed; our g=0 transition handles it.
+        jobs = [make_job(f"j{i}", submit_time=i, min_chips=2, max_chips=4,
+                         speedup={n: float(n) for n in range(0, 6)})
+                for i in range(8)]
+        result = FfDLOptimizer().schedule(jobs, 4)
+        assert sum(result.values()) == 4
+
+    def test_empty(self):
+        assert FfDLOptimizer().schedule([], 4) == {}
+
+
+class TestAFSL:
+    def test_short_job_wins_when_unscheduled(self):
+        jobs = [make_job("long", submit_time=1, remaining=1000, max_chips=2,
+                         speedup={0: 0, 1: 1.0, 2: 1.5, 3: 1.7}),
+                make_job("short", submit_time=2, remaining=10, max_chips=2,
+                         speedup={0: 0, 1: 1.0, 2: 1.5, 3: 1.7})]
+        result = AFSL().schedule(jobs, 1)
+        assert result == {"short": 1, "long": 0}
+
+    def test_all_chips_distributed_up_to_max(self):
+        jobs = [make_job("a", remaining=100, max_chips=2,
+                         speedup={0: 0, 1: 1, 2: 1.9, 3: 2.5}),
+                make_job("b", remaining=200, max_chips=2,
+                         speedup={0: 0, 1: 1, 2: 1.9, 3: 2.5})]
+        result = AFSL().schedule(jobs, 4)
+        assert result == {"a": 2, "b": 2}
+
+    def test_reverted_chips_are_reauctioned(self):
+        # b's sub-min partial win reverts to 0; its chips must go back to a
+        # rather than sit idle.
+        jobs = [make_job("a", remaining=10, min_chips=1, max_chips=8,
+                         speedup={n: float(n) for n in range(10)}),
+                make_job("b", remaining=20, min_chips=4, max_chips=4,
+                         speedup={n: float(n) for n in range(10)})]
+        result = AFSL().schedule(jobs, 5)
+        assert sum(result.values()) == 5
+
+    def test_sub_min_reverts_to_zero(self):
+        jobs = [make_job("a", remaining=10, min_chips=1, max_chips=8,
+                         speedup={n: float(n) for n in range(0, 10)}),
+                make_job("b", remaining=20, min_chips=4, max_chips=4,
+                         speedup={n: float(n) for n in range(0, 10)})]
+        result = AFSL().schedule(jobs, 5)
+        assert result["a"] >= 1
+        assert result["b"] in (0, 4)
+
+
+@pytest.mark.parametrize("name", ALGORITHM_NAMES)
+@pytest.mark.parametrize("capacity", [0, 1, 3, 8, 64])
+def test_all_algorithms_produce_valid_allocations(name, capacity):
+    """Property test: every algorithm output validates on a mixed queue."""
+    jobs = [
+        make_job("a", submit_time=1, min_chips=1, max_chips=4, remaining=50,
+                 first_start_time=1.0),
+        make_job("b", submit_time=2, min_chips=2, max_chips=2, remaining=500,
+                 priority=1, first_start_time=2.0),
+        make_job("c", submit_time=3, min_chips=2, max_chips=8, remaining=5),
+        make_job("d", submit_time=4, min_chips=1, max_chips=1, remaining=100,
+                 first_start_time=3.0),
+    ]
+    result = new_algorithm(name).schedule(jobs, capacity)
+    validate_result(capacity, result, jobs)
+    assert set(result) == {"a", "b", "c", "d"}

@@ -1,0 +1,156 @@
+"""Tests for the common layer: job model, clock, store, events."""
+
+import math
+import os
+
+import pytest
+
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus, JobEvent
+from vodascheduler_tpu.common.job import (
+    JobConfig,
+    JobSpec,
+    TrainingJob,
+    base_job_info,
+    category_of,
+    timestamped_name,
+)
+from vodascheduler_tpu.common.store import FileJobStore, JobStore
+from vodascheduler_tpu.common.types import EventVerb, JobStatus, MAX_TIME
+
+
+class TestJobModel:
+    def test_category_strips_timestamp(self):
+        # Reference: metrics_collector.py:66-68 regex.
+        assert category_of("resnet50-20260729-123456") == "resnet50"
+        assert category_of("resnet50") == "resnet50"
+        assert category_of("a-1234-99") == "a-1234-99"
+
+    def test_timestamped_name_roundtrip(self):
+        name = timestamped_name("bert", now=1753760000.0)
+        assert category_of(name) == "bert"
+
+    def test_config_defaults_num_to_min(self):
+        cfg = JobConfig(num_chips=0, min_num_chips=2, max_num_chips=4)
+        assert cfg.num_chips == 2
+
+    def test_config_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            JobConfig(min_num_chips=4, max_num_chips=2)
+        with pytest.raises(ValueError):
+            JobConfig(num_chips=8, min_num_chips=1, max_num_chips=4)
+
+    def test_base_info_linear_prior(self):
+        # Reference: NewBaseJobInfo (trainingjob.go:167-187).
+        info = base_job_info("j", "j", "default", max_chips=32)
+        assert info.speedup[0] == 0.0
+        assert info.speedup[1] == 1.0
+        assert info.speedup[33] == 33.0
+        assert info.efficiency[16] == 1.0
+
+    def test_from_spec(self):
+        spec = JobSpec(name="x-20260101-000000", pool="v5p",
+                       config=JobConfig(min_num_chips=1, max_num_chips=4))
+        job = TrainingJob.from_spec(spec, submit_time=123.0)
+        assert job.category == "x"
+        assert job.status == JobStatus.SUBMITTED
+        assert job.finish_time == MAX_TIME
+        assert job.metrics.first_start_time == MAX_TIME
+
+
+class TestVirtualClock:
+    def test_advance_and_timers(self):
+        clock = VirtualClock()
+        fired = []
+        clock.call_later(5.0, lambda: fired.append(clock.now()))
+        clock.call_later(1.0, lambda: fired.append(clock.now()))
+        clock.advance(3.0)
+        assert fired == [1.0]
+        clock.advance(3.0)
+        assert fired == [1.0, 5.0]
+        assert clock.now() == 6.0
+
+    def test_timer_chains(self):
+        clock = VirtualClock()
+        fired = []
+
+        def tick():
+            fired.append(clock.now())
+            if len(fired) < 3:
+                clock.call_later(10.0, tick)
+
+        clock.call_later(10.0, tick)
+        clock.advance(100.0)
+        assert fired == [10.0, 20.0, 30.0]
+        assert clock.now() == 100.0
+
+
+class TestStore:
+    def _job(self, name: str) -> TrainingJob:
+        spec = JobSpec(name=name, config=JobConfig(min_num_chips=1, max_num_chips=4))
+        return TrainingJob.from_spec(spec, submit_time=1.0)
+
+    def test_memory_roundtrip(self):
+        store = JobStore()
+        store.insert_job(self._job("a-20260101-000000"))
+        assert store.get_job("a-20260101-000000") is not None
+        assert len(store.list_jobs()) == 1
+        store.delete_job("a-20260101-000000")
+        assert store.get_job("a-20260101-000000") is None
+
+    def test_category_info_lookup(self):
+        store = JobStore()
+        info = base_job_info("a-20260101-000000", "a", "default")
+        info.speedup[2] = 1.7
+        store.upsert_job_info(info)
+        # A later submission of the same category finds the learned curves.
+        found = store.find_category_info("a")
+        assert found is not None and found.speedup[2] == 1.7
+
+    def test_file_store_emits_strict_json(self, tmp_path):
+        # MAX_TIME sentinels must not serialize as bare `Infinity`.
+        import json
+
+        path = os.path.join(tmp_path, "store.json")
+        store = FileJobStore(path)
+        store.insert_job(self._job("a-20260101-000000"))
+        raw = open(path).read()
+        assert "Infinity" not in raw
+        json.loads(raw)
+
+    def test_file_store_persists(self, tmp_path):
+        path = os.path.join(tmp_path, "store.json")
+        store = FileJobStore(path)
+        job = self._job("a-20260101-000000")
+        job.status = JobStatus.RUNNING
+        store.insert_job(job)
+        info = base_job_info(job.name, "a", "default")
+        info.estimated_remaining_seconds = 42.0
+        store.upsert_job_info(info)
+
+        # Fresh process: reload from disk (crash-resume path).
+        store2 = FileJobStore(path)
+        loaded = store2.get_job("a-20260101-000000")
+        assert loaded is not None
+        assert loaded.status == JobStatus.RUNNING
+        assert loaded.config.max_num_chips == 4
+        assert math.isinf(loaded.finish_time) or loaded.finish_time >= 1e300
+        info2 = store2.get_job_info(job.name)
+        assert info2 is not None
+        assert info2.estimated_remaining_seconds == 42.0
+        assert info2.speedup[2] == 2.0  # int keys restored
+
+
+class TestEventBus:
+    def test_publish_get(self):
+        bus = EventBus()
+        bus.publish("v5p", JobEvent(EventVerb.CREATE, "job-a"))
+        ev = bus.get("v5p", timeout=0)
+        assert ev == JobEvent(EventVerb.CREATE, "job-a")
+        assert bus.get("v5p", timeout=0) is None
+
+    def test_topics_isolated(self):
+        bus = EventBus()
+        bus.publish("v5p", JobEvent(EventVerb.CREATE, "a"))
+        assert bus.get("v4", timeout=0) is None
+        assert bus.pending("v5p") == 1

@@ -1,0 +1,56 @@
+"""In-process event bus: per-pool job-lifecycle queues.
+
+Reference counterpart: pkg/common/rabbitmq/rabbitmq.go — one RabbitMQ queue
+per GPU type carrying `{verb, job_name}` messages from the admission service
+to that type's scheduler. In a single control-plane process a broker is pure
+overhead; a thread-safe topic→queue map preserves the decoupling (admission
+never calls the scheduler directly, and publish can be rolled back by a
+compensating delete, handlers.go:119-134) without the network hop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Dict, Optional
+
+from vodascheduler_tpu.common.types import EventVerb
+
+
+@dataclasses.dataclass(frozen=True)
+class JobEvent:
+    """Reference: rabbitmq.Msg{Verb, JobName} (rabbitmq.go:15-26)."""
+
+    verb: EventVerb
+    job_name: str
+
+
+class EventBus:
+    """Named queues (one per TPU pool), publish/subscribe."""
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, "queue.Queue[JobEvent]"] = {}
+        self._lock = threading.Lock()
+
+    def _queue(self, topic: str) -> "queue.Queue[JobEvent]":
+        with self._lock:
+            if topic not in self._queues:
+                self._queues[topic] = queue.Queue()
+            return self._queues[topic]
+
+    def publish(self, topic: str, event: JobEvent) -> None:
+        self._queue(topic).put(event)
+
+    def get(self, topic: str, timeout: Optional[float] = None) -> Optional[JobEvent]:
+        """Pop the next event, or None on timeout / immediately when
+        timeout=0 and the queue is empty."""
+        try:
+            if timeout == 0:
+                return self._queue(topic).get_nowait()
+            return self._queue(topic).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def pending(self, topic: str) -> int:
+        return self._queue(topic).qsize()
