@@ -154,3 +154,25 @@ class TestEventBus:
         bus.publish("v5p", JobEvent(EventVerb.CREATE, "a"))
         assert bus.get("v4", timeout=0) is None
         assert bus.pending("v5p") == 1
+
+
+class TestEventBusReviewFixes:
+    def test_subscribe_drains_backlog(self):
+        from vodascheduler_tpu.common.events import EventBus, JobEvent
+        from vodascheduler_tpu.common.types import EventVerb
+
+        bus = EventBus()
+        bus.publish("pool", JobEvent(EventVerb.CREATE, "early"))
+        seen = []
+        bus.subscribe("pool", seen.append)
+        assert [e.job_name for e in seen] == ["early"]
+        bus.publish("pool", JobEvent(EventVerb.CREATE, "late"))
+        assert [e.job_name for e in seen] == ["early", "late"]
+
+    def test_subscriber_exception_contained(self):
+        from vodascheduler_tpu.common.events import EventBus, JobEvent
+        from vodascheduler_tpu.common.types import EventVerb
+
+        bus = EventBus()
+        bus.subscribe("pool", lambda e: (_ for _ in ()).throw(RuntimeError("boom")))
+        bus.publish("pool", JobEvent(EventVerb.CREATE, "x"))  # must not raise

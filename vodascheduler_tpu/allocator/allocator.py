@@ -1,0 +1,71 @@
+"""The resource allocator.
+
+Reference counterpart: pkg/allocator/allocator/resource_allocator.go —
+`allocateResource` (:76) builds the algorithm from the factory, fetches
+job_info docs from Mongo when `NeedJobInfo()` (:115, getJobsInfo), runs
+`Schedule`, and returns the {job: count} map.
+
+Info-attachment policy (getJobsInfo semantics + the admission service's
+category seeding, handlers.go:180-206): exact job doc if present, else the
+newest doc of the job's category (repeat workloads inherit learned curves),
+else the linear-speedup base prior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from vodascheduler_tpu.algorithms import new_algorithm
+from vodascheduler_tpu.common.job import TrainingJob, base_job_info
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import ScheduleResult
+
+
+@dataclasses.dataclass
+class AllocationRequest:
+    """Reference: AllocationRequest (pkg/allocator/allocator/types.go:5-10)."""
+
+    scheduler_id: str
+    num_chips: int
+    algorithm: str
+    ready_jobs: List[TrainingJob]
+
+
+class ResourceAllocator:
+    def __init__(self, store: JobStore, registry: Optional[Registry] = None):
+        self.store = store
+        registry = registry or Registry()
+        # Reference metric names: pkg/allocator/allocator/metrics.go.
+        self.m_requests = registry.counter(
+            "voda_allocator_allocation_requests_total",
+            "Total allocation requests served", ("algorithm",))
+        self.m_algo_seconds = registry.summary(
+            "voda_allocator_algorithm_duration_seconds",
+            "Scheduling algorithm run time", ("algorithm",))
+        self.m_info_seconds = registry.summary(
+            "voda_allocator_jobinfo_fetch_duration_seconds",
+            "Job info fetch time", ("algorithm",))
+
+    def allocate(self, request: AllocationRequest) -> ScheduleResult:
+        algo = new_algorithm(request.algorithm, request.scheduler_id)
+        self.m_requests.inc(algorithm=algo.name)
+        if algo.needs_job_info:
+            t0 = time.monotonic()
+            self._attach_job_info(request.ready_jobs)
+            self.m_info_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
+        t0 = time.monotonic()
+        result = algo.schedule(request.ready_jobs, request.num_chips)
+        self.m_algo_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
+        return result
+
+    def _attach_job_info(self, jobs: List[TrainingJob]) -> None:
+        for job in jobs:
+            info = self.store.get_job_info(job.name)
+            if info is None:
+                info = self.store.find_category_info(job.category)
+            if info is None:
+                info = base_job_info(job.name, job.category, job.pool)
+            job.info = info

@@ -1,0 +1,16 @@
+"""Cluster backends: how the scheduler actually runs jobs on TPU hosts.
+
+The reference delegates execution to Kubernetes + the Kubeflow MPI-Operator
+(create/scale/delete MPIJob CRDs and let the controller manage pods). This
+framework owns its execution substrate behind the `ClusterBackend`
+interface:
+
+- `fake.FakeClusterBackend`: hermetic simulated cluster driven by a
+  VirtualClock — the testing substrate the reference never finished
+  (SURVEY.md §4: fake clientsets in an empty test stub), and the engine of
+  trace replay.
+- `local.LocalClusterBackend`: real JAX trainer processes on the local
+  machine's TPU chips.
+"""
+
+from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent

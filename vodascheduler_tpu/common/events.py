@@ -11,9 +11,10 @@ compensating delete, handlers.go:119-134) without the network hop.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from vodascheduler_tpu.common.types import EventVerb
 
@@ -27,10 +28,19 @@ class JobEvent:
 
 
 class EventBus:
-    """Named queues (one per TPU pool), publish/subscribe."""
+    """Named queues (one per TPU pool), publish/subscribe.
+
+    Two consumption modes, matching how the reference consumes RabbitMQ:
+    a subscriber callback (the scheduler's readMsgs analog; delivery is
+    synchronous on the publisher's thread — the scheduler's own lock
+    serializes concurrent entry) or explicit polling via get(). Events
+    published before a topic has a subscriber queue up and are drained on
+    subscribe.
+    """
 
     def __init__(self) -> None:
         self._queues: Dict[str, "queue.Queue[JobEvent]"] = {}
+        self._subscribers: Dict[str, Callable[[JobEvent], None]] = {}
         self._lock = threading.Lock()
 
     def _queue(self, topic: str) -> "queue.Queue[JobEvent]":
@@ -39,8 +49,39 @@ class EventBus:
                 self._queues[topic] = queue.Queue()
             return self._queues[topic]
 
+    def subscribe(self, topic: str, callback: Callable[[JobEvent], None]) -> None:
+        """Register the topic's consumer and drain any events queued before
+        it existed (e.g. jobs admitted while the pool's scheduler was
+        down)."""
+        with self._lock:
+            self._subscribers[topic] = callback
+        q = self._queue(topic)
+        while True:
+            try:
+                backlog = q.get_nowait()
+            except queue.Empty:
+                break
+            self._deliver(callback, backlog)
+
     def publish(self, topic: str, event: JobEvent) -> None:
-        self._queue(topic).put(event)
+        """Hand off an event. Publication succeeds once the event is
+        delivered or queued; subscriber exceptions are contained here (the
+        consumer's failure is not the producer's rollback trigger —
+        admission's rollback fires only when hand-off itself fails)."""
+        with self._lock:
+            sub = self._subscribers.get(topic)
+        if sub is not None:
+            self._deliver(sub, event)
+        else:
+            self._queue(topic).put(event)
+
+    @staticmethod
+    def _deliver(sub: Callable[[JobEvent], None], event: JobEvent) -> None:
+        try:
+            sub(event)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "event subscriber failed handling %s", event)
 
     def get(self, topic: str, timeout: Optional[float] = None) -> Optional[JobEvent]:
         """Pop the next event, or None on timeout / immediately when

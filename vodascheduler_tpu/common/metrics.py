@@ -1,0 +1,130 @@
+"""Minimal Prometheus-style metrics registry with text exposition.
+
+Reference counterpart: the prometheus/client_golang series registered across
+scheduler (13+4 placement), allocator (8), and service (7) — catalog in
+doc/prometheus-metrics-exposed.md. This registry provides the same three
+instrument kinds the reference uses (Counter, Gauge/GaugeFunc, Summary) and
+renders the standard text format for a `/metrics` endpoint, without a
+client-library dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            values = dict(self._values) or {(): 0.0} if not self.label_names else dict(self._values)
+        for key, v in values.items():
+            lines.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        return lines
+
+
+class Gauge:
+    """Settable gauge; pass `fn` for a GaugeFunc evaluated at scrape time
+    (the reference uses GaugeFuncs over its locked maps, metrics.go:99+)."""
+
+    def __init__(self, name: str, help_: str,
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    def value(self) -> float:
+        return self._fn() if self._fn is not None else self._value
+
+    def collect(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge",
+                f"{self.name} {self.value()}"]
+
+
+class Summary:
+    """Count/sum summary (quantile-free, like an untimed reference Summary)."""
+
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._count: Dict[Tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._sum[key] = self._sum.get(key, 0.0) + v
+            self._count[key] = self._count.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._count.get(key, 0)
+
+    def mean(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        c = self._count.get(key, 0)
+        return self._sum.get(key, 0.0) / c if c else 0.0
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        with self._lock:
+            for key in self._count:
+                labels = _fmt_labels(self.label_names, key)
+                lines.append(f"{self.name}_sum{labels} {self._sum[key]}")
+                lines.append(f"{self.name}_count{labels} {self._count[key]}")
+        return lines
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List[object] = []
+
+    def register(self, metric):
+        self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Counter:
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self.register(Gauge(name, help_, fn))
+
+    def summary(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Summary:
+        return self.register(Summary(name, help_, labels))
+
+    def exposition(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"

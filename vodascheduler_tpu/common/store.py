@@ -83,6 +83,9 @@ class JobStore:
     def _dirty(self) -> None:  # persistence hook
         pass
 
+    def flush(self) -> None:  # persistence hook
+        pass
+
 
 def _job_to_dict(job: TrainingJob) -> dict:
     d = dataclasses.asdict(job)
@@ -139,12 +142,19 @@ def _info_from_dict(d: dict) -> JobInfo:
 
 class FileJobStore(JobStore):
     """JSON-file-backed store with atomic writes; survives scheduler crashes
-    so `resume=True` can reconstruct state (SURVEY.md §3.6)."""
+    so `resume=True` can reconstruct state (SURVEY.md §3.6).
 
-    def __init__(self, path: str):
+    autoflush=True (default) rewrites the file on every mutation — maximum
+    durability, O(total jobs) per write. Trace replay and other bulk
+    writers pass autoflush=False and call flush() at their own batch
+    boundaries (the scheduler flushes after each resched pass)."""
+
+    def __init__(self, path: str, autoflush: bool = True):
         super().__init__()
         self._path = path
         self._loading = False
+        self.autoflush = autoflush
+        self._pending = False
         if os.path.exists(path):
             self._load()
 
@@ -165,6 +175,17 @@ class FileJobStore(JobStore):
     def _dirty(self) -> None:
         if self._loading:
             return
+        if not self.autoflush:
+            self._pending = True
+            return
+        self._write()
+
+    def flush(self) -> None:
+        if self._pending:
+            self._pending = False
+            self._write()
+
+    def _write(self) -> None:
         raw = {
             "jobs": [_job_to_dict(j) for j in self._jobs.values()],
             "infos": [_info_to_dict(i) for docs in self._infos.values()
