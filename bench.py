@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Headline benchmark: 64-job Philly-style trace replay on a simulated
+v5p-64 pool under Elastic-Tiresias.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured chip utilization against the BASELINE.json north
+star (>= 0.85 chip utilization on this scenario). The whole control plane
+(admission, allocator, scheduler, placement, metrics-feedback loop) is the
+production code path; only the cluster and clock are simulated, so the
+number reflects real scheduling behavior, not a model of it.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from vodascheduler_tpu.placement import PoolTopology
+from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
+
+BASELINE_TARGET_UTILIZATION = 0.85  # BASELINE.json north star
+
+
+def main() -> None:
+    trace = philly_like_trace(num_jobs=64, seed=20260729)
+    topology = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))  # 64 chips
+    harness = ReplayHarness(trace, algorithm="ElasticTiresias",
+                            topology=topology)
+    report = harness.run()
+    result = {
+        "metric": "chip_utilization_philly64_elastic_tiresias_v5p64",
+        "value": round(report.chip_utilization, 4),
+        "unit": "fraction",
+        "vs_baseline": round(report.chip_utilization / BASELINE_TARGET_UTILIZATION, 4),
+        "detail": {
+            "avg_jct_seconds": round(report.avg_jct_seconds, 1),
+            "p95_jct_seconds": round(report.p95_jct_seconds, 1),
+            "makespan_seconds": round(report.makespan_seconds, 1),
+            "jobs_completed": report.completed,
+            "jobs_failed": report.failed,
+            "restarts": report.restarts_total,
+            "rescheds": report.rescheds_total,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Metrics-feedback-loop tests: CSV logger, collector math, and the closed
+loop (telemetry -> curves -> smarter allocations)."""
+
+import os
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, MetricsRow, WorkloadProfile
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, base_job_info
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.metricscollector import (
+    BackendRowSource,
+    CsvDirRowSource,
+    EpochCsvLogger,
+    MetricsCollector,
+)
+from vodascheduler_tpu.metricscollector.csv_logger import resume_epoch
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+
+class TestCsvLogger:
+    def test_roundtrip_and_resume(self, tmp_path):
+        logger = EpochCsvLogger(str(tmp_path), "job-a", total_epochs=10,
+                                global_batch_size=256)
+        logger.log_epoch(12.5, 0.125, workers=4)
+        logger.log_epoch(11.0, 0.110, workers=4)
+        assert resume_epoch(logger.path) == 2
+        # restart: a fresh logger resumes the epoch counter (reference:
+        # callbacks.py:58-66)
+        logger2 = EpochCsvLogger(str(tmp_path), "job-a", total_epochs=10)
+        assert logger2.next_epoch == 2
+        logger2.log_epoch(6.0, 0.06, workers=8)
+        src = CsvDirRowSource(str(tmp_path))
+        rows = src.rows("job-a")
+        assert [r.epoch for r in rows] == [0, 1, 2]
+        assert rows[2].workers == 8
+
+
+class TestCollectorMath:
+    def _store_with_job(self, name="j-20260101-000000", epochs=10):
+        store = JobStore()
+        spec = JobSpec(name=name,
+                       config=JobConfig(min_num_chips=1, max_num_chips=8,
+                                        epochs=epochs))
+        from vodascheduler_tpu.common.job import TrainingJob
+        store.insert_job(TrainingJob.from_spec(spec, submit_time=0.0))
+        store.upsert_job_info(base_job_info(name, "j", "pool"))
+        return store, name
+
+    def _source(self, rows):
+        class Src:
+            def job_names(self):
+                return list({r.job for r in rows})
+
+            def rows(self, job):
+                return [r for r in rows if r.job == job]
+        return Src()
+
+    def test_speedup_from_measurements(self):
+        store, name = self._store_with_job()
+        rows = [
+            MetricsRow(name, 0, 100.0, 1, 0),
+            MetricsRow(name, 1, 100.0, 1, 0),
+            MetricsRow(name, 2, 30.0, 4, 0),
+            MetricsRow(name, 3, 28.0, 4, 0),
+        ]
+        collector = MetricsCollector(store, self._source(rows))
+        assert collector.collect_all() == 1
+        info = store.get_job_info(name)
+        assert info.epoch_seconds[1] == 100.0
+        assert info.epoch_seconds[4] == 29.0
+        assert abs(info.speedup[4] - 100.0 / 29.0) < 1e-9
+        assert abs(info.efficiency[4] - 100.0 / 29.0 / 4) < 1e-9
+        # remaining: 10 epochs total, newest epoch 3 -> 6 remaining, serial
+        assert info.remaining_epochs == 6
+        assert abs(info.estimated_remaining_seconds - 600.0) < 1e-9
+
+    def test_elastic_job_without_1chip_measurement(self):
+        # Reference crashes here (epoch_time['1'] KeyError); we infer.
+        store, name = self._store_with_job()
+        rows = [MetricsRow(name, 0, 25.0, 4, 0),
+                MetricsRow(name, 1, 25.0, 4, 0)]
+        collector = MetricsCollector(store, self._source(rows))
+        collector.collect_all()
+        info = store.get_job_info(name)
+        # prior speedup[4]=4 -> inferred epoch1 = 100
+        assert abs(info.speedup[4] - 4.0) < 1e-9
+        assert abs(info.estimated_remaining_seconds - 100.0 * 8) < 1e-9
+
+    def test_same_epoch_skipped(self):
+        store, name = self._store_with_job()
+        rows = [MetricsRow(name, 0, 10.0, 2, 0)]
+        collector = MetricsCollector(store, self._source(rows))
+        assert collector.collect_all() == 1
+        assert collector.collect_all() == 0  # same newest epoch -> skip
+
+
+class TestClosedLoop:
+    def test_curves_learned_in_simulation_inform_srjf(self):
+        """Run two jobs under the collector; after telemetry accrues, the
+        learned remaining-time estimates should order SRJF correctly."""
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=2.0)
+        for i in range(2):
+            backend.add_host(f"h{i}", 4, announce=False)
+        backend.register_profile("fast", WorkloadProfile(epoch_seconds_at_1=20.0))
+        backend.register_profile("slow", WorkloadProfile(epoch_seconds_at_1=200.0))
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, algorithm="ElasticFIFO",
+                          rate_limit_seconds=5.0)
+        admission = AdmissionService(store, bus, clock)
+        collector = MetricsCollector(store, BackendRowSource(backend), clock,
+                                     interval_seconds=30.0)
+        collector.start()
+
+        fast = admission.create_training_job(JobSpec(
+            name="fast", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=4, epochs=500)))
+        slow = admission.create_training_job(JobSpec(
+            name="slow", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=4, epochs=500)))
+        clock.advance(600.0)
+
+        fi = store.get_job_info(fast)
+        si = store.get_job_info(slow)
+        assert fi.current_epoch > 0
+        assert si.current_epoch >= 0
+        # fast epochs take ~20s serial, slow ~200s serial
+        assert fi.estimated_remaining_seconds < si.estimated_remaining_seconds
+        # learned speedup is sublinear (profile exponent 0.9), below prior
+        measured = [n for n in fi.speedup if n in fi.epoch_seconds and n > 1]
+        for n in measured:
+            assert fi.speedup[n] < n + 1e-6

@@ -1,0 +1,95 @@
+"""Trace replay harness tests."""
+
+import json
+import subprocess
+import sys
+
+from vodascheduler_tpu.placement import PoolTopology
+from vodascheduler_tpu.replay import (
+    ReplayHarness,
+    load_trace,
+    philly_like_trace,
+    save_trace,
+)
+from vodascheduler_tpu.replay.simulator import PreemptionEvent
+
+
+def small_topology():
+    return PoolTopology(torus_dims=(4, 2, 2), host_block=(2, 2, 1))  # 16 chips
+
+
+class TestTrace:
+    def test_deterministic(self):
+        a = philly_like_trace(num_jobs=16, seed=7)
+        b = philly_like_trace(num_jobs=16, seed=7)
+        assert a == b
+        c = philly_like_trace(num_jobs=16, seed=8)
+        assert a != c
+
+    def test_roundtrip(self, tmp_path):
+        trace = philly_like_trace(num_jobs=8)
+        path = str(tmp_path / "trace.json")
+        save_trace(trace, path)
+        assert load_trace(path) == trace
+
+    def test_shape(self):
+        trace = philly_like_trace(num_jobs=64)
+        assert len(trace) == 64
+        assert all(t.min_chips <= t.max_chips for t in trace)
+        assert all(t.epochs >= 1 for t in trace)
+        # arrivals strictly ordered
+        offsets = [t.submit_offset_seconds for t in trace]
+        assert offsets == sorted(offsets)
+
+
+class TestReplay:
+    def test_all_jobs_complete(self):
+        trace = philly_like_trace(num_jobs=12, seed=3)
+        h = ReplayHarness(trace, algorithm="ElasticFIFO",
+                          topology=small_topology())
+        report = h.run()
+        assert report.completed == 12
+        assert report.failed == 0
+        assert 0.0 < report.chip_utilization <= 1.0
+        assert report.avg_jct_seconds > 0
+
+    def test_elastic_beats_nonelastic_on_util(self):
+        trace = philly_like_trace(num_jobs=24, seed=5)
+        elastic = ReplayHarness(trace, algorithm="ElasticFIFO",
+                                topology=small_topology()).run()
+        rigid = ReplayHarness(trace, algorithm="FIFO",
+                              topology=small_topology()).run()
+        assert elastic.chip_utilization > rigid.chip_utilization
+
+    def test_failures_counted(self):
+        trace = philly_like_trace(num_jobs=10, seed=11, failure_fraction=0.5)
+        h = ReplayHarness(trace, algorithm="ElasticFIFO",
+                          topology=small_topology())
+        report = h.run()
+        assert report.failed > 0
+        assert report.completed + report.failed == 10
+
+    def test_spot_preemption_survives(self):
+        trace = philly_like_trace(num_jobs=8, seed=13)
+        topo = small_topology()
+        # rip out two hosts mid-trace, return one later
+        names = [topo.host_name(c) for c in topo.host_coords()]
+        ev = [PreemptionEvent(at_seconds=1800.0, host=names[0]),
+              PreemptionEvent(at_seconds=2400.0, host=names[1]),
+              PreemptionEvent(at_seconds=7200.0, host=names[0], add=True,
+                              chips=topo.chips_per_host)]
+        h = ReplayHarness(trace, algorithm="ElasticTiresias",
+                          topology=topo, preemptions=ev)
+        report = h.run()
+        assert report.completed == 8
+
+
+class TestBenchScript:
+    def test_bench_prints_json_line(self):
+        out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                             text=True, timeout=300, cwd="/root/repo")
+        assert out.returncode == 0, out.stderr
+        line = out.stdout.strip().splitlines()[-1]
+        data = json.loads(line)
+        assert set(data) >= {"metric", "value", "unit", "vs_baseline"}
+        assert data["value"] > 0.5  # sanity: util should be well over 50%

@@ -13,11 +13,15 @@ Distributed Deep Learning" (NSDI'21), matching the reference
   throughput gain is larger relative to what it gives up").
 - A job leaves the auction when it reaches its maximum.
 
-Like the reference, min_num_chips is treated as 1 for auction purposes (the
-paper's model has no minimum); allocations below a declared min are rounded
-down to 0 at the end so the result always validates — a final-step guard the
-reference lacks (it would panic in validateResult if a min>1 job won fewer
-than min chips when supply ran out).
+Deliberate fix over the reference: the paper's model has no job minimum,
+and the reference auctions strictly one GPU at a time (afsl.go:47-58), so
+any min>1 job that wins fewer than min chips crashes validateResult — with
+a queue of min>1 jobs it cannot produce a valid allocation at all. Here a
+*pending* job that wins the auction is granted its full minimum at once
+(or leaves the auction if supply can't cover it), mirroring the
+min-or-nothing rule the other elastic algorithms use; running jobs still
+grow one chip per win. A final sub-min revert + re-auction remains as a
+safety net.
 """
 
 from __future__ import annotations
@@ -66,8 +70,16 @@ class AFSL(SchedulerAlgorithm):
         free = total_chips
         while free > 0 and auction:
             job = self._top_priority(auction, result)
-            result[job.name] += 1
-            free -= 1
+            if result[job.name] == 0:
+                # Pending winner: min-or-nothing.
+                grant = job.config.min_num_chips
+                if free < grant:
+                    auction.remove(job)
+                    continue
+            else:
+                grant = 1
+            result[job.name] += grant
+            free -= grant
             if result[job.name] >= job.config.max_num_chips:
                 auction.remove(job)
 

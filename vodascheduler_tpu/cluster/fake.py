@@ -41,6 +41,10 @@ class WorkloadProfile:
     speedup: Optional[Dict[int, float]] = None
     speedup_exponent: float = 0.9      # used when no explicit curve
     fail_at_epoch: Optional[int] = None  # inject a failure
+    # Checkpoint-restart pause for THIS workload (overrides the backend
+    # default): restore + recompile scales with model size, so a ResNet
+    # resize is far cheaper than a Mixtral resize.
+    restart_overhead_seconds: Optional[float] = None
 
     def speedup_at(self, n: int) -> float:
         if n <= 0:
@@ -97,6 +101,7 @@ class FakeClusterBackend(ClusterBackend):
         # accounting for utilization metrics (chip-seconds actually serving
         # jobs vs capacity)
         self.busy_chip_seconds: float = 0.0
+        self.restarts_total: int = 0  # cumulative across all jobs, ever
 
     # ---- fleet management -------------------------------------------------
 
@@ -139,7 +144,8 @@ class FakeClusterBackend(ClusterBackend):
             self.jobs[spec.name] = sim
             self.metrics_rows.setdefault(spec.name, [])
         sim.restarts += 1
-        sim.busy_until = now + self.restart_overhead_seconds
+        self.restarts_total += 1
+        sim.busy_until = now + self._overhead(sim)
         sim.last_update = now
         sim.epoch_started_at = now
         sim.epoch_started_serial = sim.progress_serial
@@ -157,8 +163,9 @@ class FakeClusterBackend(ClusterBackend):
         if placements is not None:
             sim.placements = placements
         sim.restarts += 1
+        self.restarts_total += 1
         now = self.clock.now()
-        sim.busy_until = now + self.restart_overhead_seconds
+        sim.busy_until = now + self._overhead(sim)
         sim.epoch_started_at = now
         sim.epoch_started_serial = sim.progress_serial
         sim.epoch_started_workers = num_workers
@@ -188,6 +195,11 @@ class FakeClusterBackend(ClusterBackend):
         return {name: JobHandle(name=name, num_workers=sim.num_workers,
                                 placements=list(sim.placements))
                 for name, sim in self.jobs.items() if sim.num_workers > 0}
+
+    def _overhead(self, sim: _SimJob) -> float:
+        if sim.profile.restart_overhead_seconds is not None:
+            return sim.profile.restart_overhead_seconds
+        return self.restart_overhead_seconds
 
     # ---- simulation engine -----------------------------------------------
 

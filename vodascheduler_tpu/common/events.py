@@ -41,7 +41,10 @@ class EventBus:
     def __init__(self) -> None:
         self._queues: Dict[str, "queue.Queue[JobEvent]"] = {}
         self._subscribers: Dict[str, Callable[[JobEvent], None]] = {}
-        self._lock = threading.Lock()
+        # RLock: the backlog drain in subscribe() delivers while holding the
+        # lock so a concurrent publish cannot jump ahead of older queued
+        # events; reentrant so a subscriber may itself publish.
+        self._lock = threading.RLock()
 
     def _queue(self, topic: str) -> "queue.Queue[JobEvent]":
         with self._lock:
@@ -55,13 +58,13 @@ class EventBus:
         down)."""
         with self._lock:
             self._subscribers[topic] = callback
-        q = self._queue(topic)
-        while True:
-            try:
-                backlog = q.get_nowait()
-            except queue.Empty:
-                break
-            self._deliver(callback, backlog)
+            q = self._queue(topic)
+            while True:
+                try:
+                    backlog = q.get_nowait()
+                except queue.Empty:
+                    break
+                self._deliver(callback, backlog)
 
     def publish(self, topic: str, event: JobEvent) -> None:
         """Hand off an event. Publication succeeds once the event is
@@ -70,10 +73,10 @@ class EventBus:
         admission's rollback fires only when hand-off itself fails)."""
         with self._lock:
             sub = self._subscribers.get(topic)
-        if sub is not None:
-            self._deliver(sub, event)
-        else:
-            self._queue(topic).put(event)
+            if sub is None:
+                self._queue(topic).put(event)
+                return
+        self._deliver(sub, event)
 
     @staticmethod
     def _deliver(sub: Callable[[JobEvent], None], event: JobEvent) -> None:

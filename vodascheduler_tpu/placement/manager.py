@@ -103,14 +103,37 @@ class PlacementManager:
     def total_chips(self) -> int:
         return sum(h.total_slots for h in self.host_states.values())
 
-    # ---- the placement pass (reference: Place, :306-332) -----------------
+    # ---- the placement pass ----------------------------------------------
 
     def place(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """Incremental placement (TPU-first redesign of the reference's
+        Place, :306-332).
+
+        The reference repacks every job from scratch each pass and then
+        Hungarian-relabels nodes to maximize stay-put workers (:492-544) —
+        acceptable when a moved worker is a cheap pod delete under Elastic
+        Horovod, but on TPU every moved worker is a checkpoint-restart of
+        its whole job. Here jobs that keep their size keep their hosts
+        outright; only growth deltas and new jobs are packed (anchored to
+        the job's existing hosts for ICI contiguity). Migrations then only
+        arise from host loss — or from an explicit defragment() pass, which
+        is where the reference's full repack + Hungarian machinery lives
+        on."""
         old_worker_hosts = {job: self._expand_workers(p)
                             for job, p in self.job_placements.items()}
 
         self._release_slots(job_requests)
+        cross, contiguity = self._place_incremental(job_requests)
+        return self._decision(old_worker_hosts, cross, contiguity)
 
+    def defragment(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """Full repack + Hungarian stay-put relabeling (the reference's
+        Place semantics, :306-332). Consolidates fragmentation at the cost
+        of migrations; callers weigh that cost explicitly."""
+        old_worker_hosts = {job: self._expand_workers(p)
+                            for job, p in self.job_placements.items()}
+
+        self._release_slots(job_requests)
         # Empty logical hosts mirroring the physical fleet (:317-320).
         logical = [HostState(name=f"TBD-{i}", total_slots=h.total_slots,
                              coord=h.coord)
@@ -118,7 +141,10 @@ class PlacementManager:
         cross, contiguity = self._best_fit(job_requests, logical)
         self._bind_hosts(logical)
         self._update_job_placements()
+        return self._decision(old_worker_hosts, cross, contiguity)
 
+    def _decision(self, old_worker_hosts: Dict[str, List[str]],
+                  cross: int, contiguity: int) -> PlacementDecision:
         migrations: Dict[str, List[int]] = {}
         full_restarts: List[str] = []
         migrated = 0
@@ -142,6 +168,56 @@ class PlacementManager:
             total_contiguity_cost=contiguity,
             workers_migrated=migrated,
         )
+
+    def _place_incremental(self, job_requests: ScheduleResult) -> Tuple[int, int]:
+        """Pack only growth deltas and new jobs into current free slots.
+        Returns (#jobs crossing hosts, total contiguity cost) over ALL
+        placed jobs."""
+        hosts = self._hosts_sorted()
+        # Biggest demand first, like _best_fit.
+        for job, requested in sorted(job_requests.items(),
+                                     key=lambda kv: kv[1], reverse=True):
+            placement = self.job_placements.setdefault(job, JobPlacement(name=job))
+            # prune dead-host / zeroed entries before packing the delta
+            placement.host_slots = [hs for hs in placement.host_slots
+                                    if hs.num_slots > 0 and hs.host in self.host_states]
+            delta = requested - placement.num_workers
+            if delta <= 0:
+                continue  # pinned: same size (or release already trimmed it)
+            my_hosts = [self.host_states[hs.host] for hs in placement.host_slots
+                        if hs.host in self.host_states and hs.num_slots > 0]
+            while delta > 0:
+                best = self._pick_host(hosts, delta, my_hosts)
+                if best is None:
+                    break  # tolerated inconsistency: place what fits
+                take = min(best.free_slots, delta)
+                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
+                best.free_slots -= take
+                delta -= take
+                placement.num_workers += take
+                # merge into an existing tail entry for the same host
+                if placement.host_slots and placement.host_slots[-1].host == best.name:
+                    placement.host_slots[-1].num_slots += take
+                else:
+                    placement.host_slots.append(HostSlots(best.name, take))
+                if best not in my_hosts:
+                    my_hosts.append(best)
+            if placement.num_workers == 0:
+                del self.job_placements[job]
+
+        # Stats over the whole fleet.
+        cross = 0
+        contiguity = 0
+        for placement in self.job_placements.values():
+            used = {hs.host for hs in placement.host_slots if hs.num_slots > 0}
+            if len(used) > 1:
+                cross += 1
+                if self.topology is not None:
+                    coords = [self.host_states[h].coord for h in used
+                              if h in self.host_states
+                              and self.host_states[h].coord is not None]
+                    contiguity += self.topology.contiguity_cost(coords)
+        return cross, contiguity
 
     # ---- step 1: release (reference :337-411) ----------------------------
 

@@ -1,0 +1,188 @@
+"""The replay harness: a full control plane + simulated cluster, fed a
+trace, measured on JCT and chip utilization.
+
+Fills SURVEY.md §7 stage 8. The whole stack is real — admission, event bus,
+allocator, scheduler, placement, metrics collector — only the cluster and
+the clock are simulated, so replay results exercise exactly the code paths
+production runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.metricscollector import BackendRowSource, MetricsCollector
+from vodascheduler_tpu.placement import PlacementManager, PoolTopology
+from vodascheduler_tpu.replay.trace import TraceJob
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    algorithm: str
+    num_jobs: int
+    completed: int
+    failed: int
+    makespan_seconds: float
+    avg_jct_seconds: float
+    p50_jct_seconds: float
+    p95_jct_seconds: float
+    avg_wait_seconds: float
+    chip_utilization: float      # productive chip-seconds / capacity window
+    total_chips: int
+    restarts_total: int
+    rescheds_total: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class PreemptionEvent:
+    """Spot-style host removal (negative delay re-adds)."""
+
+    at_seconds: float
+    host: str
+    add: bool = False
+    chips: int = 0
+
+
+class ReplayHarness:
+    def __init__(
+        self,
+        trace: Sequence[TraceJob],
+        algorithm: str = "ElasticTiresias",
+        topology: Optional[PoolTopology] = None,
+        pool: str = "replay-pool",
+        restart_overhead_seconds: float = 30.0,
+        rate_limit_seconds: float = 30.0,
+        collector_interval_seconds: float = 60.0,
+        preemptions: Sequence[PreemptionEvent] = (),
+        start_epoch: float = 1753760000.0,
+    ):
+        self.trace = list(trace)
+        self.algorithm = algorithm
+        self.pool = pool
+        self.clock = VirtualClock(start=start_epoch)
+        self.store = JobStore()
+        self.bus = EventBus()
+        self.backend = FakeClusterBackend(
+            self.clock, restart_overhead_seconds=restart_overhead_seconds)
+
+        self.topology = topology or PoolTopology(torus_dims=(4, 4, 4),
+                                                 host_block=(2, 2, 1))
+        pm = PlacementManager(pool, topology=self.topology)
+        pm.add_hosts_from_topology(self.topology)
+        for coord in self.topology.host_coords():
+            self.backend.add_host(self.topology.host_name(coord),
+                                  self.topology.chips_per_host, announce=False)
+
+        self.scheduler = Scheduler(
+            pool, self.backend, self.store, ResourceAllocator(self.store),
+            self.clock, bus=self.bus, placement_manager=pm,
+            algorithm=algorithm, rate_limit_seconds=rate_limit_seconds)
+        self.admission = AdmissionService(self.store, self.bus, self.clock)
+        self.collector = MetricsCollector(
+            self.store, BackendRowSource(self.backend), self.clock,
+            interval_seconds=collector_interval_seconds)
+        self.collector.start()
+
+        self._submitted: List[str] = []
+        self._first_submit_at: Optional[float] = None
+
+        for tj in self.trace:
+            self.clock.call_later(tj.submit_offset_seconds,
+                                  lambda tj=tj: self._submit(tj))
+        for ev in preemptions:
+            if ev.add:
+                self.clock.call_later(
+                    ev.at_seconds,
+                    lambda ev=ev: self.backend.add_host(ev.host, ev.chips))
+            else:
+                self.clock.call_later(
+                    ev.at_seconds,
+                    lambda ev=ev: self.backend.remove_host(ev.host))
+
+    def _submit(self, tj: TraceJob) -> None:
+        self.backend.register_profile(tj.model, tj.profile())
+        name = self.admission.create_training_job(tj.job_spec(self.pool))
+        self._submitted.append(name)
+        if self._first_submit_at is None:
+            self._first_submit_at = self.clock.now()
+
+    # ---- run -------------------------------------------------------------
+
+    def run(self, max_sim_seconds: float = 90 * 24 * 3600.0,
+            stall_horizon_seconds: float = 48 * 3600.0) -> ReplayReport:
+        deadline = self.clock.now() + max_sim_seconds
+        last_progress_at = self.clock.now()
+        last_done = -1
+        while not self._all_done():
+            nxt = self.clock.next_timer()
+            if nxt is None or nxt > deadline:
+                break
+            self.clock.advance_to(nxt)
+            done = len(self.backend.completed) + len(self.backend.failed)
+            if done != last_done:
+                last_done = done
+                last_progress_at = self.clock.now()
+            elif (not self.backend.running_jobs()
+                    and len(self._submitted) == len(self.trace)
+                    and self.clock.now() - last_progress_at > stall_horizon_seconds):
+                # Livelock: jobs queued, nothing running, nothing scheduled.
+                # A correct algorithm never reaches this; break rather than
+                # simulating an idle eternity.
+                break
+        return self._report()
+
+    def _all_done(self) -> bool:
+        if len(self._submitted) < len(self.trace):
+            return False
+        done = set(self.backend.completed) | set(self.backend.failed)
+        return all(name in done for name in self._submitted)
+
+    # ---- metrics ---------------------------------------------------------
+
+    def _report(self) -> ReplayReport:
+        jcts: List[float] = []
+        waits: List[float] = []
+        for name in self._submitted:
+            job = self.store.get_job(name)
+            if job is None or job.finish_time >= 1e300:
+                continue
+            jcts.append(job.finish_time - job.submit_time)
+            waits.append(job.metrics.waiting_seconds)
+
+        start = self._first_submit_at or self.clock.now()
+        end = max((self.store.get_job(n).finish_time for n in self._submitted
+                   if self.store.get_job(n) and self.store.get_job(n).finish_time < 1e300),
+                  default=self.clock.now())
+        makespan = max(1e-9, end - start)
+        capacity = self.backend.total_chips() * makespan
+        util = self.backend.busy_chip_seconds / capacity if capacity > 0 else 0.0
+
+        return ReplayReport(
+            algorithm=self.algorithm,
+            num_jobs=len(self.trace),
+            completed=len(self.backend.completed),
+            failed=len(self.backend.failed),
+            makespan_seconds=makespan,
+            avg_jct_seconds=statistics.mean(jcts) if jcts else 0.0,
+            p50_jct_seconds=statistics.median(jcts) if jcts else 0.0,
+            p95_jct_seconds=(statistics.quantiles(jcts, n=20)[18]
+                             if len(jcts) >= 20 else (max(jcts) if jcts else 0.0)),
+            avg_wait_seconds=statistics.mean(waits) if waits else 0.0,
+            chip_utilization=util,
+            total_chips=self.backend.total_chips(),
+            restarts_total=self.backend.restarts_total,
+            rescheds_total=self.scheduler.m_resched_total.value(),
+        )

@@ -70,6 +70,8 @@ class Scheduler:
         ticker_seconds: float = DEFAULT_TICKER_SECONDS,
         resume: bool = False,
         registry: Optional[Registry] = None,
+        scale_out_hysteresis: float = 1.0,
+        resize_cooldown_seconds: float = 120.0,
     ):
         self.pool_id = pool_id
         self.backend = backend
@@ -80,6 +82,20 @@ class Scheduler:
         self.algorithm = algorithm
         self.rate_limit_seconds = rate_limit_seconds
         self.ticker_seconds = ticker_seconds
+        # TPU-specific: a scale-out is a checkpoint-restart, not a free ring
+        # rebuild, so small growth doesn't pay for the restart pause. Small
+        # growth (new < ceil(old * hysteresis)) is suppressed only within
+        # resize_cooldown_seconds of the job's last resize — suppression
+        # must delay a restart, never permanently strand idle chips. Set
+        # hysteresis to 1.0 to disable (reference semantics — it applies
+        # every diff, scheduler.go:448-480, because Horovod resizes are
+        # cheap).
+        self.scale_out_hysteresis = scale_out_hysteresis
+        self.resize_cooldown_seconds = resize_cooldown_seconds
+        self._last_resize_at: Dict[str, float] = {}
+        # Jobs needing re-placement after host churn even if their chip
+        # count is unchanged (e.g. their host died).
+        self._placement_dirty = False
 
         # Job state (reference: ReadyJobsMap / DoneJobsMap / JobNumGPU,
         # scheduler.go:81-93).
@@ -269,6 +285,9 @@ class Scheduler:
         self.total_chips = sum(self.backend.list_hosts().values())
         if self.placement_manager is not None:
             self.placement_manager.remove_host(name)
+            # Jobs that lost workers need re-placement even if the next
+            # allocation leaves their chip count unchanged.
+            self._placement_dirty = True
         self.trigger_resched()
 
     # ---- rescheduling (reference: Run select loop + resched :271-434) ----
@@ -337,6 +356,8 @@ class Scheduler:
             return
         self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
 
+        if self.scale_out_hysteresis > 1.0:
+            self._apply_hysteresis(old, new)
         self.job_num_chips = new
         halts, scale_ins, scale_outs, starts = self.compare_results(old)
         changed = bool(halts or scale_ins or scale_outs or starts)
@@ -346,12 +367,13 @@ class Scheduler:
         # §3.3), we own the runtime: compute host bindings first and hand
         # them to the backend with each start/scale.
         placements: Dict[str, List[Tuple[str, int]]] = {}
-        migrations: Dict[str, List[int]] = {}
-        if changed and self.placement_manager is not None:
+        placed = False
+        if (changed or self._placement_dirty) and self.placement_manager is not None:
             decision = self.placement_manager.place(
                 {j: n for j, n in self.job_num_chips.items() if n > 0})
             placements = decision.placements
-            migrations = decision.migrations
+            placed = True
+            self._placement_dirty = False
 
         # Halts and scale-ins release chips before starts/scale-outs claim
         # them (reference: applySchedulerResults order, scheduler.go:434-445).
@@ -363,15 +385,46 @@ class Scheduler:
             self._start_job(job, placements.get(job))
         for job in scale_outs:
             self._scale_job(job, placements.get(job))
-        # Same-size jobs whose workers moved hosts: migrate (=restart) them.
-        for job_name in migrations:
-            if (old.get(job_name) == self.job_num_chips.get(job_name)
-                    and job_name not in (set(halts) | set(starts))):
-                self.backend.migrate_workers(job_name, placements[job_name])
+        if placed:
+            self._migrate_moved_jobs(
+                placements, set(halts) | set(starts) | set(scale_ins) | set(scale_outs))
 
         self.store.flush()  # batch boundary for autoflush=False stores
         self.m_resched_total.inc()
         self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
+
+    def _migrate_moved_jobs(self, placements: Dict[str, List[Tuple[str, int]]],
+                            already_restarted: set) -> None:
+        """Restart same-size jobs whose host binding no longer matches what
+        the backend is running — including jobs whose workers died with a
+        removed host (those produce no index-level move in the placement
+        diff, so the backend's live view is the ground truth to compare)."""
+        live = self.backend.running_jobs()
+        for job_name, target in placements.items():
+            if job_name in already_restarted:
+                continue
+            handle = live.get(job_name)
+            if handle is None:
+                continue
+            if sorted(handle.placements) != sorted(target):
+                self.backend.migrate_workers(job_name, target)
+                self._last_resize_at[job_name] = self.clock.now()
+
+    def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
+        """Suppress small scale-outs of recently-resized running jobs (see
+        ctor comment). Keeping the old (smaller) allocation only shrinks the
+        total, so the result stays valid; the cooldown guarantees the growth
+        eventually applies instead of stranding chips forever."""
+        import math as _math
+
+        now = self.clock.now()
+        for job, n_new in new.items():
+            n_old = old.get(job, 0)
+            if (n_old > 0 and n_new > n_old
+                    and n_new < _math.ceil(n_old * self.scale_out_hysteresis)
+                    and now - self._last_resize_at.get(job, -float("inf"))
+                    < self.resize_cooldown_seconds):
+                new[job] = n_old
 
     def _schedule_retry(self) -> None:
         """Reference: TriggerReschedAtTime after allocator failure
@@ -380,6 +433,9 @@ class Scheduler:
         if isinstance(self.clock, VirtualClock):
             self.clock.call_later(delay, self.trigger_resched)
         else:
+            # Real-time mode: keep the request pending so the service
+            # daemon retries once the window opens.
+            self._resched_pending = True
             self.resched_blocked_until = self.clock.now() + delay
 
     def compare_results(self, old: ScheduleResult) -> Tuple[
@@ -421,6 +477,11 @@ class Scheduler:
         job.status = JobStatus.RUNNING
         job.metrics.last_chip_seconds = 0.0
         job.metrics.last_running_seconds = 0.0
+        # Also consume the waiting window (the reference leaves it,
+        # scheduler.go:505-514, letting a freshly-started job immediately
+        # satisfy the Tiresias promote test and bounce back to queue 0).
+        job.metrics.last_waiting_seconds = 0.0
+        self._last_resize_at[name] = self.clock.now()
         if job.metrics.running_seconds == 0:
             job.metrics.first_start_time = self.clock.now()
         self.store.update_job(job)
@@ -429,6 +490,7 @@ class Scheduler:
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
         """Reference: scaleTrainingJob (scheduler.go:542-574)."""
         self.backend.scale_job(name, self.job_num_chips[name], placements)
+        self._last_resize_at[name] = self.clock.now()
 
     def _halt_job(self, name: str) -> None:
         """Reference: haltTrainingJob (scheduler.go:576-590)."""
