@@ -1,5 +1,9 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-imports, so sharding/mesh tests run hermetically without TPU hardware."""
+"""Test configuration: force an 8-device virtual CPU platform BEFORE any
+jax use, so sharding/mesh tests run hermetically without TPU hardware.
+
+Note: the env var alone is not enough under TPU plugins that register
+themselves eagerly (e.g. the axon tunnel) — the config API call wins.
+"""
 
 import os
 
@@ -8,3 +12,7 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -180,13 +180,13 @@ class TestElasticTiresias:
         result = ElasticTiresias().schedule(jobs, 6)
         assert result == {"a": 2, "b": 4}
 
-    def test_no_gain_topup_stops_at_curve_edge(self):
-        # Zero-gain (flat) regions are topped up for work conservation, but
-        # unknown counts past the curve (speedup 0 -> negative gain) stop it.
+    def test_no_gain_no_allocation(self):
+        # Zero-marginal-gain growth is declined: a grant is a
+        # checkpoint-restart, so flat speedup regions aren't worth it.
         jobs = [make_job("a", num_chips=1, min_chips=1, max_chips=8,
                          speedup={0: 0, 1: 1.0, 2: 1.0, 3: 1.0, 4: 1.0})]
         result = ElasticTiresias().schedule(jobs, 8)
-        assert result == {"a": 4}
+        assert result == {"a": 1}
 
     def test_compaction_shrinks_low_priority(self):
         # A low-priority job holding 4 chips + 12 pending jobs too big to
@@ -200,10 +200,10 @@ class TestElasticTiresias:
                              speedup={n: float(n) for n in range(0, 10)})
                     for i in range(12)]
         result = ElasticTiresias().schedule([fat] + pendings, 6)
-        # compaction shrank fat to min=1; the flat (zero-gain) curve lets
-        # the work-conserving top-up regrow it to max since no pending job
-        # can use the chips (min 8 > capacity 6)
-        assert result["fat"] == 4
+        # compaction shrank fat to min=1; its flat curve (zero gain) means
+        # regrowing it isn't worth a restart, and no pending job fits
+        # (min 8 > capacity 6)
+        assert result["fat"] == 1
         assert all(result[f"p{i}"] == 0 for i in range(12))
 
     def test_running_job_absorbs_leftover_below_its_min(self):

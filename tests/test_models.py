@@ -1,0 +1,91 @@
+"""Model zoo + training-core tests on the virtual 8-device CPU mesh.
+
+Kept deliberately small (tiny configs, few steps) — CPU compile time
+dominates; the real-device path is exercised by bench/graft entry.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vodascheduler_tpu.models import get_model, MODEL_REGISTRY
+from vodascheduler_tpu.parallel.mesh import MeshPlan
+from vodascheduler_tpu.runtime import TrainSession
+
+
+class TestRegistry:
+    def test_all_registered_names_resolve(self):
+        for name in MODEL_REGISTRY:
+            assert get_model(name).module is not None
+
+    def test_aliases(self):
+        assert get_model("llama8b").name == "llama3_8b"
+        assert get_model("mixtral").name == "mixtral_8x7b"
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            get_model("gpt17")
+
+    def test_flagship_param_count_is_8b_scale(self):
+        from vodascheduler_tpu.models.llama import LLAMA3_8B
+        assert 7e9 < LLAMA3_8B.param_count < 9e9
+
+
+class TestTraining:
+    def test_llama_tiny_trains_dp(self):
+        s = TrainSession(get_model("llama_tiny"), num_chips=8,
+                         global_batch_size=8)
+        first = s.run_steps(1)
+        for _ in range(3):
+            last = s.run_steps(5)
+        assert s.step == 16
+        assert last < first  # synthetic but learnable (memorizes RNG stream stats)
+
+    def test_sharding_plans_agree_on_loss(self):
+        # The same seed must produce the same loss under any sharding —
+        # GSPMD correctness across dp/fsdp/tp.
+        losses = {}
+        for label, plan in [("dp", MeshPlan(dp=8)),
+                            ("fsdp_tp", MeshPlan(fsdp=4, tp=2)),
+                            ("mixed", MeshPlan(dp=2, fsdp=2, tp=2))]:
+            s = TrainSession(get_model("llama_tiny"), num_chips=8,
+                             global_batch_size=8, plan=plan, seed=7)
+            losses[label] = s.run_steps(2)
+        vals = list(losses.values())
+        for v in vals[1:]:
+            assert abs(v - vals[0]) < 5e-2, losses
+
+    def test_params_actually_sharded_under_fsdp(self):
+        s = TrainSession(get_model("llama_tiny"), num_chips=8,
+                         global_batch_size=8, plan=MeshPlan(fsdp=4, tp=2))
+        leaves = jax.tree.leaves(s.state["params"])
+        sharded = [x for x in leaves if not x.sharding.is_fully_replicated]
+        assert len(sharded) >= len(leaves) // 2
+
+    def test_moe_trains_with_ep(self):
+        s = TrainSession(get_model("mixtral_tiny"), num_chips=8,
+                         global_batch_size=8, plan=MeshPlan(dp=2, ep=4))
+        loss = s.run_steps(2)
+        assert 0 < loss < 20
+
+    def test_ring_attention_training_path(self):
+        s = TrainSession(get_model("llama_tiny"), num_chips=8,
+                         global_batch_size=8, plan=MeshPlan(dp=2, sp=4))
+        loss = s.run_steps(2)
+        assert 0 < loss < 20
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (2, 64, 256)
+
+    def test_dryrun_multichip(self, capsys):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
+        out = capsys.readouterr().out
+        assert "OK" in out

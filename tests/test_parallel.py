@@ -1,0 +1,91 @@
+"""Mesh planning, sharding rules, and ring attention tests (8-device
+virtual CPU mesh from conftest)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
+from vodascheduler_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+from vodascheduler_tpu.parallel.sharding import (
+    TRANSFORMER_RULES,
+    _fit_spec,
+    batch_sharding,
+)
+from jax.sharding import PartitionSpec as P
+
+
+class TestMeshPlan:
+    def test_small_model_pure_dp(self):
+        plan = plan_mesh(8, model_params_b=0.1)
+        assert plan.dp == 8 and plan.tp == 1 and plan.fsdp == 1
+
+    def test_large_model_gets_tp_and_fsdp(self):
+        plan = plan_mesh(8, model_params_b=8.0)
+        assert plan.tp > 1 and plan.fsdp > 1
+        assert plan.num_chips == 8
+
+    def test_long_context_gets_sp(self):
+        plan = plan_mesh(8, model_params_b=8.0, seq_len=65536)
+        assert plan.sp > 1
+        assert plan.num_chips == 8
+
+    def test_moe_gets_ep(self):
+        plan = plan_mesh(8, num_experts=8)
+        assert plan.ep > 1
+        assert plan.num_chips == 8
+
+    def test_build_mesh_axis_names(self):
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["tp"] == 2
+
+    def test_build_mesh_too_few_devices(self):
+        with pytest.raises(ValueError):
+            build_mesh(MeshPlan(dp=16))
+
+
+class TestShardingRules:
+    def test_transformer_rule_matching(self):
+        assert TRANSFORMER_RULES.spec_for("layer_0/attn/q_proj/kernel") == P("fsdp", "tp")
+        assert TRANSFORMER_RULES.spec_for("layer_3/mlp/down_proj/kernel") == P("tp", "fsdp")
+        assert TRANSFORMER_RULES.spec_for("layer_1/attn_norm/scale") == P()
+        assert TRANSFORMER_RULES.spec_for("embed/embedding") == P("tp", "fsdp")
+
+    def test_fit_spec_drops_nondividing_axes(self):
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        # dim 3 not divisible by fsdp=2 -> replicated on that dim
+        assert _fit_spec(P("fsdp", "tp"), (3, 4), mesh) == P(None, "tp")
+        assert _fit_spec(P("fsdp", "tp"), (4, 4), mesh) == P("fsdp", "tp")
+        # spec longer than rank is trimmed
+        assert _fit_spec(P("fsdp", "tp"), (8,), mesh) == P("fsdp")
+
+    def test_batch_sharding_uses_data_axes(self):
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        spec = batch_sharding(mesh).spec
+        assert spec == P(("dp", "fsdp"))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = build_mesh(MeshPlan(dp=2, sp=4))
+        B, S, H, D = 2, 32, 4, 8
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), dtype=jnp.float32)
+                   for kk in jax.random.split(jax.random.PRNGKey(0), 3))
+        ring = make_ring_attention(mesh, causal=causal)
+        out = jax.jit(ring)(q, k, v)
+        ref = reference_attention(q, k, v, causal=causal)
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+    def test_degenerate_single_shard(self):
+        mesh = build_mesh(MeshPlan(dp=8))
+        B, S, H, D = 1, 16, 2, 8
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), dtype=jnp.float32)
+                   for kk in jax.random.split(jax.random.PRNGKey(1), 3))
+        out = make_ring_attention(mesh, causal=True)(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5

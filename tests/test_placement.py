@@ -207,3 +207,36 @@ class TestICIContiguity:
         b_hosts = {h for h, _ in d.placements["b"]}
         assert not (a_hosts & b_hosts)
         assert len(a_hosts) == 2 and len(b_hosts) == 2
+
+
+class TestDefragment:
+    def test_defragment_consolidates_fragmented_job(self):
+        pm = manager_with_hosts(3, 4)
+        # fragment: a spans two hosts after churn
+        pm.place({"a": 2, "b": 4, "c": 4})
+        pm.place({"a": 6, "b": 4})  # c gone; a grows into freed space
+        frag = {h for h, n in ((hs.host, hs.num_slots)
+                for hs in pm.job_placements["a"].host_slots) if n > 0}
+        d = pm.defragment({"a": 6, "b": 4})
+        assert sum(n for _, n in d.placements["a"]) == 6
+        assert sum(n for _, n in d.placements["b"]) == 4
+
+    def test_scheduler_triggers_defrag_at_threshold(self):
+        from tests.test_scheduler import build_world, spec
+
+        clock, store, bus, backend, sched, admission = build_world(
+            num_hosts=4, chips_per_host=4)
+        sched.defrag_cross_host_threshold = 1
+        a = admission.create_training_job(spec("a", min_chips=1, max_chips=6))
+        clock.advance(2.0)
+        b = admission.create_training_job(spec("b", min_chips=1, max_chips=6))
+        clock.advance(2.0)
+        # a=6 spans hosts -> cross_host >= 1 -> next pass defragments
+        assert sched._last_cross_host >= 1
+        admission.create_training_job(spec("c", min_chips=1, max_chips=4))
+        clock.advance(5.0)  # this resched runs defragment() without error
+        placed = sum(sum(n for _, n in p)
+                     for p in sched.placement_manager.job_placements and
+                     [[(hs.host, hs.num_slots) for hs in jp.host_slots]
+                      for jp in sched.placement_manager.job_placements.values()])
+        assert placed == sum(sched.job_num_chips.values())

@@ -11,11 +11,11 @@ GPU Clusters" (TPDS'21), matching the reference semantics
 3. Repeatedly give the next chip to the job with the highest marginal
    speedup gain (`speedup[n+1] - speedup[n]`); a still-pending job must
    receive its full minimum or nothing; stop when no job gains.
-4. Work-conserving top-up (deliberate addition over the EDL paper/reference,
-   which strand chips once the best marginal gain hits zero): remaining
-   chips go round-robin to running jobs below their max whose speedup curve
-   is non-decreasing at their current size — occupancy is free, only an
-   actual slowdown (negative gain) is declined.
+
+Chips the gain loop declines stay free deliberately: on TPU every grant is
+a checkpoint-restart of the receiving job, so zero-marginal-gain growth is
+pure restart cost, not "free occupancy" (a work-conserving top-up was
+tried and removed for this reason).
 """
 
 from __future__ import annotations
@@ -106,23 +106,6 @@ class ElasticTiresias(SchedulerAlgorithm):
                 gain[job.name] = next_gain(info, result[job.name])
                 if result[job.name] >= job.config.max_num_chips:
                     candidates.remove(job)
-
-        # Phase 3: work-conserving top-up (see module docstring).
-        topup = [j for j in jobs if 0 < result[j.name] < j.config.max_num_chips]
-        progressed = True
-        while free > 0 and topup and progressed:
-            progressed = False
-            for job in list(topup):
-                if free == 0:
-                    break
-                if next_gain(job.info or JobInfo(), result[job.name]) < 0:
-                    topup.remove(job)
-                    continue
-                result[job.name] += 1
-                free -= 1
-                progressed = True
-                if result[job.name] >= job.config.max_num_chips:
-                    topup.remove(job)
 
         validate_result(total_chips, result, jobs)
         return result

@@ -102,29 +102,58 @@ class FakeClusterBackend(ClusterBackend):
         # jobs vs capacity)
         self.busy_chip_seconds: float = 0.0
         self.restarts_total: int = 0  # cumulative across all jobs, ever
+        # (timestamp, total_chips) after each fleet change — lets callers
+        # integrate capacity over time (preemption changes the denominator)
+        self.capacity_history: List[Tuple[float, int]] = []
 
     # ---- fleet management -------------------------------------------------
 
     def add_host(self, name: str, chips: int, announce: bool = True) -> None:
         self.hosts[name] = chips
+        self.capacity_history.append((self.clock.now(), self.total_chips()))
         if announce:
             self.emit(ClusterEvent(ClusterEventKind.HOST_ADDED, name,
                                    timestamp=self.clock.now()))
 
     def remove_host(self, name: str, announce: bool = True) -> None:
         self.hosts.pop(name, None)
+        self.capacity_history.append((self.clock.now(), self.total_chips()))
         if announce:
             self.emit(ClusterEvent(ClusterEventKind.HOST_REMOVED, name,
                                    timestamp=self.clock.now()))
 
+    def capacity_chip_seconds(self, start: float, end: float) -> float:
+        """∫ total_chips dt over [start, end], from capacity_history."""
+        if end <= start:
+            return 0.0
+        total = 0.0
+        chips = 0
+        t_prev = start
+        for t, c in self.capacity_history:
+            if t <= start:
+                chips = c
+                continue
+            if t >= end:
+                break
+            total += (t - t_prev) * chips
+            t_prev = t
+            chips = c
+        total += (end - t_prev) * chips
+        return total
+
     def list_hosts(self) -> Dict[str, int]:
         return dict(self.hosts)
 
-    def register_profile(self, category: str, profile: WorkloadProfile) -> None:
-        self.profiles[category] = profile
+    def register_profile(self, name: str, profile: WorkloadProfile) -> None:
+        """Register under an exact job name or a category (family) name.
+        Exact-name entries win, so per-job fault injection never
+        cross-contaminates same-family jobs."""
+        self.profiles[name] = profile
 
     def _profile_for(self, spec: JobSpec) -> WorkloadProfile:
-        return self.profiles.get(category_of(spec.name), self.default_profile)
+        return self.profiles.get(
+            spec.name,
+            self.profiles.get(category_of(spec.name), self.default_profile))
 
     # ---- ClusterBackend --------------------------------------------------
 
@@ -251,10 +280,19 @@ class FakeClusterBackend(ClusterBackend):
         sim.progress_serial = min(sim.total_serial,
                                   max(sim.progress_serial,
                                       sim.epochs_done * sim.profile.epoch_seconds_at_1))
+        # Report the step-time-derived epoch time at the current worker
+        # count, the way a real trainer's logger does (mean step time x
+        # steps/epoch, callbacks.py:104-154) — NOT the wall span, which on
+        # TPU includes restart pauses and partial epochs at the old size and
+        # would pollute the learned speedup curves with spurious negative
+        # marginal gains.
+        rate = sim.profile.speedup_at(sim.num_workers)
+        clean_epoch_time = (sim.profile.epoch_seconds_at_1 / rate
+                            if rate > 0 else now - sim.epoch_started_at)
         self.metrics_rows[sim.spec.name].append(MetricsRow(
             job=sim.spec.name,
             epoch=sim.epochs_done - 1,  # 0-based like the reference CSV
-            epoch_time_sec=now - sim.epoch_started_at,
+            epoch_time_sec=clean_epoch_time,
             workers=sim.num_workers,
             timestamp=now,
         ))

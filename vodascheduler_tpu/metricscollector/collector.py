@@ -159,13 +159,22 @@ class MetricsCollector:
 
     @staticmethod
     def _epoch_seconds_at_1(info: JobInfo) -> Optional[float]:
-        """Serial epoch time: measured at 1 chip if available, else inferred
-        from the fastest measured count through the current speedup curve."""
+        """Serial epoch time: measured at 1 chip if available, else anchored
+        on the *smallest* measured count through the static linear prior
+        (t1 ~= t[m] * m).
+
+        The anchor must never go through the learned speedup values: that
+        feeds the estimate back into itself across collection passes and
+        spirals the whole curve toward zero (each pass divides by the
+        previous underestimate). With a static anchor the absolute level is
+        at worst prior-biased, but relative gains — what the elastic
+        algorithms actually rank by — stay monotone and converge as smaller
+        counts get measured."""
         if 1 in info.epoch_seconds:
             return info.epoch_seconds[1]
-        candidates = []
-        for n, t in info.epoch_seconds.items():
-            s = info.speedup.get(n, float(n))
-            if s > 0 and t > 0:
-                candidates.append(t * s)
-        return min(candidates) if candidates else None
+        measured = [(n, t) for n, t in info.epoch_seconds.items()
+                    if n > 0 and t > 0]
+        if not measured:
+            return None
+        m, t = min(measured)
+        return t * float(m)

@@ -1,0 +1,148 @@
+"""Shared transformer building blocks, TPU-first:
+
+- bfloat16 activations, fp32 norm/softmax accumulators (MXU-friendly)
+- static shapes everywhere; no data-dependent Python control flow
+- GQA attention that can swap in ring attention for sequence-parallel
+  long-context (parallel/ring_attention.py)
+- param layouts chosen so the sharding rules (parallel/sharding.py) map
+  heads/hidden onto `tp` and the complementary axis onto `fsdp`
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vodascheduler_tpu.parallel.ring_attention import reference_attention
+
+Dtype = Any
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm, fp32 accumulation (llama-family norm)."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding. x: [B, S, H, D] (D even)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+@dataclasses.dataclass
+class AttnConfig:
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    causal: bool = True
+    rope_base: float = 10000.0
+
+
+class Attention(nn.Module):
+    """Grouped-query attention; `attn_fn` lets the runtime swap in ring
+    attention when the mesh has an `sp` axis."""
+
+    cfg: AttnConfig
+    attn_fn: Optional[Callable] = None  # (q,k,v)->out, [B,S,H,D] layout
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        dense = lambda feats, name: nn.DenseGeneral(
+            features=feats, axis=-1, use_bias=False, name=name,
+            dtype=x.dtype, param_dtype=jnp.float32)
+        q = dense((cfg.num_heads, cfg.head_dim), "q_proj")(x)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj")(x)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj")(x)
+
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        if cfg.rope_base > 0:
+            q = rope(q, positions, cfg.rope_base)
+            k = rope(k, positions, cfg.rope_base)
+
+        groups = cfg.num_heads // cfg.num_kv_heads
+        if groups > 1:  # expand kv heads for GQA
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+
+        fn = self.attn_fn
+        if fn is None:
+            fn = lambda q, k, v: reference_attention(q, k, v, causal=cfg.causal)
+        out = fn(q, k, v)  # [B,S,H,D]
+
+        out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
+        return nn.DenseGeneral(features=x.shape[-1], use_bias=False,
+                               name="o_proj", dtype=x.dtype,
+                               param_dtype=jnp.float32)(out)
+
+
+class SwiGLU(nn.Module):
+    """Llama-family gated MLP."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        gate = nn.Dense(self.hidden, use_bias=False, name="gate_proj",
+                        dtype=x.dtype, param_dtype=jnp.float32)(x)
+        up = nn.Dense(self.hidden, use_bias=False, name="up_proj",
+                      dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return nn.Dense(d, use_bias=False, name="down_proj", dtype=x.dtype,
+                        param_dtype=jnp.float32)(nn.silu(gate) * up)
+
+
+class DecoderBlock(nn.Module):
+    """Pre-norm decoder block (llama-style)."""
+
+    attn_cfg: AttnConfig
+    mlp_hidden: int
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        x = x + Attention(self.attn_cfg, attn_fn=self.attn_fn,
+                          name="attn")(RMSNorm(name="attn_norm")(x), positions)
+        x = x + SwiGLU(self.mlp_hidden, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class EncoderBlock(nn.Module):
+    """Pre-norm bidirectional block (BERT/ViT-style): LayerNorm + GELU MLP."""
+
+    attn_cfg: AttnConfig
+    mlp_hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(x.dtype)
+        x = x + Attention(self.attn_cfg, name="attn")(h)
+        h = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(x.dtype)
+        h = nn.Dense(self.mlp_hidden, name="fc1", dtype=x.dtype,
+                     param_dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], name="fc2", dtype=x.dtype,
+                     param_dtype=jnp.float32)(h)
+        return x + h

@@ -1,0 +1,73 @@
+"""Llama-style decoder-only LM — the flagship model family.
+
+Covers BASELINE.md config 4 (Llama-3-8B FSDP elastic). Architecture:
+RMSNorm pre-norm, RoPE, GQA, SwiGLU, untied LM head. Long-context variants
+swap ring attention in via `attn_fn` (the runtime builds it from the mesh's
+`sp` axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, DecoderBlock, RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_hidden: int = 14336
+    max_seq_len: int = 8192
+    rope_base: float = 500000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+    @property
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.dim * 2  # embed + head
+        per_layer = (self.dim * self.head_dim
+                     * (self.num_heads * 2 + self.num_kv_heads * 2)
+                     + 3 * self.dim * self.mlp_hidden + 2 * self.dim)
+        return embed + self.num_layers * per_layer + self.dim
+
+
+# Llama-3-8B (the baseline config's model)
+LLAMA3_8B = LlamaConfig()
+# Tiny config for tests / compile checks
+LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
+                         num_kv_heads=2, mlp_hidden=128, max_seq_len=128,
+                         rope_base=10000.0)
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens [B, S] int32 -> logits [B, S, vocab]."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
+                     param_dtype=jnp.float32, dtype=dtype)(tokens)
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_kv_heads,
+                              head_dim=cfg.head_dim, causal=True,
+                              rope_base=cfg.rope_base)
+        for i in range(cfg.num_layers):
+            x = DecoderBlock(attn_cfg, cfg.mlp_hidden, attn_fn=self.attn_fn,
+                             name=f"layer_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                        dtype=dtype, param_dtype=jnp.float32)(x)

@@ -1,0 +1,111 @@
+"""Mixtral-style sparse MoE decoder (BASELINE.md config 5's MoE family).
+
+TPU-first MoE: top-2 gating with *dense dispatch* — every expert computes
+every token, weighted by the router's (renormalized, top-k-masked) probs
+via one batched einsum over the expert axis. For the expert counts here
+(8) this trades FLOPs for an XLA-friendly static dataflow: no gather/
+scatter, no capacity overflow, perfectly shardable over `ep` (each device
+holds its experts' weights; psum over ep combines outputs). Token-dropping
+all_to_all dispatch is the planned pallas upgrade for large expert counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, Attention, RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_hidden: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    rope_base: float = 1000000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+
+MIXTRAL_8X7B_LIKE = MixtralConfig()
+MIXTRAL_TINY = MixtralConfig(vocab_size=256, dim=64, num_layers=2,
+                             num_heads=4, num_kv_heads=2, mlp_hidden=128,
+                             num_experts=4, top_k=2, rope_base=10000.0)
+
+
+class MoEBlock(nn.Module):
+    """Top-k routed SwiGLU experts, dense dispatch over an expert axis."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        logits = nn.Dense(cfg.num_experts, use_bias=False, name="router",
+                          dtype=jnp.float32, param_dtype=jnp.float32)(
+                              x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)             # [B,S,E]
+        top_vals, _ = jax.lax.top_k(probs, cfg.top_k)
+        threshold = top_vals[..., -1:]                       # kth largest
+        gate = jnp.where(probs >= threshold, probs, 0.0)
+        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+        # expert weights stacked on a leading E axis (shardable over ep)
+        E, H = cfg.num_experts, cfg.mlp_hidden
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate_kernel", init, (E, D, H))
+        w_up = self.param("experts_up_kernel", init, (E, D, H))
+        w_down = self.param("experts_down_kernel", init, (E, H, D))
+
+        xb = x.astype(jnp.bfloat16)
+        h = jnp.einsum("bsd,edh->besh", xb, w_gate.astype(jnp.bfloat16))
+        u = jnp.einsum("bsd,edh->besh", xb, w_up.astype(jnp.bfloat16))
+        y = jnp.einsum("besh,ehd->besd", nn.silu(h) * u,
+                       w_down.astype(jnp.bfloat16))           # [B,E,S,D]
+        out = jnp.einsum("besd,bse->bsd", y.astype(jnp.float32),
+                         gate)
+        return out.astype(x.dtype)
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_kv_heads,
+                              head_dim=cfg.head_dim, causal=True,
+                              rope_base=cfg.rope_base)
+        x = x + Attention(attn_cfg, name="attn")(RMSNorm(name="attn_norm")(x))
+        x = x + MoEBlock(cfg, name="moe")(RMSNorm(name="moe_norm")(x))
+        return x
+
+
+class Mixtral(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
+                     param_dtype=jnp.float32, dtype=dtype)(tokens)
+        for i in range(cfg.num_layers):
+            x = MixtralBlock(cfg, name=f"layer_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                        dtype=dtype, param_dtype=jnp.float32)(x)

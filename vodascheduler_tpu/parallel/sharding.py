@@ -1,0 +1,120 @@
+"""Sharding rules: map parameter paths and batches onto the mesh.
+
+Pattern-based partitioning (path regex -> PartitionSpec) rather than model
+annotations: models stay plain flax modules, and the same model reshapes
+onto any mesh — the property elastic resize depends on (a checkpoint saved
+on an 8-chip mesh restores onto 32 chips by re-deriving shardings from the
+same rules, orbax handles the data movement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """Ordered (path-regex, PartitionSpec) rules; first match wins.
+
+    Spec axis names refer to mesh axes; axes absent from the mesh (size 1)
+    are dropped automatically by jax. `default` applies when nothing
+    matches (fsdp-shard the largest axis or replicate).
+    """
+
+    rules: List[Tuple[str, P]]
+    default: P = dataclasses.field(default_factory=P)
+
+    def spec_for(self, path: str) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        return self.default
+
+
+# Transformer rules (llama/bert/vit family): TP shards attention heads and
+# MLP hidden; FSDP shards the other big axis of every matrix.
+TRANSFORMER_RULES = ShardingRules(rules=[
+    # token/position embeddings: shard vocab over tp, model dim over fsdp
+    (r"embed.*embedding$", P("tp", "fsdp")),
+    # attention projections: qkv shard heads (tp), o shards model dim
+    (r"(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp")),
+    (r"o_proj.*kernel$", P("tp", "fsdp")),
+    # MLP: up/gate shard hidden (tp); down shards model dim back
+    (r"(up_proj|gate_proj|fc1).*kernel$", P("fsdp", "tp")),
+    (r"(down_proj|fc2).*kernel$", P("tp", "fsdp")),
+    # MoE expert weights: experts over ep, then like MLP
+    (r"experts.*(up|gate).*kernel$", P("ep", "fsdp", "tp")),
+    (r"experts.*down.*kernel$", P("ep", "tp", "fsdp")),
+    (r"router.*kernel$", P("fsdp", None)),
+    # final head
+    (r"lm_head.*kernel$", P("fsdp", "tp")),
+    # norms / biases / scales: replicate
+    (r"(norm|scale|bias|ln)", P()),
+])
+
+# Conv/vision rules (resnet): fsdp over output channels of large convs.
+CONV_RULES = ShardingRules(rules=[
+    (r"conv.*kernel$", P(None, None, None, "fsdp")),
+    (r"dense.*kernel$", P("fsdp", "tp")),
+    (r"(bn|norm|scale|bias)", P()),
+])
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        parts.append(str(name if name is not None else k))
+    return "/".join(parts)
+
+
+def param_shardings(params: Any, mesh: Mesh,
+                    rules: ShardingRules) -> Any:
+    """NamedShardings for a param pytree by path rules. Specs referring to
+    mesh axes of size 1 (or axes that don't divide the dim) fall back to
+    replication on that axis."""
+
+    def one(path, leaf):
+        spec = rules.spec_for(_path_str(path))
+        spec = _fit_spec(spec, getattr(leaf, "shape", ()), mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Trim a spec to the array rank and drop axes that don't divide the
+    dimension (falls back to replication for that dim)."""
+    out = []
+    for i, dim in enumerate(shape):
+        axis = spec[i] if i < len(spec) else None
+        if axis is None:
+            out.append(None)
+            continue
+        size = mesh.shape.get(axis, 1)
+        if size <= 1 or dim % size != 0:
+            out.append(None)
+        else:
+            out.append(axis)
+    return P(*out)
+
+
+def batch_sharding(mesh: Mesh, seq_axis: Optional[str] = None) -> NamedSharding:
+    """Batch sharding: batch dim over all data-like axes (dp+fsdp), and
+    optionally the sequence dim over sp."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    batch_axes = data_axes if data_axes else None
+    if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        return NamedSharding(mesh, P(batch_axes, seq_axis))
+    return NamedSharding(mesh, P(batch_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -38,6 +38,11 @@ class ReplayReport:
     p95_jct_seconds: float
     avg_wait_seconds: float
     chip_utilization: float      # productive chip-seconds / capacity window
+    # productive chip-seconds / attainable capacity, where attainable at any
+    # instant is min(fleet capacity, Σ ready jobs' max chips) — the honest
+    # denominator when the trace's ramp-up and drain-down tails cannot
+    # physically fill the fleet
+    attainable_utilization: float
     total_chips: int
     restarts_total: int
     rescheds_total: float
@@ -48,7 +53,8 @@ class ReplayReport:
 
 @dataclasses.dataclass
 class PreemptionEvent:
-    """Spot-style host removal (negative delay re-adds)."""
+    """Spot-style fleet change at a trace offset: removes `host`, or adds
+    it with `chips` capacity when `add=True`."""
 
     at_seconds: float
     host: str
@@ -98,6 +104,9 @@ class ReplayHarness:
 
         self._submitted: List[str] = []
         self._first_submit_at: Optional[float] = None
+        self._attainable_chip_seconds = 0.0
+        self._attainable_last_t: Optional[float] = None
+        self._sample_attainable()
 
         for tj in self.trace:
             self.clock.call_later(tj.submit_offset_seconds,
@@ -112,9 +121,22 @@ class ReplayHarness:
                     ev.at_seconds,
                     lambda ev=ev: self.backend.remove_host(ev.host))
 
+    def _sample_attainable(self, interval: float = 60.0) -> None:
+        """Integrate attainable capacity (piecewise over `interval`)."""
+        now = self.clock.now()
+        demand = sum(j.config.max_num_chips
+                     for j in self.scheduler.ready_jobs.values())
+        attainable = min(self.backend.total_chips(), demand)
+        if self._attainable_last_t is not None and self._first_submit_at is not None:
+            self._attainable_chip_seconds += (now - self._attainable_last_t) * attainable
+        self._attainable_last_t = now
+        self.clock.call_later(interval, self._sample_attainable)
+
     def _submit(self, tj: TraceJob) -> None:
-        self.backend.register_profile(tj.model, tj.profile())
         name = self.admission.create_training_job(tj.job_spec(self.pool))
+        # Exact-name registration: per-job fault injection must not leak to
+        # other jobs of the same family.
+        self.backend.register_profile(name, tj.profile())
         self._submitted.append(name)
         if self._first_submit_at is None:
             self._first_submit_at = self.clock.now()
@@ -167,8 +189,13 @@ class ReplayHarness:
                    if self.store.get_job(n) and self.store.get_job(n).finish_time < 1e300),
                   default=self.clock.now())
         makespan = max(1e-9, end - start)
-        capacity = self.backend.total_chips() * makespan
+        # Capacity integrates fleet changes (spot preemption shrinks the
+        # denominator for exactly the window the chips were gone).
+        capacity = self.backend.capacity_chip_seconds(start, end)
         util = self.backend.busy_chip_seconds / capacity if capacity > 0 else 0.0
+        attainable = self._attainable_chip_seconds
+        attainable_util = (self.backend.busy_chip_seconds / attainable
+                           if attainable > 0 else 0.0)
 
         return ReplayReport(
             algorithm=self.algorithm,
@@ -182,6 +209,7 @@ class ReplayHarness:
                              if len(jcts) >= 20 else (max(jcts) if jcts else 0.0)),
             avg_wait_seconds=statistics.mean(waits) if waits else 0.0,
             chip_utilization=util,
+            attainable_utilization=min(1.0, attainable_util),
             total_chips=self.backend.total_chips(),
             restarts_total=self.backend.restarts_total,
             rescheds_total=self.scheduler.m_resched_total.value(),

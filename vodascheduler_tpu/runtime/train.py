@@ -1,0 +1,160 @@
+"""GSPMD training core: sharded state init + jitted train step.
+
+The scaling-book recipe, executed: plan a mesh for the chip count
+(parallel/mesh.py), derive every array's sharding from path rules
+(parallel/sharding.py — the same rules shard params, Adam moments, and
+batches), jit one train step with those shardings and let XLA insert the
+collectives (psum/reduce-scatter/all-gather ride the mesh axes). No
+pmap, no manual collectives in the loss path; ring attention (shard_map)
+slots in only when the mesh has a real `sp` axis.
+
+Elasticity contract: everything here is a pure function of (bundle,
+num_chips) — resizing a job rebuilds TrainSession at the new count and
+restores the checkpoint with resharding (checkpoint.py), exactly the
+restart-with-reshard design SURVEY.md §7 calls for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from vodascheduler_tpu.models.registry import ModelBundle
+from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
+from vodascheduler_tpu.parallel.ring_attention import make_ring_attention
+from vodascheduler_tpu.parallel.sharding import (
+    batch_sharding,
+    param_shardings,
+)
+
+
+@dataclasses.dataclass
+class TrainSetup:
+    """Everything needed to run sharded steps for (bundle, mesh)."""
+
+    mesh: Any
+    plan: MeshPlan
+    state_shardings: Any
+    batch_shardings: Any
+    init_fn: Callable[[jax.Array], Any]          # rng -> sharded state
+    train_step: Callable[[Any, Any], Tuple[Any, jax.Array]]
+    make_batch: Callable[[int, jax.Array], Any]  # sharded synthetic batch
+    eval_shape_state: Any
+
+
+def make_train_setup(bundle: ModelBundle, num_chips: int,
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     learning_rate: float = 1e-3,
+                     plan: Optional[MeshPlan] = None,
+                     global_batch_size: int = 8) -> TrainSetup:
+    devices = list(devices if devices is not None else jax.devices())[:num_chips]
+    if plan is None:
+        plan = plan_mesh(num_chips, model_params_b=bundle.params_b,
+                         seq_len=bundle.seq_len,
+                         num_experts=bundle.num_experts)
+    mesh = build_mesh(plan, devices)
+    module = bundle.module
+
+    # Long-context models get ring attention when the mesh has an sp axis.
+    attn_fn = None
+    if plan.sp > 1 and hasattr(module, "attn_fn"):
+        attn_fn = make_ring_attention(mesh, causal=True)
+        module = type(module)(module.cfg, attn_fn=attn_fn)  # type: ignore
+
+    optimizer = optax.adamw(learning_rate)
+    sample_rng = jax.random.PRNGKey(0)
+    sample_batch = jax.eval_shape(
+        functools.partial(bundle.make_batch, global_batch_size), sample_rng)
+    model_input_key = "images" if "images" in sample_batch else "inputs"
+
+    # Non-trainable collections (BatchNorm running stats) ride in the state
+    # pytree untouched by the optimizer; BatchNorm models run on their
+    # init-time stats in synthetic-benchmark mode (see resnet.py).
+    if bundle.has_batch_stats:
+        def apply_fn_extra(params, extra, x):
+            return module.apply({"params": params, **extra}, x, train=False)
+    else:
+        def apply_fn_extra(params, extra, x):
+            return module.apply({"params": params}, x)
+
+    def init_state(rng) -> Dict[str, Any]:
+        batch = bundle.make_batch(global_batch_size, rng)
+        variables = module.init(rng, batch[model_input_key])
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
+        return {"params": params, "extra": extra,
+                "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), dtype=jnp.int32)}
+
+    def train_step(state, batch):
+        def loss_fn(params):
+            return bundle.loss_fn(
+                lambda p, x: apply_fn_extra(p, state["extra"], x), params, batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "extra": state["extra"],
+                "opt_state": opt_state,
+                "step": state["step"] + 1}, loss
+
+    # Shardings: the same path rules cover params AND the optimizer moments
+    # (their tree paths embed the param path), scalars replicate.
+    state_shapes = jax.eval_shape(init_state, sample_rng)
+    state_shardings = param_shardings(state_shapes, mesh, bundle.rules)
+    b_shard = batch_sharding(mesh)
+    b_shard_seq = batch_sharding(mesh, seq_axis="sp")
+    batch_shardings = jax.tree.map(
+        lambda leaf: b_shard_seq if (plan.sp > 1 and len(leaf.shape) == 2)
+        else b_shard, sample_batch)
+
+    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+    step_jit = jax.jit(train_step,
+                       in_shardings=(state_shardings, batch_shardings),
+                       out_shardings=(state_shardings, None),
+                       donate_argnums=0)
+
+    def make_batch(batch_size: int, rng: jax.Array):
+        batch = bundle.make_batch(batch_size, rng)
+        return jax.device_put(batch, batch_shardings)
+
+    return TrainSetup(mesh=mesh, plan=plan, state_shardings=state_shardings,
+                      batch_shardings=batch_shardings, init_fn=init_jit,
+                      train_step=step_jit, make_batch=make_batch,
+                      eval_shape_state=state_shapes)
+
+
+class TrainSession:
+    """A live training session at a fixed chip count."""
+
+    def __init__(self, bundle: ModelBundle, num_chips: int,
+                 global_batch_size: int = 8, seed: int = 0,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 plan: Optional[MeshPlan] = None):
+        self.bundle = bundle
+        self.num_chips = num_chips
+        self.global_batch_size = global_batch_size
+        self.setup = make_train_setup(bundle, num_chips, devices=devices,
+                                      plan=plan,
+                                      global_batch_size=global_batch_size)
+        self.rng = jax.random.PRNGKey(seed)
+        self.state = self.setup.init_fn(jax.random.PRNGKey(seed))
+
+    @property
+    def step(self) -> int:
+        return int(self.state["step"])
+
+    def run_steps(self, n: int) -> float:
+        """Run n steps; returns the last loss."""
+        loss = jnp.zeros(())
+        for _ in range(n):
+            self.rng, sub = jax.random.split(self.rng)
+            batch = self.setup.make_batch(self.global_batch_size, sub)
+            self.state, loss = self.setup.train_step(self.state, batch)
+        return float(loss)

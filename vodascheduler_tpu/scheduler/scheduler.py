@@ -72,6 +72,7 @@ class Scheduler:
         registry: Optional[Registry] = None,
         scale_out_hysteresis: float = 1.0,
         resize_cooldown_seconds: float = 120.0,
+        defrag_cross_host_threshold: int = 0,
     ):
         self.pool_id = pool_id
         self.backend = backend
@@ -92,6 +93,12 @@ class Scheduler:
         # cheap).
         self.scale_out_hysteresis = scale_out_hysteresis
         self.resize_cooldown_seconds = resize_cooldown_seconds
+        # Incremental placement fragments over time; when more than this
+        # many jobs span hosts, the next pass runs the full repack +
+        # Hungarian consolidation (placement.defragment) and pays its
+        # migrations. 0 disables defragmentation.
+        self.defrag_cross_host_threshold = defrag_cross_host_threshold
+        self._last_cross_host = 0
         self._last_resize_at: Dict[str, float] = {}
         # Jobs needing re-placement after host churn even if their chip
         # count is unchanged (e.g. their host died).
@@ -369,8 +376,13 @@ class Scheduler:
         placements: Dict[str, List[Tuple[str, int]]] = {}
         placed = False
         if (changed or self._placement_dirty) and self.placement_manager is not None:
-            decision = self.placement_manager.place(
-                {j: n for j, n in self.job_num_chips.items() if n > 0})
+            requests = {j: n for j, n in self.job_num_chips.items() if n > 0}
+            if (self.defrag_cross_host_threshold > 0
+                    and self._last_cross_host >= self.defrag_cross_host_threshold):
+                decision = self.placement_manager.defragment(requests)
+            else:
+                decision = self.placement_manager.place(requests)
+            self._last_cross_host = decision.num_jobs_cross_host
             placements = decision.placements
             placed = True
             self._placement_dirty = False
