@@ -7,3 +7,10 @@ resharded restore -> continue (SURVEY.md §7), driven by the supervisor.
 """
 
 from vodascheduler_tpu.runtime.train import TrainSession, make_train_setup
+from vodascheduler_tpu.runtime.checkpoint import (
+    checkpoint_nbytes,
+    latest_step,
+    list_steps,
+    restore_checkpoint,
+    save_checkpoint,
+)

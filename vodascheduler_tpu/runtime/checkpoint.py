@@ -1,0 +1,125 @@
+"""Sharded checkpoint with reshard-on-restore: the TPU elasticity primitive.
+
+Reference counterpart: SURVEY.md §5.4 — the reference's resume is
+application-level (Keras `ModelCheckpoint` h5 + epoch recovered from the
+metrics CSV, examples/py/tensorflow2/callbacks.py:58-66), and live resize
+needs no checkpoint because Elastic Horovod keeps state in memory across
+ring re-forms. On TPU a slice-topology change restarts the JAX processes,
+so resize IS checkpoint-restart: save the GSPMD-sharded state, rebuild the
+mesh at the new chip count, and restore with each array laid out for the
+*new* sharding (Orbax reads shards directly into the new layout — no
+host-side gather of the full state).
+
+This makes elastic resize and migration the same mechanism, exactly the
+design SURVEY.md §7 calls for ("resize = restart-with-reshard").
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+STEP_DIR_RE = re.compile(r"^step_(\d{10})$")
+
+
+def _step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"step_{step:010d}")
+
+
+def list_steps(ckpt_dir: str) -> list:
+    """All checkpointed steps in ascending order."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = STEP_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(ckpt_dir, name)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = list_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, rng: jax.Array,
+                    keep_last: int = 2) -> int:
+    """Atomically save `{state, rng}` under ckpt_dir/step_<n>.
+
+    Orbax writes each array's shards from the devices that hold them and
+    commits via tmp-dir rename, so a crash mid-save never corrupts the
+    previous checkpoint (the crash-consistency the reference gets from
+    Mongo + k8s idempotency, SURVEY.md §7 hard part (d)).
+    """
+    step = int(state["step"])
+    path = _step_dir(ckpt_dir, step)
+    os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        if os.path.exists(path):
+            # Re-save of an existing step (e.g. preemption save right after
+            # restore): write beside it, then swap, so the old checkpoint
+            # survives a crash mid-save. The suffixed names never match
+            # STEP_DIR_RE, so a half-finished swap is invisible to restore.
+            tmp, old = path + ".new", path + ".old"
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.rmtree(old, ignore_errors=True)
+            ckptr.save(tmp, {"state": state, "rng": rng})
+            ckptr.wait_until_finished()  # save() is async in orbax >= 0.9
+            os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old)
+        else:
+            ckptr.save(path, {"state": state, "rng": rng})
+            ckptr.wait_until_finished()
+    # Retention: keep the newest `keep_last` steps.
+    steps = list_steps(ckpt_dir)
+    for old in steps[:-keep_last] if keep_last > 0 else []:
+        shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+    return step
+
+
+def _abstract_target(setup, rng_like: jax.Array) -> Any:
+    """Shape/dtype/sharding skeleton for restore: state laid out for the
+    (possibly different) mesh in `setup`, rng replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    state_abs = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        setup.eval_shape_state, setup.state_shardings)
+    rng_abs = jax.ShapeDtypeStruct(
+        rng_like.shape, rng_like.dtype,
+        sharding=NamedSharding(setup.mesh, PartitionSpec()))
+    return {"state": state_abs, "rng": rng_abs}
+
+
+def restore_checkpoint(ckpt_dir: str, setup,
+                       step: Optional[int] = None) -> Tuple[Any, jax.Array]:
+    """Restore (state, rng), resharding every array onto `setup`'s mesh.
+
+    `setup` may be built for a different chip count than the checkpoint
+    was saved from — that is the whole point.
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    path = _step_dir(ckpt_dir, step)
+    rng_like = jax.random.PRNGKey(0)
+    target = _abstract_target(setup, rng_like)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, target)
+    return restored["state"], restored["rng"]
+
+
+def checkpoint_nbytes(state: Any) -> int:
+    """Total checkpoint payload size — drives restart-cost modeling."""
+    return sum(int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(state))

@@ -136,7 +136,7 @@ class TrainSession:
     def __init__(self, bundle: ModelBundle, num_chips: int,
                  global_batch_size: int = 8, seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 plan: Optional[MeshPlan] = None):
+                 plan: Optional[MeshPlan] = None, init: bool = True):
         self.bundle = bundle
         self.num_chips = num_chips
         self.global_batch_size = global_batch_size
@@ -144,17 +144,49 @@ class TrainSession:
                                       plan=plan,
                                       global_batch_size=global_batch_size)
         self.rng = jax.random.PRNGKey(seed)
-        self.state = self.setup.init_fn(jax.random.PRNGKey(seed))
+        self.state = self.setup.init_fn(jax.random.PRNGKey(seed)) if init \
+            else None
 
     @property
     def step(self) -> int:
+        self._require_state()
         return int(self.state["step"])
+
+    def _require_state(self) -> None:
+        if self.state is None:
+            raise RuntimeError(
+                "TrainSession has no state: constructed with init=False — "
+                "restore a checkpoint (TrainSession.resume) first")
 
     def run_steps(self, n: int) -> float:
         """Run n steps; returns the last loss."""
+        self._require_state()
         loss = jnp.zeros(())
         for _ in range(n):
             self.rng, sub = jax.random.split(self.rng)
             batch = self.setup.make_batch(self.global_batch_size, sub)
             self.state, loss = self.setup.train_step(self.state, batch)
         return float(loss)
+
+    def save(self, ckpt_dir: str, keep_last: int = 2) -> int:
+        """Checkpoint current (state, rng); returns the saved step."""
+        self._require_state()
+        from vodascheduler_tpu.runtime import checkpoint as ckpt
+        return ckpt.save_checkpoint(ckpt_dir, self.state, self.rng,
+                                    keep_last=keep_last)
+
+    @classmethod
+    def resume(cls, bundle: ModelBundle, num_chips: int, ckpt_dir: str,
+               global_batch_size: int = 8,
+               devices: Optional[Sequence[jax.Device]] = None,
+               plan: Optional[MeshPlan] = None,
+               step: Optional[int] = None) -> "TrainSession":
+        """Rebuild a session at a (possibly different) chip count from a
+        checkpoint — the elastic-resize restore path (SURVEY.md §7:
+        resize = restart-with-reshard)."""
+        from vodascheduler_tpu.runtime import checkpoint as ckpt
+        session = cls(bundle, num_chips, global_batch_size=global_batch_size,
+                      devices=devices, plan=plan, init=False)
+        session.state, session.rng = ckpt.restore_checkpoint(
+            ckpt_dir, session.setup, step=step)
+        return session
