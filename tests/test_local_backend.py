@@ -1,0 +1,97 @@
+"""LocalBackend e2e: real supervisor subprocesses training under backend
+control — completion events, elastic checkpoint-restart resize, and the
+metrics CSV contract (the live slice of SURVEY.md §7 stage 2).
+
+These spawn real Python subprocesses (each imports jax on a virtual CPU
+mesh), so they are the slowest tests in the suite; workloads are tiny.
+"""
+
+import os
+import time
+
+import pytest
+
+from vodascheduler_tpu.cluster.backend import ClusterEvent, ClusterEventKind
+from vodascheduler_tpu.cluster.local import LocalBackend
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.metricscollector.csv_logger import read_epoch_csv
+from vodascheduler_tpu.runtime.checkpoint import latest_step
+
+TIMEOUT = 180.0
+
+
+def _wait(predicate, timeout=TIMEOUT, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _spec(name, epochs=2, steps=3):
+    return JobSpec(name=name, model="mnist_mlp", global_batch_size=8,
+                   steps_per_epoch=steps,
+                   config=JobConfig(min_num_chips=1, max_num_chips=4,
+                                    epochs=epochs))
+
+
+@pytest.fixture
+def backend(tmp_path):
+    b = LocalBackend(str(tmp_path), hermetic_devices=2,
+                     stop_grace_seconds=60.0)
+    yield b
+    b.close()
+
+
+def test_job_runs_to_completion(backend, tmp_path):
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-a"), num_workers=2)
+    assert "job-a" in backend.running_jobs()
+
+    assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                             for e in events)), \
+        open(tmp_path / "job-a" / "supervisor.log").read()
+    assert backend.running_jobs() == {}
+
+    rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-a.csv"))
+    assert [int(r["epoch"]) for r in rows] == [0, 1]
+    assert all(int(r["workers"]) == 2 for r in rows)
+    assert latest_step(str(tmp_path / "job-a" / "ckpt")) == 6  # 2 epochs x 3
+
+
+def test_scale_restarts_with_checkpoint(backend, tmp_path):
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-b", epochs=4, steps=5), num_workers=2)
+
+    ckpt_dir = str(tmp_path / "job-b" / "ckpt")
+    # Wait for the first epoch checkpoint, then resize 2 -> 4.
+    assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+        open(tmp_path / "job-b" / "supervisor.log").read()
+    saved = latest_step(ckpt_dir)
+    backend.scale_job("job-b", 4)
+
+    assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                             for e in events)), \
+        open(tmp_path / "job-b" / "supervisor.log").read()
+    assert latest_step(ckpt_dir) == 20  # progress preserved across restart
+    assert saved <= 20
+    rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-b.csv"))
+    workers_seen = {int(r["workers"]) for r in rows}
+    assert 4 in workers_seen  # finished at the new size
+
+
+def test_stop_preserves_checkpoint_and_no_failure_event(backend, tmp_path):
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-c", epochs=50, steps=5), num_workers=2)
+    ckpt_dir = str(tmp_path / "job-c" / "ckpt")
+    assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+        open(tmp_path / "job-c" / "supervisor.log").read()
+    backend.stop_job("job-c")
+    assert backend.running_jobs() == {}
+    assert latest_step(ckpt_dir) is not None
+    time.sleep(1.0)
+    assert not any(e.kind == ClusterEventKind.JOB_FAILED for e in events)

@@ -1,0 +1,101 @@
+"""C++ kernel parity: native Hungarian + FfDL DP must match the pure
+Python implementations exactly (the Python versions are the oracles;
+SURVEY.md §2.9 native-code obligation)."""
+
+import random
+
+import pytest
+
+from vodascheduler_tpu import native
+from vodascheduler_tpu.placement import hungarian
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native kernels unavailable (no g++)")
+
+
+def _py_solve_max(score):
+    cost = [[-float(v) for v in row] for row in score]
+    cols = hungarian._solve_min(cost)
+    return [(r, c) for r, c in enumerate(cols)]
+
+
+def _score(assignment, score):
+    return sum(score[r][c] for r, c in assignment)
+
+
+def test_hungarian_parity_random():
+    rng = random.Random(7)
+    for n in (1, 2, 3, 5, 8, 16, 33):
+        score = [[rng.uniform(0, 100) for _ in range(n)] for _ in range(n)]
+        nat = native.hungarian_max(score)
+        py = _py_solve_max(score)
+        # Optimal assignments can differ; optimal *values* cannot.
+        assert _score(nat, score) == pytest.approx(_score(py, score))
+        assert sorted(c for _, c in nat) == list(range(n))
+
+
+def test_hungarian_prefers_diagonal():
+    score = [[10, 0, 0], [0, 10, 0], [0, 0, 10]]
+    assert native.hungarian_max(score) == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_ffdl_dp_parity_with_python():
+    """Run FfDLOptimizer with and without the native kernel on identical
+    inputs; total throughput of the chosen allocation must match."""
+    import os
+
+    from tests.helpers import make_job
+    from vodascheduler_tpu.algorithms import new_algorithm
+    from vodascheduler_tpu.common.job import JobInfo
+
+    rng = random.Random(11)
+    jobs = []
+    for i in range(12):
+        lo = rng.choice([1, 1, 2])
+        hi = rng.choice([2, 4, 8])
+        if hi < lo:
+            hi = lo
+        job = make_job(f"j{i}", min_chips=lo, max_chips=hi,
+                       submit_time=float(i))
+        speedup = {0: 0.0}
+        for g in range(1, 65):
+            speedup[g] = g ** rng.uniform(0.6, 1.0)
+        job.info = JobInfo(name=job.name, speedup=speedup)
+        jobs.append(job)
+
+    algo = new_algorithm("FfDLOptimizer")
+    native_result = algo.schedule(jobs, 16)
+
+    os.environ["VODA_NO_NATIVE"] = "1"
+    try:
+        py_result = algo.schedule(jobs, 16)
+    finally:
+        del os.environ["VODA_NO_NATIVE"]
+
+    def total(result):
+        return sum(jobs[i].info.speedup_at(result[f"j{i}"]) for i in range(12))
+
+    assert total(native_result) == pytest.approx(total(py_result))
+    assert sum(native_result.values()) <= 16
+
+
+def test_native_speedup_on_large_pool():
+    """The point of the kernel: n=128 hosts assignment well under the
+    reference's 30 s resched rate limit, and faster than Python."""
+    import time
+
+    rng = random.Random(3)
+    n = 128
+    score = [[rng.uniform(0, 50) for _ in range(n)] for _ in range(n)]
+
+    t0 = time.monotonic()
+    nat = native.hungarian_max(score)
+    t_native = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    py = _py_solve_max(score)
+    t_python = time.monotonic() - t0
+
+    assert _score(nat, score) == pytest.approx(_score(py, score))
+    assert t_native < t_python
+    assert t_native < 1.0

@@ -1,0 +1,160 @@
+"""REST API + CLI + composed app: the reference's full service surface
+driven over HTTP (SURVEY.md §1: service :55587, scheduler :55588,
+allocator :55589; §3.1 submission path; cmd/ CLI).
+
+The app runs a real LocalBackend (supervisor subprocesses on a hermetic
+CPU mesh), so the submit->schedule->train->complete loop here is the
+genuine article, just tiny.
+"""
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+import yaml
+
+from vodascheduler_tpu import cli
+from vodascheduler_tpu.service.app import VodaApp
+
+TIMEOUT = 180.0
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return json.loads(r.read())
+
+
+def _req(url, method, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        return json.loads(r.read())
+
+
+def _wait(predicate, timeout=TIMEOUT, interval=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def app(tmp_path_factory):
+    workdir = tmp_path_factory.mktemp("voda")
+    app = VodaApp(workdir=str(workdir), hermetic_devices=2, chips=4,
+                  rate_limit_seconds=0.5, collector_interval_seconds=5.0,
+                  service_port=0, scheduler_port=0, allocator_port=0)
+    app.daemon.poll_seconds = 0.2
+    app.start()
+    yield app
+    app.stop()
+
+
+@pytest.fixture(scope="module")
+def urls(app):
+    return {
+        "service": f"http://127.0.0.1:{app.service_server.port}",
+        "scheduler": f"http://127.0.0.1:{app.scheduler_server.port}",
+        "allocator": f"http://127.0.0.1:{app.allocator_server.port}",
+    }
+
+
+def _submit(urls, base_name, epochs=1, steps=2):
+    spec = {"name": base_name, "model": "mnist_mlp", "global_batch_size": 8,
+            "steps_per_epoch": steps,
+            "config": {"min_num_chips": 1, "max_num_chips": 2,
+                       "epochs": epochs}}
+    return _req(f"{urls['service']}/training", "POST",
+                yaml.safe_dump(spec).encode())["name"]
+
+
+def test_submit_trains_and_completes(urls):
+    name = _submit(urls, "rest-e2e")
+    assert name.startswith("rest-e2e-")
+
+    def done():
+        rows = _get(f"{urls['scheduler']}/training")
+        return any(r["name"] == name and r["status"] == "Completed"
+                   for r in rows)
+
+    assert _wait(done), _get(f"{urls['scheduler']}/training")
+    jobs = _get(f"{urls['service']}/training")
+    assert any(j["name"] == name and j["status"] == "Completed"
+               for j in jobs)
+
+
+def test_scheduler_endpoints(urls):
+    out = _req(f"{urls['scheduler']}/algorithm", "PUT",
+               json.dumps({"algorithm": "ElasticTiresias"}).encode())
+    assert out["algorithm"] == "ElasticTiresias"
+    with pytest.raises(urllib.error.HTTPError):
+        _req(f"{urls['scheduler']}/algorithm", "PUT", b'"NoSuchAlgo"')
+    out = _req(f"{urls['scheduler']}/ratelimit", "PUT", b"0.5")
+    assert out["seconds"] == 0.5
+    _req(f"{urls['scheduler']}/algorithm", "PUT", b'"ElasticFIFO"')
+
+
+def test_metrics_exposition(urls):
+    for server in ("service", "scheduler", "allocator"):
+        with urllib.request.urlopen(f"{urls[server]}/metrics",
+                                    timeout=10.0) as r:
+            text = r.read().decode()
+        assert "# TYPE" in text
+    # scheduler series catalog (reference: doc/prometheus-metrics-exposed.md)
+    with urllib.request.urlopen(f"{urls['scheduler']}/metrics",
+                                timeout=10.0) as r:
+        text = r.read().decode()
+    assert "voda_scheduler_total_chips 4" in text
+
+
+def test_allocation_endpoint_stateless(urls):
+    out = _req(f"{urls['allocator']}/allocation", "POST", json.dumps({
+        "scheduler_id": "t", "num_chips": 4, "algorithm": "ElasticFIFO",
+        "ready_jobs": [],
+    }).encode())
+    assert out == {}
+
+
+def test_delete_unknown_job_is_400(urls):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{urls['service']}/training?name=nope", "DELETE")
+    assert e.value.code == 400
+
+
+def test_cli_flow(urls, tmp_path):
+    spec_file = tmp_path / "job.yaml"
+    spec_file.write_text(yaml.safe_dump({
+        "name": "cli-job", "model": "mnist_mlp", "global_batch_size": 8,
+        "steps_per_epoch": 2,
+        "config": {"min_num_chips": 1, "max_num_chips": 2, "epochs": 1}}))
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["--server", urls["service"],
+                  "--scheduler-server", urls["scheduler"],
+                  "create", "-f", str(spec_file)])
+    assert "job created: cli-job-" in buf.getvalue()
+    name = buf.getvalue().strip().split(": ")[1]
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["--server", urls["service"],
+                  "--scheduler-server", urls["scheduler"], "get", "jobs"])
+    assert name in buf.getvalue()
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["--server", urls["service"],
+                  "--scheduler-server", urls["scheduler"], "get", "status"])
+    assert "CHIPS" in buf.getvalue()
+
+    def done():
+        rows = _get(f"{urls['scheduler']}/training")
+        return any(r["name"] == name and r["status"] == "Completed"
+                   for r in rows)
+    assert _wait(done)

@@ -48,6 +48,13 @@ class FfDLOptimizer(SchedulerAlgorithm):
         feasible = ordered[:K]  # FIFO trim (ffdl_optimizer.go:53-63)
         J = len(feasible)
 
+        native_alloc = self._native_dp(feasible, K)
+        if native_alloc is not None:
+            for job, g in zip(feasible, native_alloc):
+                result[job.name] = g
+            validate_result(total_chips, result, jobs)
+            return result
+
         # P[j][k]: best Σ speedup giving k chips to the first j jobs.
         P = [[0.0] * (K + 1) for _ in range(J + 1)]
         SOL = [[0] * (K + 1) for _ in range(J + 1)]
@@ -72,6 +79,19 @@ class FfDLOptimizer(SchedulerAlgorithm):
 
         validate_result(total_chips, result, jobs)
         return result
+
+    @staticmethod
+    def _native_dp(feasible: List[TrainingJob], K: int):
+        """C++ DP kernel (native/voda_native.cc); None -> Python fallback."""
+        from vodascheduler_tpu import native
+
+        lo = [j.config.min_num_chips for j in feasible]
+        hi = [j.config.max_num_chips for j in feasible]
+        speedup_rows = []
+        for job in feasible:
+            info = job.info or JobInfo()
+            speedup_rows.append([info.speedup_at(g) for g in range(K + 1)])
+        return native.ffdl_dp(K, lo, hi, speedup_rows)
 
     @property
     def needs_job_info(self) -> bool:

@@ -9,8 +9,9 @@ interface:
   VirtualClock — the testing substrate the reference never finished
   (SURVEY.md §4: fake clientsets in an empty test stub), and the engine of
   trace replay.
-- `local.LocalClusterBackend`: real JAX trainer processes on the local
-  machine's TPU chips.
+- `local.LocalBackend`: real JAX trainer processes (runtime/supervisor.py)
+  on the local machine's TPU chips.
 """
 
 from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent
+from vodascheduler_tpu.cluster.local import LocalBackend

@@ -203,3 +203,8 @@ class FileJobStore(JobStore):
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+
+
+# Public serialization aliases (REST allocator wire format, rest.py).
+job_to_dict = _job_to_dict
+job_from_dict = _job_from_dict

@@ -65,3 +65,9 @@ JOB_MAX_NUM_PROC = "max_num_chips"
 JOB_EPOCHS = "epochs"
 JOB_NAME = "job_name"
 JOB_PRIORITY = "priority"
+
+
+# Exit-code contract between the job supervisor (runtime/supervisor.py) and
+# cluster backends: a supervisor that checkpointed and exited on request
+# (resize/halt/migration) is not a failure.
+PREEMPTED_EXIT_CODE = 3

@@ -7,8 +7,8 @@ workers.
 
 Implementation: the O(n³) shortest-augmenting-path algorithm with dual
 potentials on the cost (minimization) form; maximization negates the
-matrix. A C++ kernel (placement/native) accelerates large pools; this pure
-Python version is the always-available fallback and the test oracle.
+matrix. The C++ kernel (native/voda_native.cc) accelerates large pools;
+this pure Python version is the always-available fallback and test oracle.
 """
 
 from __future__ import annotations
@@ -16,10 +16,7 @@ from __future__ import annotations
 import math
 from typing import List, Sequence, Tuple
 
-try:
-    from vodascheduler_tpu.placement.native import hungarian_native  # type: ignore
-except Exception:  # native kernel not built — pure Python fallback
-    hungarian_native = None
+from vodascheduler_tpu import native
 
 
 def solve_max(score: Sequence[Sequence[float]]) -> List[Tuple[int, int]]:
@@ -33,8 +30,9 @@ def solve_max(score: Sequence[Sequence[float]]) -> List[Tuple[int, int]]:
     for row in score:
         if len(row) != n:
             raise ValueError("score matrix must be square")
-    if hungarian_native is not None:
-        return hungarian_native.solve_max(score)
+    result = native.hungarian_max(score)
+    if result is not None:
+        return result
     cost = [[-float(v) for v in row] for row in score]
     cols = _solve_min(cost)
     return [(r, c) for r, c in enumerate(cols)]

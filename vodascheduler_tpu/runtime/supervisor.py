@@ -1,0 +1,150 @@
+"""Per-job worker supervisor: the process that actually trains.
+
+Reference counterpart: the Elastic-Horovod worker launched by `horovodrun`
+inside an MPIJob (SURVEY.md §3.4 — examples/py/tensorflow2/
+tensorflow2_keras_mnist_elastic.py:75-195). TPU-native redesign:
+
+- One supervisor process per job (per host in multi-host mode); the GSPMD
+  mesh inside it replaces the Horovod ring. There is no in-place ring
+  re-form: a resize means the backend stops this process (SIGTERM ->
+  checkpoint -> exit) and starts a new one at the new chip count, which
+  restores with resharding (runtime/checkpoint.py).
+- Resume epoch comes from the training step in the checkpoint, not a CSV
+  replay (the reference recovers the epoch from its metrics CSV,
+  callbacks.py:58-66 — a workaround for h5 checkpoints carrying no step).
+- Per-epoch telemetry rows go to `<metrics_dir>/<job>.csv` with the
+  reference's columns (callbacks.py:104-154) for the metrics collector.
+
+Exit codes: 0 = training complete; PREEMPTED_EXIT_CODE = checkpointed and
+exited on request (resize/halt/migration); anything else = failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+# Chunk size between stop-flag checks: small enough that SIGTERM turns into
+# a checkpoint promptly, big enough to amortize dispatch overhead.
+STEPS_PER_CHUNK = 10
+
+
+def _configure_devices() -> None:
+    """Hermetic mode: VODA_FORCE_CPU_DEVICES=N gives this process an
+    N-device virtual CPU mesh (tests / machines without TPU). On real TPU
+    hardware leave it unset."""
+    n = os.environ.get("VODA_FORCE_CPU_DEVICES")
+    if n:
+        # Replace any inherited device-count flag: the backend's requested
+        # mesh size wins over whatever the parent shell exported.
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host: the backend issues a coordinator address (the TPU-native
+    replacement for the MPI hostfile + discovery script, SURVEY.md §2.3)."""
+    coord = os.environ.get("VODA_COORDINATOR_ADDRESS")
+    if coord:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["VODA_NUM_PROCESSES"]),
+            process_id=int(os.environ["VODA_PROCESS_ID"]))
+
+
+def run_job(workdir: str, num_chips: int,
+            metrics_dir: Optional[str] = None) -> int:
+    """Train the job described by `<workdir>/spec.json` at num_chips until
+    its epoch budget completes, checkpointing every epoch."""
+    _configure_devices()
+    _maybe_init_distributed()
+
+    import jax
+    from vodascheduler_tpu.common.job import JobSpec
+    from vodascheduler_tpu.metricscollector.csv_logger import EpochCsvLogger
+    from vodascheduler_tpu.models import get_model
+    from vodascheduler_tpu.runtime import latest_step
+    from vodascheduler_tpu.runtime.train import TrainSession
+
+    with open(os.path.join(workdir, "spec.json")) as f:
+        spec = JobSpec.from_dict(json.load(f))
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    metrics_dir = metrics_dir or os.path.join(workdir, "metrics")
+    bundle = get_model(spec.model)
+
+    stop_requested = {"flag": False}
+
+    def on_sigterm(signum, frame):
+        stop_requested["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    signal.signal(signal.SIGINT, on_sigterm)
+
+    devices = jax.devices()[:num_chips]
+    if len(devices) < num_chips:
+        print(f"supervisor: need {num_chips} devices, have {len(devices)}",
+              file=sys.stderr)
+        return 2
+
+    if latest_step(ckpt_dir) is not None:
+        session = TrainSession.resume(
+            bundle, num_chips, ckpt_dir, devices=devices,
+            global_batch_size=spec.global_batch_size)
+    else:
+        session = TrainSession(bundle, num_chips, devices=devices,
+                               global_batch_size=spec.global_batch_size)
+
+    steps_per_epoch = max(1, spec.steps_per_epoch)
+    total_steps = spec.config.epochs * steps_per_epoch
+    logger = EpochCsvLogger(metrics_dir, spec.name,
+                            total_epochs=spec.config.epochs,
+                            global_batch_size=spec.global_batch_size)
+    # Trust the checkpoint for position; the CSV may lag a crash.
+    logger.next_epoch = session.step // steps_per_epoch
+
+    while session.step < total_steps:
+        epoch_start = time.monotonic()
+        epoch_end_step = min(total_steps,
+                             (session.step // steps_per_epoch + 1)
+                             * steps_per_epoch)
+        steps_this_epoch = epoch_end_step - session.step
+        while session.step < epoch_end_step:
+            if stop_requested["flag"]:
+                session.save(ckpt_dir)
+                return PREEMPTED_EXIT_CODE
+            n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
+            session.run_steps(n)
+        epoch_time = time.monotonic() - epoch_start
+        logger.log_epoch(epoch_time_sec=epoch_time,
+                         step_time_sec=epoch_time / steps_this_epoch,
+                         workers=num_chips,
+                         start_time=str(time.time()))
+        session.save(ckpt_dir)
+
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--num-chips", type=int, required=True)
+    parser.add_argument("--metrics-dir", default=None)
+    args = parser.parse_args(argv)
+    return run_job(args.workdir, args.num_chips, metrics_dir=args.metrics_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -321,6 +321,34 @@ class Scheduler:
     def resched_pending(self) -> bool:
         return self._resched_pending
 
+    def pump(self) -> None:
+        """Real-time driver hook (service/daemon.py): run a pending resched
+        once the rate-limit window opens. Under a VirtualClock the clock's
+        timers do this; under the wall clock a daemon thread calls pump().
+        """
+        with self._lock:
+            due = (self._resched_pending and not self._in_resched
+                   and self.clock.now() >= self.resched_blocked_until)
+        if due:
+            self._run_resched_now()
+
+    def set_algorithm(self, name: str) -> None:
+        """Switch the scheduling algorithm at runtime and reschedule
+        (reference: PUT /algorithm, scheduler.go:1127-1155)."""
+        from vodascheduler_tpu.algorithms import new_algorithm
+        new_algorithm(name, self.pool_id)  # validate; raises on unknown
+        with self._lock:
+            self.algorithm = name
+        self.trigger_resched()
+
+    def set_rate_limit(self, seconds: float) -> None:
+        """Adjust the resched rate limit (reference: PUT /ratelimit,
+        scheduler.go:1157-1183)."""
+        if seconds < 0:
+            raise ValueError("rate limit must be >= 0")
+        with self._lock:
+            self.rate_limit_seconds = seconds
+
     def _run_resched_now(self) -> None:
         with self._lock:
             if not self._resched_pending or self._stopped:

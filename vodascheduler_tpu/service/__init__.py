@@ -6,3 +6,4 @@ pool's scheduler.
 """
 
 from vodascheduler_tpu.service.admission import AdmissionService
+from vodascheduler_tpu.service.daemon import SchedulerDaemon

@@ -1,0 +1,162 @@
+"""VodaApp: the whole control plane composed in one process.
+
+Reference counterpart: the Helm deployment (SURVEY.md §1) — training
+service, per-pool scheduler, resource allocator, and metrics-collector
+CronJob as separate pods wired by RabbitMQ/Mongo/kube-dns. Idiomatic
+single-binary redesign (SURVEY.md §2.3: "idiomatically: one process or
+lightweight services"): the same components with the same REST surface,
+composed in-process — the EventBus replaces RabbitMQ, the FileJobStore
+replaces Mongo, and each piece still stands alone for a split deployment
+(rest.RemoteAllocator, deploy/).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from vodascheduler_tpu import config
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.common.clock import Clock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import FileJobStore
+from vodascheduler_tpu.metricscollector.collector import (
+    CsvDirRowSource,
+    MetricsCollector,
+)
+from vodascheduler_tpu.scheduler.scheduler import Scheduler
+from vodascheduler_tpu.service.admission import AdmissionService
+from vodascheduler_tpu.service.daemon import SchedulerDaemon
+from vodascheduler_tpu.service.rest import (
+    make_allocator_server,
+    make_scheduler_server,
+    make_service_server,
+)
+
+log = logging.getLogger(__name__)
+
+
+class VodaApp:
+    def __init__(self, workdir: str = config.WORKDIR,
+                 pool: str = config.DEFAULT_POOL,
+                 algorithm: str = config.DEFAULT_ALGORITHM,
+                 backend: str = "local",
+                 hermetic_devices: Optional[int] = None,
+                 chips: Optional[int] = None,
+                 host: str = "127.0.0.1",
+                 service_port: int = config.SERVICE_PORT,
+                 scheduler_port: int = config.SCHEDULER_PORT,
+                 allocator_port: int = config.ALLOCATOR_PORT,
+                 rate_limit_seconds: float = 30.0,
+                 collector_interval_seconds: float = 60.0,
+                 resume: bool = False):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.clock = Clock()
+        self.store = FileJobStore(os.path.join(self.workdir, "state.json"))
+        self.bus = EventBus()
+        self.registry = Registry()
+
+        self.allocator = ResourceAllocator(self.store, registry=self.registry)
+
+        jobs_dir = os.path.join(self.workdir, "jobs")
+        if backend == "local":
+            from vodascheduler_tpu.cluster.local import LocalBackend
+            self.backend = LocalBackend(jobs_dir, chips=chips,
+                                        hermetic_devices=hermetic_devices)
+        else:
+            raise ValueError(f"unknown backend {backend!r} (the app serves "
+                             "real local training; simulation lives in replay/)")
+
+        self.scheduler = Scheduler(
+            pool_id=pool, backend=self.backend, store=self.store,
+            allocator=self.allocator, clock=self.clock, bus=self.bus,
+            algorithm=algorithm, rate_limit_seconds=rate_limit_seconds,
+            resume=resume, registry=self.registry)
+        self.admission = AdmissionService(self.store, self.bus, self.clock,
+                                          registry=self.registry)
+        self.collector = MetricsCollector(
+            self.store, CsvDirRowSource(self.backend.metrics_dir),
+            interval_seconds=collector_interval_seconds)
+        self.daemon = SchedulerDaemon(
+            [self.scheduler],
+            periodic=[(collector_interval_seconds, self._collect_and_resched)])
+
+        # Warm the native kernels off the resched hot path (first use would
+        # otherwise block a resched on a synchronous g++ build).
+        import threading
+
+        from vodascheduler_tpu import native
+        threading.Thread(target=native.get_lib, daemon=True).start()
+
+        self.service_server = make_service_server(
+            self.admission, self.registry, host=host, port=service_port)
+        self.scheduler_server = make_scheduler_server(
+            self.scheduler, self.registry, host=host, port=scheduler_port)
+        self.allocator_server = make_allocator_server(
+            self.allocator, self.registry, host=host, port=allocator_port)
+
+    def _collect_and_resched(self) -> None:
+        """Collector pass; fresh curves can change info-driven allocations
+        (reference: collector writes Mongo, next resched reads it §3.5)."""
+        if self.collector.collect_all() > 0:
+            self.scheduler.trigger_resched()
+
+    def start(self) -> None:
+        self.daemon.start()
+        self.service_server.start()
+        self.scheduler_server.start()
+        self.allocator_server.start()
+        log.info("voda up: service=:%d scheduler=:%d allocator=:%d workdir=%s",
+                 self.service_server.port, self.scheduler_server.port,
+                 self.allocator_server.port, self.workdir)
+
+    def stop(self) -> None:
+        self.service_server.stop()
+        self.scheduler_server.stop()
+        self.allocator_server.stop()
+        self.daemon.stop()
+        self.scheduler.stop()
+        if hasattr(self.backend, "close"):
+            self.backend.close()
+        self.store.flush()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="voda-server",
+        description="Run the full control plane (service+scheduler+allocator)")
+    parser.add_argument("--workdir", default=config.WORKDIR)
+    parser.add_argument("--pool", default=config.DEFAULT_POOL)
+    parser.add_argument("--algorithm", default=config.DEFAULT_ALGORITHM)
+    parser.add_argument("--hermetic-devices", type=int, default=None,
+                        help="give each job an N-device virtual CPU mesh "
+                             "(no TPU needed)")
+    parser.add_argument("--chips", type=int, default=None,
+                        help="pool capacity override")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--resume", action="store_true",
+                        help="reconstruct state from store + running jobs "
+                             "(reference: -resume flag)")
+    parser.add_argument("--collector-interval", type=float, default=60.0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    app = VodaApp(workdir=args.workdir, pool=args.pool,
+                  algorithm=args.algorithm,
+                  hermetic_devices=args.hermetic_devices, chips=args.chips,
+                  host=args.host, resume=args.resume,
+                  collector_interval_seconds=args.collector_interval)
+    app.start()
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        app.stop()
+    return 0

@@ -1,0 +1,234 @@
+"""REST API layer: reference-parity endpoints over stdlib http.server.
+
+Reference counterparts (SURVEY.md §1 layer map):
+- Training service :55587 — POST/DELETE/GET /training, GET /metrics
+  (pkg/service/service/service.go:31-36)
+- Scheduler :55588 — GET /training, PUT /algorithm, PUT /ratelimit,
+  GET /metrics (pkg/scheduler/scheduler/scheduler.go:256-261)
+- Resource allocator :55589 — POST /allocation, GET /metrics
+  (pkg/allocator/allocator/resource_allocator.go:41-44)
+
+Job specs are accepted as YAML or JSON (YAML is a superset); the reference
+accepts Kubernetes MPIJob YAML (handlers.go:142).
+
+`RemoteAllocator` is the scheduler-side client for a split deployment —
+the reference runs the allocator as a separate 2-replica microservice and
+the scheduler POSTs each resched (scheduler.go:377-430). In-process use
+(passing ResourceAllocator directly) remains the default.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import yaml
+
+from vodascheduler_tpu import config
+from vodascheduler_tpu.allocator import AllocationRequest, ResourceAllocator
+from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import job_from_dict, job_to_dict
+from vodascheduler_tpu.service.admission import AdmissionError, AdmissionService
+
+log = logging.getLogger(__name__)
+
+# route table: (method, path) -> fn(body_bytes, query_dict) -> (status, payload)
+# payload: dict/list (JSON), or (content_type, str) for raw text.
+Route = Callable[[bytes, Dict[str, list]], Tuple[int, object]]
+
+
+class RestServer:
+    """A route-table HTTP server on a background thread."""
+
+    def __init__(self, routes: Dict[Tuple[str, str], Route],
+                 host: str = "127.0.0.1", port: int = 0):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet; klog-level 5 noise
+                log.debug("%s - %s", self.address_string(), fmt % args)
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                fn = routes.get((method, parsed.path))
+                if fn is None:
+                    self._reply(404, {"error": f"no route {method} {parsed.path}"})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    status, payload = fn(body, parse_qs(parsed.query))
+                except (AdmissionError, KeyError, ValueError) as e:
+                    status, payload = 400, {"error": str(e)}
+                except Exception as e:
+                    log.exception("handler error")
+                    status, payload = 500, {"error": str(e)}
+                self._reply(status, payload)
+
+            def _reply(self, status: int, payload) -> None:
+                if (isinstance(payload, tuple) and len(payload) == 2
+                        and isinstance(payload[0], str)):
+                    ctype, text = payload
+                    data = text.encode()
+                else:
+                    ctype = "application/json"
+                    data = (json.dumps(payload) + "\n").encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def _metrics_route(registry: Registry) -> Route:
+    def metrics(body, query):
+        return 200, ("text/plain; version=0.0.4", registry.exposition())
+    return metrics
+
+
+def _job_name_from(body: bytes, query: Dict[str, list]) -> str:
+    if query.get("name"):
+        return query["name"][0]
+    if body:
+        data = yaml.safe_load(body)
+        if isinstance(data, str):
+            return data.strip()
+        if isinstance(data, dict) and "name" in data:
+            return str(data["name"])
+    raise ValueError("job name required (?name= or JSON body {name})")
+
+
+def make_service_server(admission: AdmissionService, registry: Registry,
+                        host: str = "0.0.0.0",
+                        port: int = config.SERVICE_PORT) -> RestServer:
+    """Training-service API (reference: service.go:31-36)."""
+
+    def create(body, query):
+        data = yaml.safe_load(body)
+        if not isinstance(data, dict):
+            raise ValueError("body must be a YAML/JSON job spec mapping")
+        spec = JobSpec.from_dict(data)
+        name = admission.create_training_job(spec)
+        return 200, {"name": name}
+
+    def delete(body, query):
+        name = _job_name_from(body, query)
+        admission.delete_training_job(name)
+        return 200, {"deleted": name}
+
+    def get_jobs(body, query):
+        jobs = admission.store.list_jobs()
+        return 200, [{
+            "name": j.name, "pool": j.pool, "status": j.status.value,
+            "priority": j.priority, "submit_time": j.submit_time,
+        } for j in sorted(jobs, key=lambda j: j.submit_time)]
+
+    return RestServer({
+        ("POST", "/training"): create,
+        ("DELETE", "/training"): delete,
+        ("GET", "/training"): get_jobs,
+        ("GET", "/metrics"): _metrics_route(registry),
+    }, host, port)
+
+
+def make_scheduler_server(scheduler, registry: Registry,
+                          host: str = "0.0.0.0",
+                          port: int = config.SCHEDULER_PORT) -> RestServer:
+    """Per-pool scheduler API (reference: scheduler.go:256-261)."""
+
+    def get_training(body, query):
+        return 200, scheduler.status_table()
+
+    def put_algorithm(body, query):
+        data = yaml.safe_load(body)
+        name = data["algorithm"] if isinstance(data, dict) else str(data).strip()
+        scheduler.set_algorithm(name)
+        return 200, {"algorithm": name}
+
+    def put_ratelimit(body, query):
+        data = yaml.safe_load(body)
+        seconds = float(data["seconds"] if isinstance(data, dict) else data)
+        scheduler.set_rate_limit(seconds)
+        return 200, {"seconds": seconds}
+
+    return RestServer({
+        ("GET", "/training"): get_training,
+        ("PUT", "/algorithm"): put_algorithm,
+        ("PUT", "/ratelimit"): put_ratelimit,
+        ("GET", "/metrics"): _metrics_route(registry),
+    }, host, port)
+
+
+def make_allocator_server(allocator: ResourceAllocator, registry: Registry,
+                          host: str = "0.0.0.0",
+                          port: int = config.ALLOCATOR_PORT) -> RestServer:
+    """Stateless allocation API (reference: resource_allocator.go:41-44)."""
+
+    def allocate(body, query):
+        data = json.loads(body)
+        request = AllocationRequest(
+            scheduler_id=data.get("scheduler_id", ""),
+            num_chips=int(data["num_chips"]),
+            algorithm=data.get("algorithm", config.DEFAULT_ALGORITHM),
+            ready_jobs=[job_from_dict(j) for j in data.get("ready_jobs", [])],
+        )
+        return 200, allocator.allocate(request)
+
+    return RestServer({
+        ("POST", "/allocation"): allocate,
+        ("GET", "/metrics"): _metrics_route(registry),
+    }, host, port)
+
+
+class RemoteAllocator:
+    """Scheduler-side client for a remote allocator service
+    (reference: getResourceAllocation, scheduler.go:377-430)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def allocate(self, request: AllocationRequest):
+        import urllib.request
+
+        payload = json.dumps({
+            "scheduler_id": request.scheduler_id,
+            "num_chips": request.num_chips,
+            "algorithm": request.algorithm,
+            "ready_jobs": [job_to_dict(j) for j in request.ready_jobs],
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/allocation", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return {k: int(v) for k, v in json.load(resp).items()}
