@@ -1,0 +1,34 @@
+# Reference counterpart: the repo-root Makefile (gen-scheduler + helm
+# install targets). TPU-native targets: test, bench, native kernels,
+# docker images, GKE apply.
+
+PY ?= python
+
+.PHONY: test test-fast bench native docker deploy-gke clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+# Build the C++ resched kernels explicitly (they also build lazily on
+# first use).
+native:
+	$(PY) -c "from vodascheduler_tpu import native; native.get_lib(); print('native kernels OK')"
+
+docker:
+	docker build -f deploy/docker/Dockerfile.controlplane -t voda-controlplane:latest .
+	docker build -f deploy/docker/Dockerfile.worker -t voda-worker:latest .
+
+deploy-gke:
+	kubectl apply -f deploy/gke/namespace.yaml
+	kubectl apply -f deploy/gke/rbac.yaml
+	kubectl apply -f deploy/gke/controlplane.yaml
+
+clean:
+	rm -rf build dist *.egg-info vodascheduler_tpu/native/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

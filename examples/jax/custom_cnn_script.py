@@ -1,0 +1,63 @@
+"""Bring-your-own-model script: a custom CNN the scheduler can run.
+
+The TPU-native counterpart of handing Voda an arbitrary Horovod training
+script (reference examples/py/pytorch/pytorch_mnist_elastic.py — a user
+workload Voda schedules without knowing its internals): define
+`get_model(spec) -> ModelBundle` here, point a job spec's `extra.script`
+at this file (see examples/jobs/custom-cnn.yaml), and the supervisor runs
+its elastic loop (checkpoint / resume / reshard / metrics CSV) around
+your model, data, and loss.
+
+`spec.extra` is free-form user config — this script reads `width` from it.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SmallCnn(nn.Module):
+    """Two conv blocks + dense head, bfloat16 compute for the MXU."""
+
+    width: int = 32
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.bfloat16)
+        for mult in (1, 2):
+            x = nn.Conv(self.width * mult, (3, 3), dtype=jnp.bfloat16)(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=jnp.bfloat16)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.classes, dtype=jnp.float32)(x)
+
+
+def get_model(spec=None):
+    from vodascheduler_tpu.models.registry import ModelBundle
+    from vodascheduler_tpu.parallel.sharding import CONV_RULES
+
+    width = int((spec.extra.get("width", "32") if spec is not None else "32"))
+
+    def make_batch(batch_size: int, rng: jax.Array):
+        r1, r2 = jax.random.split(rng)
+        return {
+            "images": jax.random.normal(r1, (batch_size, 28, 28, 1),
+                                        dtype=jnp.float32),
+            "labels": jax.random.randint(r2, (batch_size,), 0, 10,
+                                         dtype=jnp.int32),
+        }
+
+    def loss_fn(apply_fn, params, batch):
+        logits = apply_fn(params, batch["images"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), batch["labels"]).mean()
+
+    return ModelBundle(name="custom_cnn", module=SmallCnn(width=width),
+                       make_batch=make_batch, loss_fn=loss_fn,
+                       rules=CONV_RULES)

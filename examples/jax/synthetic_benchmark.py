@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Synthetic throughput benchmark — examples/sec for any registry model.
+
+Reference counterpart: examples/py/tensorflow2/tensorflow2_synthetic_
+benchmark_elastic.py (the smoke workload in examples/test_yaml): random
+data, N warmup + M measured batches, prints img/sec and the scaling
+efficiency. Used both as a standalone probe of a slice and as the
+cheapest schedulable smoke job.
+
+Run:  python examples/jax/synthetic_benchmark.py --model resnet_tiny --num-chips 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# Runnable from a bare checkout: put the repo root on sys.path when the
+# package isn't installed.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet_tiny")
+    p.add_argument("--num-chips", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="global batch size")
+    p.add_argument("--num-warmup-batches", type=int, default=5)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args(argv)
+
+    from vodascheduler_tpu.runtime.supervisor import _configure_devices
+    _configure_devices()
+
+    import jax
+    import numpy as np
+
+    from vodascheduler_tpu.models import get_model
+    from vodascheduler_tpu.runtime.train import TrainSession
+
+    devices = jax.devices()[: args.num_chips]
+    if len(devices) < args.num_chips:
+        print(f"need {args.num_chips} devices, have {len(devices)}",
+              file=sys.stderr)
+        return 2
+
+    bundle = get_model(args.model)
+    session = TrainSession(bundle, args.num_chips, devices=devices,
+                           global_batch_size=args.batch_size)
+    active = {k: v for k, v in session.setup.plan.axis_sizes().items() if v > 1}
+    print(f"model: {args.model}, chips: {args.num_chips}, "
+          f"plan: {active or '{single chip}'}, "
+          f"global batch: {args.batch_size}")
+
+    session.run_steps(args.num_warmup_batches)  # compile + warmup
+
+    rates = []
+    for i in range(args.num_iters):
+        t0 = time.monotonic()
+        session.run_steps(args.num_batches_per_iter)
+        dt = time.monotonic() - t0
+        rate = args.num_batches_per_iter * args.batch_size / dt
+        rates.append(rate)
+        print(f"iter {i}: {rate:.1f} examples/sec")
+
+    mean = float(np.mean(rates))
+    print(f"total examples/sec on {args.num_chips} chips: {mean:.1f} "
+          f"(+/- {float(np.std(rates)):.1f}); "
+          f"per chip: {mean / args.num_chips:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

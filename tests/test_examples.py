@@ -1,0 +1,178 @@
+"""Examples layer: scripts run, resume elastically, and specs parse.
+
+Covers the gap the reference left untested (SURVEY.md §4: its examples
+are exercised only live) — here each example runs hermetically on a
+virtual CPU mesh.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(script, args, chips, timeout=240):
+    env = dict(os.environ)
+    env["VODA_FORCE_CPU_DEVICES"] = str(chips)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax", script)] + args,
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+class TestMnistExample:
+    def test_trains_then_resumes_at_new_chip_count(self, tmp_path):
+        wd = str(tmp_path / "mnist")
+        base = ["--workdir", wd, "--epochs", "1", "--steps-per-epoch", "4",
+                "--batch-size", "16"]
+        r = _run_example("mnist_mlp_elastic.py", base + ["--num-chips", "2"],
+                         chips=2)
+        assert r.returncode == 0, r.stderr
+        assert "training complete" in r.stdout
+        assert os.path.exists(os.path.join(wd, "ckpt"))
+        csv = os.path.join(wd, "metrics", "mnist-mlp-elastic.csv")
+        assert os.path.exists(csv)
+
+        # Elastic restart: more epochs at a different chip count resumes
+        # from the checkpoint instead of starting over.
+        r2 = _run_example("mnist_mlp_elastic.py",
+                          ["--workdir", wd, "--epochs", "2",
+                           "--steps-per-epoch", "4", "--batch-size", "16",
+                           "--num-chips", "4"], chips=4)
+        assert r2.returncode == 0, r2.stderr
+        assert "resumed at step 4" in r2.stdout
+
+
+@pytest.mark.slow
+class TestSyntheticBenchmark:
+    def test_prints_throughput(self):
+        r = _run_example("synthetic_benchmark.py",
+                         ["--model", "mnist_mlp", "--num-chips", "2",
+                          "--batch-size", "16", "--num-warmup-batches", "1",
+                          "--num-batches-per-iter", "2", "--num-iters", "1"],
+                         chips=2)
+        assert r.returncode == 0, r.stderr
+        assert "examples/sec on 2 chips" in r.stdout
+
+
+@pytest.mark.slow
+class TestTransformerExample:
+    def test_explicit_plan(self, tmp_path):
+        r = _run_example("transformer_lm_elastic.py",
+                         ["--workdir", str(tmp_path / "lm"), "--epochs", "1",
+                          "--steps-per-epoch", "2", "--batch-size", "4",
+                          "--num-chips", "4", "--plan", "dp2,tp2"], chips=4)
+        assert r.returncode == 0, r.stderr
+        assert "'dp': 2" in r.stdout and "'tp': 2" in r.stdout
+
+    def test_parse_plan(self):
+        import importlib.util
+        path = os.path.join(EXAMPLES, "jax", "transformer_lm_elastic.py")
+        spec = importlib.util.spec_from_file_location("tx_example", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        parse_plan = mod.parse_plan
+        plan = parse_plan("dp2,fsdp2,tp2")
+        assert (plan.dp, plan.fsdp, plan.tp) == (2, 2, 2)
+        assert parse_plan("auto") is None
+        with pytest.raises(ValueError):
+            parse_plan("xp3")
+        with pytest.raises(ValueError):
+            parse_plan("dp")
+
+
+@pytest.mark.slow
+class TestCustomScript:
+    def test_supervisor_runs_user_script(self, tmp_path):
+        """End-to-end: a job whose model comes from extra.script."""
+        from vodascheduler_tpu.common.job import JobConfig, JobSpec
+        from vodascheduler_tpu.runtime.supervisor import load_bundle
+
+        spec = JobSpec(
+            name="custom-cnn-test",
+            config=JobConfig(min_num_chips=1, max_num_chips=2, epochs=1),
+            model="custom", global_batch_size=8, steps_per_epoch=2,
+            extra={"script": os.path.join(EXAMPLES, "jax",
+                                          "custom_cnn_script.py"),
+                   "width": "8"})
+        bundle = load_bundle(spec)
+        assert bundle.name == "custom_cnn"
+        assert bundle.module.width == 8
+
+        import json
+        wd = tmp_path / "job"
+        wd.mkdir()
+        (wd / "spec.json").write_text(json.dumps(spec.to_dict()))
+        env = dict(os.environ)
+        env["VODA_FORCE_CPU_DEVICES"] = "2"
+        r = subprocess.run(
+            [sys.executable, "-m", "vodascheduler_tpu.runtime.supervisor",
+             "--workdir", str(wd), "--num-chips", "2"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr
+        assert (wd / "ckpt").exists()
+
+    def test_missing_get_model_rejected(self, tmp_path):
+        from vodascheduler_tpu.common.job import JobSpec
+        from vodascheduler_tpu.runtime.supervisor import load_bundle
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        with pytest.raises(AttributeError):
+            load_bundle(JobSpec(name="j", extra={"script": str(bad)}))
+
+
+@pytest.mark.slow
+class TestPreemption:
+    def test_sigterm_checkpoints_and_exits_preempted(self, tmp_path):
+        from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+        wd = str(tmp_path / "mnist")
+        env = dict(os.environ)
+        env["VODA_FORCE_CPU_DEVICES"] = "1"
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONUNBUFFERED"] = "1"
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(EXAMPLES, "jax", "mnist_mlp_elastic.py"),
+             "--workdir", wd, "--epochs", "50", "--steps-per-epoch", "200",
+             "--batch-size", "16", "--num-chips", "1"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        # Wait for the sentinel printed after the SIGTERM handler is
+        # installed (so the signal preempts instead of killing), then stop.
+        seen = []
+        for line in proc.stdout:
+            seen.append(line)
+            if "elastic run:" in line:
+                break
+        assert proc.poll() is None, "".join(seen)
+        time.sleep(1.0)  # let it enter run_steps
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+        assert proc.returncode == PREEMPTED_EXIT_CODE, "".join(seen) + out
+        assert "preempted" in out
+
+
+class TestJobSpecYamls:
+    def test_all_example_specs_parse(self):
+        from vodascheduler_tpu.common.job import JobSpec
+
+        found = []
+        for sub in ("jobs", "test_jobs"):
+            d = os.path.join(EXAMPLES, sub)
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".yaml"):
+                    with open(os.path.join(d, fn)) as f:
+                        spec = JobSpec.from_dict(yaml.safe_load(f))
+                    assert spec.config.min_num_chips <= spec.config.max_num_chips
+                    found.append(fn)
+        assert len(found) >= 6

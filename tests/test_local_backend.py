@@ -19,6 +19,8 @@ from vodascheduler_tpu.runtime.checkpoint import latest_step
 
 TIMEOUT = 180.0
 
+pytestmark = pytest.mark.slow
+
 
 def _wait(predicate, timeout=TIMEOUT, interval=0.2):
     deadline = time.monotonic() + timeout

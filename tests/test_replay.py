@@ -4,6 +4,8 @@ import json
 import subprocess
 import sys
 
+import pytest
+
 from vodascheduler_tpu.placement import PoolTopology
 from vodascheduler_tpu.replay import (
     ReplayHarness,
@@ -84,6 +86,7 @@ class TestReplay:
         assert report.completed == 8
 
 
+@pytest.mark.slow
 class TestBenchScript:
     def test_bench_prints_json_line(self):
         out = subprocess.run([sys.executable, "bench.py"], capture_output=True,

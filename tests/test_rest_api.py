@@ -18,6 +18,8 @@ import pytest
 import yaml
 
 from vodascheduler_tpu import cli
+
+pytestmark = pytest.mark.slow
 from vodascheduler_tpu.service.app import VodaApp
 
 TIMEOUT = 180.0

@@ -10,8 +10,20 @@ client-library dependency.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+
+@contextlib.contextmanager
+def timed(summary: "Summary", **labels: str):
+    """Observe the wall-clock duration of a block into a Summary."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        summary.observe(time.monotonic() - t0, **labels)
 
 
 class Counter:

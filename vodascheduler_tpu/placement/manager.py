@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from vodascheduler_tpu.common.metrics import Registry, timed
 from vodascheduler_tpu.placement import hungarian
 from vodascheduler_tpu.placement.state import HostSlots, HostState, JobPlacement
 from vodascheduler_tpu.placement.topology import PoolTopology
@@ -54,11 +55,29 @@ class PlacementManager:
     """Owns host/job placement state for one TPU pool."""
 
     def __init__(self, pool_id: str = "default",
-                 topology: Optional[PoolTopology] = None):
+                 topology: Optional[PoolTopology] = None,
+                 registry=None):
         self.pool_id = pool_id
         self.topology = topology
         self.host_states: Dict[str, HostState] = {}
         self.job_placements: Dict[str, JobPlacement] = {}
+        # Reference series: pkg/placement/metrics.go:11-50 (algo duration
+        # summary + migrated/deleted/cross-node gauges of the last pass).
+        if registry is None:
+            registry = Registry()
+        self.m_algo_duration = registry.summary(
+            "voda_placement_algo_duration_seconds",
+            "Placement pass duration", ("mode",))
+        self.m_workers_migrated = registry.gauge(
+            "voda_placement_workers_migrated",
+            "Workers that changed host in the last placement pass")
+        self.m_full_restarts = registry.gauge(
+            "voda_placement_full_restarts",
+            "Jobs whose entire worker set moved in the last pass "
+            "(reference: launchers deleted)")
+        self.m_jobs_cross_host = registry.gauge(
+            "voda_placement_jobs_cross_host",
+            "Jobs spanning more than one host after the last pass")
 
     # ---- host membership (reference: node informer handlers :174-304) ----
 
@@ -119,29 +138,40 @@ class PlacementManager:
         arise from host loss — or from an explicit defragment() pass, which
         is where the reference's full repack + Hungarian machinery lives
         on."""
-        old_worker_hosts = {job: self._expand_workers(p)
-                            for job, p in self.job_placements.items()}
+        with timed(self.m_algo_duration, mode="incremental"):
+            old_worker_hosts = {job: self._expand_workers(p)
+                                for job, p in self.job_placements.items()}
 
-        self._release_slots(job_requests)
-        cross, contiguity = self._place_incremental(job_requests)
-        return self._decision(old_worker_hosts, cross, contiguity)
+            self._release_slots(job_requests)
+            cross, contiguity = self._place_incremental(job_requests)
+            decision = self._decision(old_worker_hosts, cross, contiguity)
+        self._observe(decision)
+        return decision
 
     def defragment(self, job_requests: ScheduleResult) -> PlacementDecision:
         """Full repack + Hungarian stay-put relabeling (the reference's
         Place semantics, :306-332). Consolidates fragmentation at the cost
         of migrations; callers weigh that cost explicitly."""
-        old_worker_hosts = {job: self._expand_workers(p)
-                            for job, p in self.job_placements.items()}
+        with timed(self.m_algo_duration, mode="defragment"):
+            old_worker_hosts = {job: self._expand_workers(p)
+                                for job, p in self.job_placements.items()}
 
-        self._release_slots(job_requests)
-        # Empty logical hosts mirroring the physical fleet (:317-320).
-        logical = [HostState(name=f"TBD-{i}", total_slots=h.total_slots,
-                             coord=h.coord)
-                   for i, h in enumerate(self._hosts_sorted())]
-        cross, contiguity = self._best_fit(job_requests, logical)
-        self._bind_hosts(logical)
-        self._update_job_placements()
-        return self._decision(old_worker_hosts, cross, contiguity)
+            self._release_slots(job_requests)
+            # Empty logical hosts mirroring the physical fleet (:317-320).
+            logical = [HostState(name=f"TBD-{i}", total_slots=h.total_slots,
+                                 coord=h.coord)
+                       for i, h in enumerate(self._hosts_sorted())]
+            cross, contiguity = self._best_fit(job_requests, logical)
+            self._bind_hosts(logical)
+            self._update_job_placements()
+            decision = self._decision(old_worker_hosts, cross, contiguity)
+        self._observe(decision)
+        return decision
+
+    def _observe(self, decision: PlacementDecision) -> None:
+        self.m_workers_migrated.set(decision.workers_migrated)
+        self.m_full_restarts.set(len(decision.full_restarts))
+        self.m_jobs_cross_host.set(decision.num_jobs_cross_host)
 
     def _decision(self, old_worker_hosts: Dict[str, List[str]],
                   cross: int, contiguity: int) -> PlacementDecision:

@@ -64,6 +64,57 @@ def _maybe_init_distributed() -> None:
             process_id=int(os.environ["VODA_PROCESS_ID"]))
 
 
+def load_bundle(spec):
+    """Resolve the job's ModelBundle: a user script, or the registry.
+
+    `spec.extra["script"]` names a Python file defining `get_model(spec)`
+    (or argless `get_model()`) returning a ModelBundle — the TPU-native
+    counterpart of the reference's user-supplied Horovod training scripts
+    (examples/py/*): users bring their own model/data/loss, the framework
+    owns the elastic run loop around it.
+    """
+    script = spec.extra.get("script", "")
+    if not script:
+        from vodascheduler_tpu.models import get_model
+        return get_model(spec.model)
+
+    import importlib.util
+    import inspect
+
+    path = _resolve_script(script)
+    mod_name = "voda_user_script_" + os.path.splitext(os.path.basename(path))[0]
+    spec_obj = importlib.util.spec_from_file_location(mod_name, path)
+    if spec_obj is None or spec_obj.loader is None:
+        raise FileNotFoundError(f"user script not loadable: {path}")
+    module = importlib.util.module_from_spec(spec_obj)
+    sys.modules[mod_name] = module
+    spec_obj.loader.exec_module(module)
+    get = getattr(module, "get_model", None)
+    if get is None:
+        raise AttributeError(f"user script {path} must define get_model()")
+    if inspect.signature(get).parameters:
+        return get(spec)
+    return get()
+
+
+def _resolve_script(script: str) -> str:
+    """A relative script path is tried against the supervisor's cwd, then
+    the repo root (parent of the installed package) — so shipped example
+    specs work regardless of where the server was started."""
+    if os.path.isabs(script):
+        return script
+    candidates = [os.path.abspath(script)]
+    import vodascheduler_tpu
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.abspath(vodascheduler_tpu.__file__)))
+    candidates.append(os.path.join(pkg_parent, script))
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    raise FileNotFoundError(
+        f"user script {script!r} not found (tried: {candidates})")
+
+
 def run_job(workdir: str, num_chips: int,
             metrics_dir: Optional[str] = None) -> int:
     """Train the job described by `<workdir>/spec.json` at num_chips until
@@ -74,7 +125,6 @@ def run_job(workdir: str, num_chips: int,
     import jax
     from vodascheduler_tpu.common.job import JobSpec
     from vodascheduler_tpu.metricscollector.csv_logger import EpochCsvLogger
-    from vodascheduler_tpu.models import get_model
     from vodascheduler_tpu.runtime import latest_step
     from vodascheduler_tpu.runtime.train import TrainSession
 
@@ -83,7 +133,7 @@ def run_job(workdir: str, num_chips: int,
 
     ckpt_dir = os.path.join(workdir, "ckpt")
     metrics_dir = metrics_dir or os.path.join(workdir, "metrics")
-    bundle = get_model(spec.model)
+    bundle = load_bundle(spec)
 
     stop_requested = {"flag": False}
 

@@ -136,12 +136,13 @@ class TrainSession:
     def __init__(self, bundle: ModelBundle, num_chips: int,
                  global_batch_size: int = 8, seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 plan: Optional[MeshPlan] = None, init: bool = True):
+                 plan: Optional[MeshPlan] = None, init: bool = True,
+                 learning_rate: float = 1e-3):
         self.bundle = bundle
         self.num_chips = num_chips
         self.global_batch_size = global_batch_size
         self.setup = make_train_setup(bundle, num_chips, devices=devices,
-                                      plan=plan,
+                                      plan=plan, learning_rate=learning_rate,
                                       global_batch_size=global_batch_size)
         self.rng = jax.random.PRNGKey(seed)
         self.state = self.setup.init_fn(jax.random.PRNGKey(seed)) if init \
@@ -180,13 +181,17 @@ class TrainSession:
                global_batch_size: int = 8,
                devices: Optional[Sequence[jax.Device]] = None,
                plan: Optional[MeshPlan] = None,
-               step: Optional[int] = None) -> "TrainSession":
+               step: Optional[int] = None,
+               learning_rate: float = 1e-3) -> "TrainSession":
         """Rebuild a session at a (possibly different) chip count from a
         checkpoint — the elastic-resize restore path (SURVEY.md §7:
-        resize = restart-with-reshard)."""
+        resize = restart-with-reshard). `learning_rate` may differ from the
+        saved run's (e.g. linear scaling with the new chip count — the
+        reference rescales LR on every Horovod reset the same way)."""
         from vodascheduler_tpu.runtime import checkpoint as ckpt
         session = cls(bundle, num_chips, global_batch_size=global_batch_size,
-                      devices=devices, plan=plan, init=False)
+                      devices=devices, plan=plan, init=False,
+                      learning_rate=learning_rate)
         session.state, session.rng = ckpt.restore_checkpoint(
             ckpt_dir, session.setup, step=step)
         return session

@@ -166,6 +166,9 @@ class Scheduler:
             "voda_scheduler_jobs_created_total", "Jobs accepted")
         self.m_jobs_deleted = registry.counter(
             "voda_scheduler_jobs_deleted_total", "Jobs deleted by user")
+        self.m_job_restarts = registry.counter(
+            "voda_scheduler_job_restarts_total",
+            "Checkpoint-restart incarnations (start/scale/migration)")
         registry.gauge("voda_scheduler_ready_jobs",
                        "Jobs in the ready queue", fn=lambda: float(len(self.ready_jobs)))
         registry.gauge("voda_scheduler_running_jobs", "Jobs allocated chips",
@@ -514,6 +517,7 @@ class Scheduler:
         if job is None:
             return
         self.backend.start_job(job.spec, self.job_num_chips[name], placements)
+        self.m_job_restarts.inc()
         job.status = JobStatus.RUNNING
         job.metrics.last_chip_seconds = 0.0
         job.metrics.last_running_seconds = 0.0
@@ -530,6 +534,7 @@ class Scheduler:
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
         """Reference: scaleTrainingJob (scheduler.go:542-574)."""
         self.backend.scale_job(name, self.job_num_chips[name], placements)
+        self.m_job_restarts.inc()
         self._last_resize_at[name] = self.clock.now()
 
     def _halt_job(self, name: str) -> None:

@@ -22,7 +22,7 @@ from vodascheduler_tpu.common.job import (
     category_of,
     timestamped_name,
 )
-from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.metrics import Registry, timed
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import EventVerb, JobStatus
 
@@ -47,9 +47,19 @@ class AdmissionService:
             "voda_service_jobs_deleted_total", "Jobs deleted")
         self.m_errors = registry.counter(
             "voda_service_errors_total", "Admission errors")
+        self.m_create_duration = registry.summary(
+            "voda_service_create_duration_seconds",
+            "Job admission handler duration")
+        self.m_delete_duration = registry.summary(
+            "voda_service_delete_duration_seconds",
+            "Job deletion handler duration")
 
     def create_training_job(self, spec: JobSpec) -> str:
         """Admit a job; returns its timestamped name."""
+        with timed(self.m_create_duration):
+            return self._create_training_job(spec)
+
+    def _create_training_job(self, spec: JobSpec) -> str:
         now = self.clock.now()
         # Second-resolution timestamps collide when jobs arrive in the same
         # second (guaranteed in trace replay); bump until unique.
@@ -97,12 +107,13 @@ class AdmissionService:
         return name
 
     def delete_training_job(self, name: str) -> None:
-        job = self.store.get_job(name)
-        if job is None:
-            self.m_errors.inc()
-            raise AdmissionError(f"job {name} not found")
-        self.bus.publish(job.pool, JobEvent(EventVerb.DELETE, name))
-        self.m_deleted.inc()
+        with timed(self.m_delete_duration):
+            job = self.store.get_job(name)
+            if job is None:
+                self.m_errors.inc()
+                raise AdmissionError(f"job {name} not found")
+            self.bus.publish(job.pool, JobEvent(EventVerb.DELETE, name))
+            self.m_deleted.inc()
 
     def get_job(self, name: str) -> Optional[TrainingJob]:
         return self.store.get_job(name)

@@ -26,6 +26,7 @@ from vodascheduler_tpu.metricscollector.collector import (
     CsvDirRowSource,
     MetricsCollector,
 )
+from vodascheduler_tpu.placement import PlacementManager
 from vodascheduler_tpu.scheduler.scheduler import Scheduler
 from vodascheduler_tpu.service.admission import AdmissionService
 from vodascheduler_tpu.service.daemon import SchedulerDaemon
@@ -70,11 +71,14 @@ class VodaApp:
             raise ValueError(f"unknown backend {backend!r} (the app serves "
                              "real local training; simulation lives in replay/)")
 
+        self.placement = PlacementManager(pool_id=pool,
+                                          registry=self.registry)
         self.scheduler = Scheduler(
             pool_id=pool, backend=self.backend, store=self.store,
             allocator=self.allocator, clock=self.clock, bus=self.bus,
             algorithm=algorithm, rate_limit_seconds=rate_limit_seconds,
-            resume=resume, registry=self.registry)
+            resume=resume, registry=self.registry,
+            placement_manager=self.placement)
         self.admission = AdmissionService(self.store, self.bus, self.clock,
                                           registry=self.registry)
         self.collector = MetricsCollector(
