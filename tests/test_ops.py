@@ -1,0 +1,138 @@
+"""Pallas flash-attention kernel vs the O(S²) reference, values and grads.
+
+Runs the kernels in interpreter mode on the CPU test platform; the same
+code compiles on TPU (interpret auto-selects by backend).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vodascheduler_tpu.ops import flash_attention, make_flash_attention
+from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh
+from vodascheduler_tpu.parallel.ring_attention import reference_attention
+
+
+def _qkv(seed, B=2, S=128, H=2, D=64, dtype=jnp.float32):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return [jax.random.normal(k, (B, S, H, D), dtype) for k in keys]
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv(0)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+
+def test_forward_multiblock_vs_singleblock():
+    # Streaming over 4 K blocks must agree with one-shot (block == S).
+    q, k, v = _qkv(1, S=256, H=1)
+    tiled = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    whole = flash_attention(q, k, v, block_q=256, block_k=256,
+                            interpret=True)
+    np.testing.assert_allclose(tiled, whole, atol=3e-5, rtol=3e-5)
+
+
+def test_forward_bfloat16():
+    q, k, v = _qkv(2, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(out.astype(np.float32),
+                               ref.astype(np.float32), atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(3, S=64, D=32)
+    w = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=32,
+                                       block_k=32, interpret=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_block_size_auto_shrinks_to_divide():
+    # S=96 is not divisible by 128; _pick_block must fall back cleanly.
+    q, k, v = _qkv(4, S=96, H=1, D=32)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+
+def test_sharded_flash_attention_on_mesh():
+    mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2), jax.devices()[:8])
+    fn = make_flash_attention(mesh, interpret=True)
+    q, k, v = _qkv(5, B=4, S=64, H=4, D=32)
+    out = jax.jit(fn)(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5,
+                               rtol=3e-5)
+
+
+def test_attention_module_with_flash_kernel():
+    """The Attention module produces identical outputs with the kernel
+    swapped in as attn_fn (GQA repeat happens before the kernel)."""
+    from vodascheduler_tpu.models.layers import AttnConfig, Attention
+
+    cfg = AttnConfig(num_heads=4, num_kv_heads=2, head_dim=16, causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 32, 64))
+    flash_fn = lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                               interpret=True)
+    base = Attention(cfg)
+    withk = Attention(cfg, attn_fn=flash_fn)
+    params = base.init(jax.random.PRNGKey(7), x)
+    out_base = base.apply(params, x)
+    out_flash = withk.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_base),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_train_step_with_flash_attention(monkeypatch):
+    """Full sharded train step with the flash kernel wired in as attn_fn
+    (interpret mode on the CPU test platform)."""
+    import numpy as _np
+
+    monkeypatch.setenv("VODA_FLASH_ATTENTION", "1")
+    from vodascheduler_tpu.models import get_model
+    from vodascheduler_tpu.runtime import TrainSession
+
+    session = TrainSession(get_model("llama_tiny"), num_chips=4,
+                           global_batch_size=4,
+                           plan=MeshPlan(dp=2, tp=2),
+                           devices=jax.devices()[:4])
+    loss = session.run_steps(1)
+    assert _np.isfinite(loss)
+
+
+def test_mixtral_threads_attn_fn():
+    """Mixtral accepts an injected attention kernel and matches its own
+    XLA-path output (review finding: it used to drop attn_fn silently)."""
+    from vodascheduler_tpu.models.mixtral import MIXTRAL_TINY, Mixtral
+
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0,
+                                MIXTRAL_TINY.vocab_size)
+    flash_fn = lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                               interpret=True)
+    base = Mixtral(MIXTRAL_TINY)
+    withk = Mixtral(MIXTRAL_TINY, attn_fn=flash_fn)
+    params = base.init(jax.random.PRNGKey(1), tokens)
+    out_base = base.apply(params, tokens)
+    out_flash = withk.apply(params, tokens)
+    assert Mixtral.causal_attention
+    np.testing.assert_allclose(np.asarray(out_flash, dtype=np.float32),
+                               np.asarray(out_base, dtype=np.float32),
+                               atol=3e-2, rtol=3e-2)

@@ -54,6 +54,9 @@ class Llama(nn.Module):
     cfg: LlamaConfig
     attn_fn: Optional[Callable] = None
 
+    # Decoder LM: the runtime may inject a causal kernel (flash / ring)
+    causal_attention = True
+
     @nn.compact
     def __call__(self, tokens):
         """tokens [B, S] int32 -> logits [B, S, vocab]."""

@@ -12,7 +12,7 @@ all_to_all dispatch is the planned pallas upgrade for large expert counts.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -82,6 +82,7 @@ class MoEBlock(nn.Module):
 
 class MixtralBlock(nn.Module):
     cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x):
@@ -90,13 +91,18 @@ class MixtralBlock(nn.Module):
                               num_kv_heads=cfg.num_kv_heads,
                               head_dim=cfg.head_dim, causal=True,
                               rope_base=cfg.rope_base)
-        x = x + Attention(attn_cfg, name="attn")(RMSNorm(name="attn_norm")(x))
+        x = x + Attention(attn_cfg, attn_fn=self.attn_fn,
+                          name="attn")(RMSNorm(name="attn_norm")(x))
         x = x + MoEBlock(cfg, name="moe")(RMSNorm(name="moe_norm")(x))
         return x
 
 
 class Mixtral(nn.Module):
     cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
+
+    # Decoder LM: the runtime may inject a causal kernel (flash / ring)
+    causal_attention = True
 
     @nn.compact
     def __call__(self, tokens):
@@ -105,7 +111,7 @@ class Mixtral(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
                      param_dtype=jnp.float32, dtype=dtype)(tokens)
         for i in range(cfg.num_layers):
-            x = MixtralBlock(cfg, name=f"layer_{i}")(x)
+            x = MixtralBlock(cfg, attn_fn=self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
                         dtype=dtype, param_dtype=jnp.float32)(x)

@@ -1,0 +1,371 @@
+"""Flash attention as a Pallas TPU kernel, forward and backward.
+
+Streaming-softmax attention tiled for the MXU: scores/accumulators stay in
+VMEM in fp32, K/V blocks stream past each Q block on the innermost grid
+dimension, and the output is normalized once at flush time (one reciprocal
+per row instead of a rescale per block). The forward emits per-row
+logsumexp so the backward can recompute attention weights blockwise
+(FlashAttention-2 style) — no O(S²) materialization in either pass.
+
+Design notes (vs the generic XLA lowering of softmax attention):
+- all matmuls are [block_q, D] x [D, block_k] shapes with
+  `preferred_element_type=f32` → MXU with fp32 accumulation;
+- running max / denominator live in (block_q, 128) VMEM scratch (lane-
+  replicated, the native TPU vector layout for per-row scalars);
+- causal blocks strictly above the diagonal are predicated off with
+  `pl.when`, so ~half the work is skipped at block granularity;
+- backward splits into a dq kernel (streams K/V past each Q block) and a
+  dk/dv kernel (streams Q/dO past each K block), each recomputing p from
+  q·k and the saved logsumexp.
+
+Runs in interpreter mode off-TPU so the same code path is testable on the
+8-device CPU mesh (tests/test_ops.py).
+
+Reference parity: the reference's training plane is Horovod user scripts
+(SURVEY.md §2.3); this kernel belongs to the TPU-native training plane
+that replaces them (runtime/train.py wires it in as `attn_fn`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+NEG_INF = -1e30  # finite: avoids inf-inf NaNs in the running-max updates
+LANES = 128
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    """Largest block <= preferred that divides seq (power-of-2 descent)."""
+    b = min(preferred, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _bcast_lanes(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(rows, LANES) lane-replicated scalars -> (rows, n)."""
+    if n == LANES:
+        return x
+    if n < LANES:
+        return x[:, :n]
+    reps, rem = divmod(n, LANES)
+    if rem:
+        raise NotImplementedError(f"width {n} not a multiple of {LANES}")
+    return jnp.tile(x, (1, reps))
+
+
+def _causal_mask(s, i, j, block_q, block_k):
+    rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, sm_scale, causal, block_q, block_k, num_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    run = (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+
+        m_prev, l_prev = m_ref[...], l_ref[...]          # [bq, LANES]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _bcast_lanes(m_next, block_k))   # [bq, bk]
+        corr = jnp.exp(m_prev - m_next)                  # [bq, LANES]
+        m_ref[...] = m_next
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1)[:, None]
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[...] = (acc_ref[...] * _bcast_lanes(corr, acc_ref.shape[-1])
+                        + jnp.dot(p, v, preferred_element_type=jnp.float32))
+
+    @pl.when(j == num_k - 1)
+    def _flush():
+        l = l_ref[...]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0, 0] = (acc_ref[...]
+                       * _bcast_lanes(l_inv, acc_ref.shape[-1])
+                       ).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        lse_ref[0, 0] = m_ref[...] + jnp.log(safe_l)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    num_q, num_k = Sq // bq, Sk // bk
+    sm_scale = D ** -0.5
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, num_k=num_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running max
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, D), jnp.float32),       # unnormalized output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+               dq_acc, delta_ref, *, sm_scale, causal, block_q, block_k,
+               num_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        delta_ref[...] = jnp.sum(do * o, axis=1)[:, None] * jnp.ones(
+            (1, LANES), jnp.float32)
+
+    run = (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        lse = lse_ref[0, 0]                                  # [bq, LANES]
+        p = jnp.exp(s - _bcast_lanes(lse, block_k))          # [bq, bk]
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - _bcast_lanes(delta_ref[...], block_k)) * sm_scale
+        dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, sm_scale, causal, block_q, block_k,
+                num_q):
+    j, i = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, jnp.float32)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, jnp.float32)
+
+    run = (i + 1) * block_q - 1 >= j * block_k if causal else i >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        lse = lse_ref[0, 0]
+        p = jnp.exp(s - _bcast_lanes(lse, block_k))          # [bq, bk]
+        delta = jnp.sum(do * o, axis=1)[:, None]             # [bq, 1]
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - delta) * sm_scale                    # [bq, bk]
+        # dk += ds^T q ; dv += p^T do   (contract over the bq rows)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    num_q, num_k = Sq // bq, Sk // bk
+    sm_scale = D ** -0.5
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq, LANES),
+                            lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, num_k=num_k),
+        grid=(B, H, num_q, num_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                        pltpu.VMEM((bq, LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, o, lse)
+
+    # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
+    q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    lse_spec_t = pl.BlockSpec((1, 1, bq, LANES),
+                              lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, num_q=num_q),
+        grid=(B, H, num_k, num_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, q_spec_t,
+                  lse_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, o, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    # The kernel emits lse lane-replicated ([B,H,S,LANES], the native TPU
+    # layout for per-row scalars); keep only one lane as the AD residual —
+    # residuals are held across ALL layers during reverse-mode, so the
+    # 128x blowup would dominate activation memory at long seq.
+    return o, (q, k, v, o, lse[..., 0])
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    lse_full = jnp.broadcast_to(lse[..., None],
+                                (*lse.shape, LANES))  # transient, per-layer
+    return _bwd(q, k, v, o, lse_full, g, causal, block_q, block_k, interpret)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] arrays (model layout).
+
+    Heads must already be GQA-expanded (models/layers.py repeats KV heads
+    before calling `attn_fn`). Differentiable via the Pallas backward
+    kernels. `interpret=None` auto-selects interpreter mode off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    D = q.shape[-1]
+    if D > LANES and D % LANES:
+        raise NotImplementedError(
+            f"head_dim {D} > {LANES} must be a multiple of {LANES}")
+    qT = q.transpose(0, 2, 1, 3)  # [B,H,S,D]
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qT, kT, vT, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(mesh: Mesh,
+                         batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                         head_axis: str = "tp", causal: bool = True,
+                         interpret: Optional[bool] = None):
+    """Shard_map the kernel over a dp/fsdp x tp mesh as an `attn_fn`.
+
+    Batch shards over the data axes and heads over `tp`, matching the
+    activation shardings in parallel/sharding.py, so the kernel runs on
+    purely local blocks and GSPMD inserts no collectives around it. The
+    sequence axis stays local — a mesh with a real `sp` axis should use
+    ring attention (parallel/ring_attention.py) instead.
+    """
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch, None, head, None)
+
+    def local_fn(q, k, v):
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

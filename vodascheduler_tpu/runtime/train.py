@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +32,18 @@ from vodascheduler_tpu.parallel.sharding import (
     batch_sharding,
     param_shardings,
 )
+
+
+def _flash_attention_enabled() -> bool:
+    """Default: Pallas flash attention on TPU, XLA path elsewhere.
+    VODA_FLASH_ATTENTION=1 forces it on (interpreter mode off-TPU, for
+    tests); =0 forces the XLA path."""
+    flag = os.environ.get("VODA_FLASH_ATTENTION", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
 
 
 @dataclasses.dataclass
@@ -60,11 +73,28 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     mesh = build_mesh(plan, devices)
     module = bundle.module
 
-    # Long-context models get ring attention when the mesh has an sp axis.
+    # Attention kernel selection: long-context meshes (real sp axis) get
+    # ring attention; otherwise, on TPU, the Pallas flash kernel replaces
+    # the O(S²) XLA softmax path (ops/flash_attention.py). Both shard via
+    # shard_map with the same batch/head specs the GSPMD rules use.
     attn_fn = None
-    if plan.sp > 1 and hasattr(module, "attn_fn"):
-        attn_fn = make_ring_attention(mesh, causal=True)
-        module = type(module)(module.cfg, attn_fn=attn_fn)  # type: ignore
+    if hasattr(module, "attn_fn"):
+        # Modules exposing attn_fn declare their masking with the
+        # `causal_attention` class attribute — the injected kernel replaces
+        # the layer's own cfg.causal, so it must match.
+        causal = getattr(type(module), "causal_attention", None)
+        if causal is None:
+            raise TypeError(
+                f"{type(module).__name__} exposes attn_fn but not "
+                "`causal_attention`; declare it so kernel injection can't "
+                "silently change masking")
+        if plan.sp > 1:
+            attn_fn = make_ring_attention(mesh, causal=causal)
+        elif _flash_attention_enabled():
+            from vodascheduler_tpu.ops import make_flash_attention
+            attn_fn = make_flash_attention(mesh, causal=causal)
+        if attn_fn is not None:
+            module = type(module)(module.cfg, attn_fn=attn_fn)  # type: ignore
 
     optimizer = optax.adamw(learning_rate)
     sample_rng = jax.random.PRNGKey(0)
