@@ -136,3 +136,15 @@ def test_mixtral_threads_attn_fn():
     np.testing.assert_allclose(np.asarray(out_flash, dtype=np.float32),
                                np.asarray(out_base, dtype=np.float32),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_sharded_flash_falls_back_on_nondividing_shapes():
+    """Heads not divisible by tp must fall back to the XLA path at trace
+    time instead of failing shard_map's divisibility check."""
+    mesh = build_mesh(MeshPlan(dp=2, tp=4), jax.devices()[:8])
+    fn = make_flash_attention(mesh, interpret=True)
+    q, k, v = _qkv(8, B=4, S=32, H=3, D=16)  # 3 heads, tp=4
+    out = jax.jit(fn)(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5,
+                               rtol=3e-5)

@@ -53,7 +53,7 @@ class TestShardingRules:
         assert TRANSFORMER_RULES.spec_for("layer_0/attn/q_proj/kernel") == P("fsdp", "tp")
         assert TRANSFORMER_RULES.spec_for("layer_3/mlp/down_proj/kernel") == P("tp", "fsdp")
         assert TRANSFORMER_RULES.spec_for("layer_1/attn_norm/scale") == P()
-        assert TRANSFORMER_RULES.spec_for("embed/embedding") == P("tp", "fsdp")
+        assert TRANSFORMER_RULES.spec_for("embed/embedding") == P("fsdp", "tp")
 
     def test_fit_spec_drops_nondividing_axes(self):
         mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
@@ -89,3 +89,29 @@ class TestRingAttention:
         out = make_ring_attention(mesh, causal=True)(q, k, v)
         ref = reference_attention(q, k, v, causal=True)
         assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+class TestNoInvoluntaryResharding:
+    def test_dp_fsdp_tp_step_has_no_involuntary_remat(self):
+        """GSPMD must not fall back to full rematerialization anywhere in
+        the train step (regression: the embed table's old P(tp, fsdp)
+        sharding leaked feature sharding into the gather output)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "from vodascheduler_tpu.models import get_model\n"
+            "from vodascheduler_tpu.parallel.mesh import MeshPlan\n"
+            "from vodascheduler_tpu.runtime import TrainSession\n"
+            "s = TrainSession(get_model('llama_tiny'), num_chips=8,\n"
+            "                 global_batch_size=4,\n"
+            "                 plan=MeshPlan(dp=2, fsdp=2, tp=2),\n"
+            "                 devices=jax.devices()[:8])\n"
+            "s.run_steps(1)\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "Involuntary full rematerialization" not in proc.stderr, \
+            proc.stderr[-3000:]

@@ -8,6 +8,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from vodascheduler_tpu.models.layers import AttnConfig, EncoderBlock
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,7 +41,7 @@ class Bert(nn.Module):
         pos = nn.Embed(cfg.max_seq_len, cfg.dim, name="pos_embed",
                        param_dtype=jnp.float32, dtype=dtype)(
                            jnp.arange(S)[None, :].repeat(B, axis=0))
-        x = x + pos
+        x = constrain_batch_activation(x + pos)
         attn_cfg = AttnConfig(num_heads=cfg.num_heads,
                               num_kv_heads=cfg.num_heads,
                               head_dim=cfg.dim // cfg.num_heads,

@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from vodascheduler_tpu.models.layers import AttnConfig, DecoderBlock, RMSNorm
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +65,7 @@ class Llama(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
                      param_dtype=jnp.float32, dtype=dtype)(tokens)
+        x = constrain_batch_activation(x)
         attn_cfg = AttnConfig(num_heads=cfg.num_heads,
                               num_kv_heads=cfg.num_kv_heads,
                               head_dim=cfg.head_dim, causal=True,

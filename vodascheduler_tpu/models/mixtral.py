@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from vodascheduler_tpu.models.layers import AttnConfig, Attention, RMSNorm
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +111,7 @@ class Mixtral(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
                      param_dtype=jnp.float32, dtype=dtype)(tokens)
+        x = constrain_batch_activation(x)
         for i in range(cfg.num_layers):
             x = MixtralBlock(cfg, attn_fn=self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)

@@ -44,6 +44,10 @@ except AttributeError:  # pragma: no cover
 
 NEG_INF = -1e30  # finite: avoids inf-inf NaNs in the running-max updates
 LANES = 128
+# The logsumexp is per-row; persisting it lane-replicated would be 128x
+# the HBM traffic/footprint, so the output array keeps a single lane
+# (VMEM tiles are padded either way; HBM stores only this width).
+LSE_LANES = 1
 
 
 def _pick_block(seq: int, preferred: int) -> int:
@@ -117,7 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                        * _bcast_lanes(l_inv, acc_ref.shape[-1])
                        ).astype(o_ref.dtype)
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        lse_ref[0, 0] = m_ref[...] + jnp.log(safe_l)
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(safe_l))[:, :LSE_LANES]
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -139,11 +143,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),   # running max
@@ -189,11 +194,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         s *= sm_scale
         if causal:
             s = _causal_mask(s, i, j, block_q, block_k)
-        lse = lse_ref[0, 0]                                  # [bq, LANES]
-        p = jnp.exp(s - _bcast_lanes(lse, block_k))          # [bq, bk]
+        lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
+        p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
-        ds = p * (dov - _bcast_lanes(delta_ref[...], block_k)) * sm_scale
+        ds = p * (dov - delta_ref[...][:, :1]) * sm_scale
         dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(j == num_k - 1)
@@ -225,8 +230,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         s *= sm_scale
         if causal:
             s = _causal_mask(s, i, j, block_q, block_k)
-        lse = lse_ref[0, 0]
-        p = jnp.exp(s - _bcast_lanes(lse, block_k))          # [bq, bk]
+        lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
+        p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
         delta = jnp.sum(do * o, axis=1)[:, None]             # [bq, 1]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
@@ -254,7 +259,7 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq, LANES),
+    lse_spec = pl.BlockSpec((1, 1, bq, LSE_LANES),
                             lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
@@ -275,7 +280,7 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
     q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
     kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
-    lse_spec_t = pl.BlockSpec((1, 1, bq, LANES),
+    lse_spec_t = pl.BlockSpec((1, 1, bq, LSE_LANES),
                               lambda b, h, j, i: (b, h, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -309,18 +314,12 @@ def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    # The kernel emits lse lane-replicated ([B,H,S,LANES], the native TPU
-    # layout for per-row scalars); keep only one lane as the AD residual —
-    # residuals are held across ALL layers during reverse-mode, so the
-    # 128x blowup would dominate activation memory at long seq.
-    return o, (q, k, v, o, lse[..., 0])
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    lse_full = jnp.broadcast_to(lse[..., None],
-                                (*lse.shape, LANES))  # transient, per-layer
-    return _bwd(q, k, v, o, lse_full, g, causal, block_q, block_k, interpret)
+    return _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -359,13 +358,31 @@ def make_flash_attention(mesh: Mesh,
     purely local blocks and GSPMD inserts no collectives around it. The
     sequence axis stays local — a mesh with a real `sp` axis should use
     ring attention (parallel/ring_attention.py) instead.
+
+    Shapes that don't divide the mesh axes (heads % tp, batch % dp·fsdp)
+    fall back to the plain XLA softmax path at trace time — shard_map
+    requires exact divisibility, and the elasticity contract ("the same
+    model reshapes onto any mesh") must not break on such plans.
     """
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
     spec = P(batch, None, head, None)
+    batch_size = 1
+    for a in (batch or ()):
+        batch_size *= mesh.shape[a]
+    head_size = mesh.shape[head_axis] if head else 1
 
     def local_fn(q, k, v):
         return flash_attention(q, k, v, causal=causal, interpret=interpret)
 
-    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)
+    sharded = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)
+
+    def attn(q, k, v):
+        if q.shape[0] % batch_size or q.shape[2] % head_size:
+            from vodascheduler_tpu.parallel.ring_attention import (
+                reference_attention)
+            return reference_attention(q, k, v, causal=causal)
+        return sharded(q, k, v)
+
+    return attn

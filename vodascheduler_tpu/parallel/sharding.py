@@ -39,8 +39,13 @@ class ShardingRules:
 # Transformer rules (llama/bert/vit family): TP shards attention heads and
 # MLP hidden; FSDP shards the other big axis of every matrix.
 TRANSFORMER_RULES = ShardingRules(rules=[
-    # token/position embeddings: shard vocab over tp, model dim over fsdp
-    (r"embed.*embedding$", P("tp", "fsdp")),
+    # token/position embeddings: vocab over fsdp, model dim over tp.
+    # (Not the transpose: dim-over-fsdp propagates into the gather output
+    # with a permuted device order GSPMD can only fix by involuntary full
+    # rematerialization of the [B,S,D] activation — see
+    # constrain_batch_activation. vocab-over-fsdp also reduce-scatters
+    # the embedding grad instead of replicating it.)
+    (r"embed.*embedding$", P("fsdp", "tp")),
     # attention projections: qkv shard heads (tp), o shards model dim
     (r"(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp")),
     (r"o_proj.*kernel$", P("tp", "fsdp")),
@@ -118,3 +123,25 @@ def batch_sharding(mesh: Mesh, seq_axis: Optional[str] = None) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def constrain_batch_activation(x: jax.Array) -> jax.Array:
+    """Pin an activation's leading (batch) dim to the data axes.
+
+    Embedding tables are fsdp-sharded on the model dim, and without a
+    constraint GSPMD propagates that feature sharding into the gather
+    output; the backward pass then pays an involuntary full
+    rematerialization converting the batch-sharded cotangent back
+    (observed on dp×fsdp×tp meshes). Models call this right after the
+    embedding lookup. Uses the framework's fixed axis names (mesh.py
+    AXES), so it needs an active mesh context — the train step runs
+    under one (train.py) — and no-ops when there is none, keeping
+    modules usable standalone.
+    """
+    try:
+        # Mirror batch_sharding: batch over the data axes, seq over sp
+        # (sp=1 meshes make the seq axis a no-op; sp>1 meshes already
+        # shard the token batch this way, so divisibility holds).
+        return jax.lax.with_sharding_constraint(x, P(("dp", "fsdp"), "sp"))
+    except (RuntimeError, ValueError, KeyError):
+        return x

@@ -144,11 +144,22 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
         lambda leaf: b_shard_seq if (plan.sp > 1 and len(leaf.shape) == 2)
         else b_shard, sample_batch)
 
-    init_jit = jax.jit(init_state, out_shardings=state_shardings)
-    step_jit = jax.jit(train_step,
-                       in_shardings=(state_shardings, batch_shardings),
-                       out_shardings=(state_shardings, None),
-                       donate_argnums=0)
+    # The jitted fns run (and trace) under the mesh context so bare-
+    # PartitionSpec activation constraints inside models resolve
+    # (sharding.constrain_batch_activation).
+    def _under_mesh(fn):
+        @functools.wraps(fn)
+        def wrapped(*args):
+            with mesh:
+                return fn(*args)
+        return wrapped
+
+    init_jit = _under_mesh(jax.jit(init_state, out_shardings=state_shardings))
+    step_jit = _under_mesh(jax.jit(train_step,
+                                   in_shardings=(state_shardings,
+                                                 batch_shardings),
+                                   out_shardings=(state_shardings, None),
+                                   donate_argnums=0))
 
     def make_batch(batch_size: int, rng: jax.Array):
         batch = bundle.make_batch(batch_size, rng)
