@@ -92,26 +92,47 @@ class TestRingAttention:
 
 
 class TestNoInvoluntaryResharding:
+    @pytest.mark.slow
     def test_dp_fsdp_tp_step_has_no_involuntary_remat(self):
         """GSPMD must not fall back to full rematerialization anywhere in
         the train step (regression: the embed table's old P(tp, fsdp)
-        sharding leaked feature sharding into the gather output)."""
+        sharding leaked feature sharding into the gather output).
+
+        Runs a positive control first — the old bad rule must reproduce
+        the warning — so the assertion can't pass vacuously if a jaxlib
+        upgrade rewords or reroutes the log."""
         import subprocess
         import sys
 
-        code = (
-            "import jax; jax.config.update('jax_platforms','cpu')\n"
-            "from vodascheduler_tpu.models import get_model\n"
-            "from vodascheduler_tpu.parallel.mesh import MeshPlan\n"
-            "from vodascheduler_tpu.runtime import TrainSession\n"
-            "s = TrainSession(get_model('llama_tiny'), num_chips=8,\n"
-            "                 global_batch_size=4,\n"
-            "                 plan=MeshPlan(dp=2, fsdp=2, tp=2),\n"
-            "                 devices=jax.devices()[:8])\n"
-            "s.run_steps(1)\n"
-        )
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True, timeout=420)
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        assert "Involuntary full rematerialization" not in proc.stderr, \
-            proc.stderr[-3000:]
+        def run_step(patch_bad_rule: bool) -> str:
+            patch = (
+                "import vodascheduler_tpu.parallel.sharding as sh\n"
+                "from jax.sharding import PartitionSpec as P\n"
+                "sh.TRANSFORMER_RULES.rules[0] = "
+                "(r'embed.*embedding$', P('tp', 'fsdp'))\n"
+                "sh.constrain_batch_activation = lambda x: x\n"
+            ) if patch_bad_rule else ""
+            code = (
+                "import jax; jax.config.update('jax_platforms','cpu')\n"
+                + patch +
+                "from vodascheduler_tpu.models import get_model\n"
+                "from vodascheduler_tpu.parallel.mesh import MeshPlan\n"
+                "from vodascheduler_tpu.runtime import TrainSession\n"
+                "s = TrainSession(get_model('llama_tiny'), num_chips=8,\n"
+                "                 global_batch_size=4,\n"
+                "                 plan=MeshPlan(dp=2, fsdp=2, tp=2),\n"
+                "                 devices=jax.devices()[:8])\n"
+                "s.run_steps(1)\n"
+            )
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=420)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return proc.stderr
+
+        marker = "Involuntary full rematerialization"
+        control = run_step(patch_bad_rule=True)
+        assert marker in control, (
+            "positive control failed: the known-bad sharding no longer "
+            "reproduces the GSPMD warning — update this test's marker")
+        assert marker not in run_step(patch_bad_rule=False)

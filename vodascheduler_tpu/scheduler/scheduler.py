@@ -455,9 +455,15 @@ class Scheduler:
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
         """Suppress small scale-outs of recently-resized running jobs (see
-        ctor comment). Keeping the old (smaller) allocation only shrinks the
-        total, so the result stays valid; the cooldown guarantees the growth
-        eventually applies instead of stranding chips forever."""
+        ctor comment) — on TPU every resize is a checkpoint-restart, so a
+        +1/-1 oscillation burns two restart windows for negligible speedup.
+
+        Keeping the old (smaller) allocation only shrinks the total, so
+        the result stays valid; the cooldown guarantees the growth
+        eventually applies instead of stranding chips forever. (Symmetric
+        scale-in suppression was tried and removed: holding a job at its
+        larger size delays the inevitable shrink-restart without saving
+        it, and measured neutral-to-negative on trace replay.)"""
         import math as _math
 
         now = self.clock.now()
