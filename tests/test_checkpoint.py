@@ -128,3 +128,38 @@ def test_restore_missing_raises(tmp_path, devices):
     with pytest.raises(FileNotFoundError):
         TrainSession.resume(get_model("mnist_mlp"), 2, str(tmp_path / "none"),
                             devices=devices[:2])
+
+
+class TestAsyncSaver:
+    def test_async_save_overlaps_and_restores(self, tmp_path):
+        """An async save started before further training steps restores
+        the state AS OF the save (device->host copy is synchronous), and
+        retention prunes only after the superseding save commits."""
+        from vodascheduler_tpu.models import get_model
+        from vodascheduler_tpu.runtime import TrainSession
+        from vodascheduler_tpu.runtime.checkpoint import list_steps
+
+        d = str(tmp_path / "ckpt")
+        s = TrainSession(get_model("mnist_mlp"), num_chips=1,
+                         global_batch_size=4)
+        s.run_steps(1)
+        step1 = s.save(d, keep_last=1, wait=False)
+        s.run_steps(1)  # donates/overwrites state while save may be in flight
+        step2 = s.save(d, keep_last=1, wait=False)
+        s.run_steps(1)
+        s.finish_saves()
+        assert (step1, step2) == (1, 2)
+        # keep_last=1: step1 pruned once step2 committed
+        assert list_steps(d) == [2]
+
+        restored = TrainSession.resume(get_model("mnist_mlp"), 1, d,
+                                       global_batch_size=4)
+        assert restored.step == 2
+
+    def test_finish_saves_without_any_save_is_noop(self):
+        from vodascheduler_tpu.models import get_model
+        from vodascheduler_tpu.runtime import TrainSession
+
+        s = TrainSession(get_model("mnist_mlp"), num_chips=1,
+                         global_batch_size=4)
+        s.finish_saves()  # no saver created yet

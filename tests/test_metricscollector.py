@@ -134,3 +134,43 @@ class TestClosedLoop:
         measured = [n for n in fi.speedup if n in fi.epoch_seconds and n > 1]
         for n in measured:
             assert fi.speedup[n] < n + 1e-6
+
+
+class TestTpuMonitor:
+    def test_collects_device_count_and_exposes(self):
+        from vodascheduler_tpu.common.metrics import Registry
+        from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+
+        registry = Registry()
+        mon = TpuMonitor(registry)
+        mon.collect_once()
+        text = registry.exposition()
+        assert "voda_tpu_devices" in text
+        # CPU test platform: 8 virtual devices (conftest)
+        assert mon.m_devices.value() == 8.0
+        mon.collect_once()  # idempotent full rebuild
+
+    def test_stale_device_series_cleared_on_rebuild(self):
+        from vodascheduler_tpu.common.metrics import Registry
+        from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+
+        registry = Registry()
+        mon = TpuMonitor(registry)
+        g = mon.m_mem["voda_tpu_memory_bytes_in_use"]
+        g.set(999.0, device="99", platform="gone")
+        mon.collect_once()
+        # a device not observed this poll must not keep exporting
+        assert 'device="99"' not in registry.exposition()
+
+    def test_labeled_gauge_exposition_format(self):
+        from vodascheduler_tpu.common.metrics import Registry
+
+        registry = Registry()
+        g = registry.gauge("voda_tpu_memory_bytes_in_use", "test",
+                           labels=("device", "platform"))
+        g.set(123.0, device="0", platform="tpu")
+        g.set(456.0, device="1", platform="tpu")
+        text = registry.exposition()
+        assert 'voda_tpu_memory_bytes_in_use{device="0",platform="tpu"} 123.0' in text
+        assert 'voda_tpu_memory_bytes_in_use{device="1",platform="tpu"} 456.0' in text
+        assert g.value(device="1", platform="tpu") == 456.0

@@ -246,3 +246,70 @@ class TestMetricsAccounting:
         assert ja.metrics.chip_seconds > 4 * 90
         assert jb.metrics.waiting_seconds > 90
         assert jb.metrics.running_seconds == 0
+
+
+class TestMultiPool:
+    def test_two_pools_route_and_run_independently(self):
+        """Reference layout: one scheduler instance per GPU type, sharing
+        the store and the event bus, with admission routing each job to
+        its pool's queue (SURVEY.md §1 layer map; rabbitmq.go per-type
+        queues). Here: two pools, one control plane."""
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus()
+
+        backends = {}
+        scheds = {}
+        for pool, chips in (("v5p-pool", 8), ("v5e-pool", 4)):
+            be = FakeClusterBackend(clock, restart_overhead_seconds=5.0)
+            be.add_host(f"{pool}-host-0", chips, announce=False)
+            backends[pool] = be
+            scheds[pool] = Scheduler(pool, be, store,
+                                     ResourceAllocator(store), clock,
+                                     bus=bus, algorithm="ElasticFIFO",
+                                     rate_limit_seconds=1.0)
+        admission = AdmissionService(store, bus, clock)
+
+        a = admission.create_training_job(spec("job-a", pool="v5p-pool",
+                                               max_chips=8, epochs=2))
+        b = admission.create_training_job(spec("job-b", pool="v5e-pool",
+                                               max_chips=4, epochs=2))
+        clock.advance(2.0)
+
+        # Each job landed only on its pool's scheduler and cluster.
+        assert a in scheds["v5p-pool"].job_num_chips
+        assert a not in scheds["v5e-pool"].job_num_chips
+        assert b in scheds["v5e-pool"].job_num_chips
+        assert b not in scheds["v5p-pool"].job_num_chips
+        assert scheds["v5p-pool"].job_num_chips[a] == 8
+        assert scheds["v5e-pool"].job_num_chips[b] == 4
+
+        clock.advance(3600.0)
+        assert a in backends["v5p-pool"].completed
+        assert b in backends["v5e-pool"].completed
+        assert store.get_job(a).status == JobStatus.COMPLETED
+        assert store.get_job(b).status == JobStatus.COMPLETED
+
+    def test_delete_routes_to_owning_pool(self):
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus()
+        backends = {}
+        scheds = {}
+        for pool in ("p1", "p2"):
+            be = FakeClusterBackend(clock, restart_overhead_seconds=5.0)
+            be.add_host(f"{pool}-h0", 4, announce=False)
+            backends[pool] = be
+            scheds[pool] = Scheduler(pool, be, store,
+                                     ResourceAllocator(store), clock,
+                                     bus=bus, rate_limit_seconds=1.0)
+        admission = AdmissionService(store, bus, clock)
+        a = admission.create_training_job(spec("till-deleted", pool="p2",
+                                               max_chips=4, epochs=1000))
+        clock.advance(2.0)
+        assert a in scheds["p2"].job_num_chips
+        admission.delete_training_job(a)
+        clock.advance(2.0)
+        assert a not in scheds["p2"].job_num_chips
+        assert not backends["p2"].running_jobs()
+        assert a not in scheds["p1"].job_num_chips

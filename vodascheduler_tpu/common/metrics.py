@@ -54,24 +54,50 @@ class Counter:
 
 class Gauge:
     """Settable gauge; pass `fn` for a GaugeFunc evaluated at scrape time
-    (the reference uses GaugeFuncs over its locked maps, metrics.go:99+)."""
+    (the reference uses GaugeFuncs over its locked maps, metrics.go:99+).
+    With `label_names`, one series per label tuple (e.g. per TPU device)."""
 
     def __init__(self, name: str, help_: str,
-                 fn: Optional[Callable[[], float]] = None):
+                 fn: Optional[Callable[[], float]] = None,
+                 label_names: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_
+        self.label_names = label_names
         self._fn = fn
         self._value = 0.0
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
 
-    def set(self, v: float) -> None:
-        self._value = v
+    def set(self, v: float, **labels: str) -> None:
+        if self.label_names:
+            key = tuple(labels.get(n, "") for n in self.label_names)
+            with self._lock:
+                self._values[key] = v
+        else:
+            self._value = v
 
-    def value(self) -> float:
+    def value(self, **labels: str) -> float:
+        if self.label_names:
+            key = tuple(labels.get(n, "") for n in self.label_names)
+            return self._values.get(key, 0.0)
         return self._fn() if self._fn is not None else self._value
 
+    def clear(self) -> None:
+        """Drop all labeled series (for full-rebuild collectors)."""
+        with self._lock:
+            self._values.clear()
+
     def collect(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge",
-                f"{self.name} {self.value()}"]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        if self.label_names:
+            with self._lock:
+                for key, v in self._values.items():
+                    lines.append(
+                        f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        else:
+            lines.append(f"{self.name} {self.value()}")
+        return lines
 
 
 class Summary:
@@ -129,8 +155,9 @@ class Registry:
         return self.register(Counter(name, help_, labels))
 
     def gauge(self, name: str, help_: str,
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
-        return self.register(Gauge(name, help_, fn))
+              fn: Optional[Callable[[], float]] = None,
+              labels: Tuple[str, ...] = ()) -> Gauge:
+        return self.register(Gauge(name, help_, fn, label_names=labels))
 
     def summary(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Summary:
         return self.register(Summary(name, help_, labels))

@@ -50,19 +50,42 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
-def save_checkpoint(ckpt_dir: str, state: Any, rng: jax.Array,
-                    keep_last: int = 2) -> int:
-    """Atomically save `{state, rng}` under ckpt_dir/step_<n>.
+class AsyncCheckpointSaver:
+    """Checkpoint saver that overlaps disk I/O with training.
 
-    Orbax writes each array's shards from the devices that hold them and
-    commits via tmp-dir rename, so a crash mid-save never corrupts the
-    previous checkpoint (the crash-consistency the reference gets from
-    Mongo + k8s idempotency, SURVEY.md §7 hard part (d)).
+    Orbax's save() contract: the device→host copy happens synchronously
+    (so jit donation of the state on the next step is safe), then shard
+    writing proceeds in a background thread. One save is in flight at a
+    time; retention pruning of older steps is deferred until the write
+    that supersedes them has committed. `wait()` (or `close()`) must run
+    before process exit — the supervisor calls it before its preemption
+    save and before reporting completion.
     """
-    step = int(state["step"])
-    path = _step_dir(ckpt_dir, step)
-    os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
+
+    def __init__(self) -> None:
+        self._ckptr: Optional[ocp.StandardCheckpointer] = None
+        self._pending_retention: Optional[Tuple[str, int]] = None
+
+    def _checkpointer(self) -> ocp.StandardCheckpointer:
+        if self._ckptr is None:
+            self._ckptr = ocp.StandardCheckpointer()
+        return self._ckptr
+
+    def save(self, ckpt_dir: str, state: Any, rng: jax.Array,
+             keep_last: int = 2, wait: bool = False) -> int:
+        """Save `{state, rng}` under ckpt_dir/step_<n>; returns the step.
+
+        Crash-safety: orbax commits each save via tmp-dir rename, and the
+        tmp names never match STEP_DIR_RE, so restore never sees a
+        half-written checkpoint (the crash-consistency the reference gets
+        from Mongo + k8s idempotency, SURVEY.md §7 hard part (d)).
+        """
+        ckptr = self._checkpointer()
+        ckptr.wait_until_finished()  # one in flight; previous is committed
+        self._finish_retention()
+        step = int(state["step"])
+        path = _step_dir(ckpt_dir, step)
+        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
         if os.path.exists(path):
             # Re-save of an existing step (e.g. preemption save right after
             # restore): write beside it, then swap, so the old checkpoint
@@ -72,18 +95,55 @@ def save_checkpoint(ckpt_dir: str, state: Any, rng: jax.Array,
             shutil.rmtree(tmp, ignore_errors=True)
             shutil.rmtree(old, ignore_errors=True)
             ckptr.save(tmp, {"state": state, "rng": rng})
-            ckptr.wait_until_finished()  # save() is async in orbax >= 0.9
+            ckptr.wait_until_finished()
             os.rename(path, old)
             os.rename(tmp, path)
             shutil.rmtree(old)
+            self._prune(ckpt_dir, keep_last)
         else:
             ckptr.save(path, {"state": state, "rng": rng})
-            ckptr.wait_until_finished()
-    # Retention: keep the newest `keep_last` steps.
-    steps = list_steps(ckpt_dir)
-    for old in steps[:-keep_last] if keep_last > 0 else []:
-        shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
-    return step
+            self._pending_retention = (ckpt_dir, keep_last)
+            if wait:
+                self.wait()
+        return step
+
+    def _prune(self, ckpt_dir: str, keep_last: int) -> None:
+        steps = list_steps(ckpt_dir)
+        for old in steps[:-keep_last] if keep_last > 0 else []:
+            shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+
+    def _finish_retention(self) -> None:
+        if self._pending_retention is not None:
+            ckpt_dir, keep_last = self._pending_retention
+            self._pending_retention = None
+            self._prune(ckpt_dir, keep_last)
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) has committed."""
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+        self._finish_retention()
+
+    def close(self) -> None:
+        self.wait()
+        if self._ckptr is not None:
+            self._ckptr.close()
+            self._ckptr = None
+
+    def __enter__(self) -> "AsyncCheckpointSaver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, rng: jax.Array,
+                    keep_last: int = 2) -> int:
+    """Synchronous one-shot save (see AsyncCheckpointSaver for the
+    overlapped path the supervisor uses)."""
+    with AsyncCheckpointSaver() as saver:
+        return saver.save(ckpt_dir, state, rng, keep_last=keep_last,
+                          wait=True)
 
 
 def _abstract_target(setup, rng_like: jax.Array) -> Any:

@@ -173,7 +173,10 @@ def run_job(workdir: str, num_chips: int,
         steps_this_epoch = epoch_end_step - session.step
         while session.step < epoch_end_step:
             if stop_requested["flag"]:
-                session.save(ckpt_dir)
+                # Preemption save must be durable before exit; also drain
+                # any still-flying per-epoch save of an older step first.
+                session.finish_saves()
+                session.save(ckpt_dir, wait=True)
                 return PREEMPTED_EXIT_CODE
             n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
             session.run_steps(n)
@@ -182,8 +185,11 @@ def run_job(workdir: str, num_chips: int,
                          step_time_sec=epoch_time / steps_this_epoch,
                          workers=num_chips,
                          start_time=str(time.time()))
-        session.save(ckpt_dir)
+        # Async: the next epoch's compute overlaps this save's shard
+        # writes (the device->host copy is synchronous inside save).
+        session.save(ckpt_dir, wait=False)
 
+    session.finish_saves()
     return 0
 
 

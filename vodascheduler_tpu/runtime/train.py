@@ -188,6 +188,7 @@ class TrainSession:
         self.rng = jax.random.PRNGKey(seed)
         self.state = self.setup.init_fn(jax.random.PRNGKey(seed)) if init \
             else None
+        self._saver = None
 
     @property
     def step(self) -> int:
@@ -210,12 +211,25 @@ class TrainSession:
             self.state, loss = self.setup.train_step(self.state, batch)
         return float(loss)
 
-    def save(self, ckpt_dir: str, keep_last: int = 2) -> int:
-        """Checkpoint current (state, rng); returns the saved step."""
+    def save(self, ckpt_dir: str, keep_last: int = 2,
+             wait: bool = True) -> int:
+        """Checkpoint current (state, rng); returns the saved step.
+
+        `wait=False` overlaps the shard writes with subsequent training
+        steps (device→host copy still happens before returning, so the
+        donated state buffers are safe); call `finish_saves()` before the
+        process exits or before restoring elsewhere."""
         self._require_state()
-        from vodascheduler_tpu.runtime import checkpoint as ckpt
-        return ckpt.save_checkpoint(ckpt_dir, self.state, self.rng,
-                                    keep_last=keep_last)
+        from vodascheduler_tpu.runtime.checkpoint import AsyncCheckpointSaver
+        if self._saver is None:
+            self._saver = AsyncCheckpointSaver()
+        return self._saver.save(ckpt_dir, self.state, self.rng,
+                                keep_last=keep_last, wait=wait)
+
+    def finish_saves(self) -> None:
+        """Block until any in-flight async save has committed."""
+        if self._saver is not None:
+            self._saver.wait()
 
     @classmethod
     def resume(cls, bundle: ModelBundle, num_chips: int, ckpt_dir: str,

@@ -84,9 +84,19 @@ class VodaApp:
         self.collector = MetricsCollector(
             self.store, CsvDirRowSource(self.backend.metrics_dir),
             interval_seconds=collector_interval_seconds)
-        self.daemon = SchedulerDaemon(
-            [self.scheduler],
-            periodic=[(collector_interval_seconds, self._collect_and_resched)])
+        # Chip telemetry on the shared /metrics endpoints (reference
+        # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
+        # Collected only when this process may own a jax backend: hermetic
+        # CPU mode, or explicitly enabled (control plane running off-host
+        # from the workers). On a real TPU host libtpu grants the chips to
+        # one process — the training supervisors must win, not us.
+        from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+        self.tpu_monitor = TpuMonitor(self.registry)
+        periodic = [(collector_interval_seconds, self._collect_and_resched)]
+        if (hermetic_devices is not None
+                or os.environ.get("VODA_TPU_MONITOR") == "1"):
+            periodic.append((30.0, self.tpu_monitor.collect_once))
+        self.daemon = SchedulerDaemon([self.scheduler], periodic=periodic)
 
         # Warm the native kernels off the resched hot path (first use would
         # otherwise block a resched on a synchronous g++ build).
