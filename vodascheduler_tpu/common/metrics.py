@@ -87,6 +87,13 @@ class Gauge:
         with self._lock:
             self._values.clear()
 
+    def set_all(self, values: Dict[Tuple[str, ...], float]) -> None:
+        """Atomically replace every labeled series (keys are label tuples
+        in label_names order) — a concurrent scrape sees either the old
+        or the new complete set, never a partially-cleared one."""
+        with self._lock:
+            self._values = dict(values)
+
     def collect(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} gauge"]

@@ -60,27 +60,35 @@ class AttnConfig:
 
 class Attention(nn.Module):
     """Grouped-query attention; `attn_fn` lets the runtime swap in ring
-    attention when the mesh has an `sp` axis."""
+    attention when the mesh has an `sp` axis. Pass `context` for
+    cross-attention (keys/values projected from the encoder output)."""
 
     cfg: AttnConfig
     attn_fn: Optional[Callable] = None  # (q,k,v)->out, [B,S,H,D] layout
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, context=None):
         cfg = self.cfg
         B, S, _ = x.shape
+        kv_src = x if context is None else context
         dense = lambda feats, name: nn.DenseGeneral(
             features=feats, axis=-1, use_bias=False, name=name,
             dtype=x.dtype, param_dtype=jnp.float32)
         q = dense((cfg.num_heads, cfg.head_dim), "q_proj")(x)
-        k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj")(x)
-        v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj")(x)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj")(kv_src)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj")(kv_src)
 
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
         if cfg.rope_base > 0:
             q = rope(q, positions, cfg.rope_base)
-            k = rope(k, positions, cfg.rope_base)
+            if context is None:
+                k = rope(k, positions, cfg.rope_base)
+            else:  # rotate keys by the *encoder* sequence's positions
+                kv_pos = jnp.broadcast_to(
+                    jnp.arange(kv_src.shape[1])[None, :],
+                    (B, kv_src.shape[1]))
+                k = rope(k, kv_pos, cfg.rope_base)
 
         groups = cfg.num_heads // cfg.num_kv_heads
         if groups > 1:  # expand kv heads for GQA

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from vodascheduler_tpu.models import bert, llama, mixtral, mlp, resnet, vit
+from vodascheduler_tpu.models import bert, llama, mixtral, mlp, nmt, resnet, vit
 from vodascheduler_tpu.parallel.sharding import (
     CONV_RULES,
     TRANSFORMER_RULES,
@@ -54,6 +54,18 @@ def _mlm_loss(apply_fn, params, batch):
     logits = apply_fn(params, batch["inputs"])
     return optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), batch["targets"]).mean()
+
+
+def _nmt_batch(vocab: int, src_seq: int, tgt_seq: int):
+    def make(batch_size: int, rng: jax.Array):
+        r1, r2 = jax.random.split(rng)
+        src = jax.random.randint(r1, (batch_size, src_seq), 0, vocab,
+                                 dtype=jnp.int32)
+        tgt = jax.random.randint(r2, (batch_size, tgt_seq + 1), 0, vocab,
+                                 dtype=jnp.int32)
+        return {"inputs": {"src": src, "tgt": tgt[:, :-1]},
+                "targets": tgt[:, 1:]}
+    return make
 
 
 def _image_batch(size: int, channels: int, classes: int):
@@ -121,6 +133,17 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(mixtral.MIXTRAL_8X7B_LIKE.vocab_size, 4096),
             loss_fn=_lm_loss, rules=TRANSFORMER_RULES, params_b=47.0,
             seq_len=4096, num_experts=8),
+        "nmt_base": lambda: ModelBundle(
+            name="nmt_base",
+            module=nmt.Seq2SeqTransformer(nmt.NMT_BASE),
+            make_batch=_nmt_batch(nmt.NMT_BASE.vocab_size, 256, 256),
+            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, params_b=0.07,
+            seq_len=256),
+        "nmt_tiny": lambda: ModelBundle(
+            name="nmt_tiny",
+            module=nmt.Seq2SeqTransformer(nmt.NMT_TINY),
+            make_batch=_nmt_batch(nmt.NMT_TINY.vocab_size, 32, 32),
+            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, seq_len=32),
         "mixtral_tiny": lambda: ModelBundle(
             name="mixtral_tiny", module=mixtral.Mixtral(mixtral.MIXTRAL_TINY),
             make_batch=_lm_batch(mixtral.MIXTRAL_TINY.vocab_size, 64),
@@ -137,6 +160,8 @@ _ALIASES = {
     "vitl": "vit_l16",
     "llama8b": "llama3_8b",
     "mixtral": "mixtral_8x7b",
+    "nmt": "nmt_base",
+    "transformer_nmt": "nmt_base",
 }
 
 

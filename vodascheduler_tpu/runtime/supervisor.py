@@ -173,10 +173,10 @@ def run_job(workdir: str, num_chips: int,
         steps_this_epoch = epoch_end_step - session.step
         while session.step < epoch_end_step:
             if stop_requested["flag"]:
-                # Preemption save must be durable before exit; also drain
-                # any still-flying per-epoch save of an older step first.
-                session.finish_saves()
+                # Durable before exit (save itself drains any still-flying
+                # per-epoch write first, then waits for this one).
                 session.save(ckpt_dir, wait=True)
+                session.finish_saves()
                 return PREEMPTED_EXIT_CODE
             n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
             session.run_steps(n)

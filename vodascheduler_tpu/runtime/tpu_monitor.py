@@ -65,10 +65,10 @@ class TpuMonitor:
             log.exception("device discovery failed")
             devices = []
         self.m_devices.set(float(len(devices)))
-        # Full rebuild: devices that vanished (resize, failure) must not
-        # keep exporting their last-seen values as if they were live.
-        for gauge in self.m_mem.values():
-            gauge.clear()
+        # Full rebuild, swapped in atomically per series: devices that
+        # vanished stop exporting, and a concurrent scrape never sees a
+        # half-cleared label set.
+        new_values = {series: {} for _, series in _STAT_SERIES}
         for d in devices:
             try:
                 stats = d.memory_stats() or {}
@@ -76,6 +76,7 @@ class TpuMonitor:
                 stats = {}
             for key, series in _STAT_SERIES:
                 if key in stats:
-                    self.m_mem[series].set(
-                        float(stats[key]), device=str(d.id),
-                        platform=d.platform)
+                    new_values[series][(str(d.id), d.platform)] = \
+                        float(stats[key])
+        for series, values in new_values.items():
+            self.m_mem[series].set_all(values)

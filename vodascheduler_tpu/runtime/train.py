@@ -227,9 +227,11 @@ class TrainSession:
                                 keep_last=keep_last, wait=wait)
 
     def finish_saves(self) -> None:
-        """Block until any in-flight async save has committed."""
+        """Drain any in-flight async save and release the checkpointer
+        (a later save lazily recreates it)."""
         if self._saver is not None:
-            self._saver.wait()
+            self._saver.close()
+            self._saver = None
 
     @classmethod
     def resume(cls, bundle: ModelBundle, num_chips: int, ckpt_dir: str,

@@ -90,11 +90,15 @@ class VodaApp:
         # CPU mode, or explicitly enabled (control plane running off-host
         # from the workers). On a real TPU host libtpu grants the chips to
         # one process — the training supervisors must win, not us.
-        from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
-        self.tpu_monitor = TpuMonitor(self.registry)
         periodic = [(collector_interval_seconds, self._collect_and_resched)]
+        self.tpu_monitor = None
         if (hermetic_devices is not None
                 or os.environ.get("VODA_TPU_MONITOR") == "1"):
+            # Register the gauges only when collection actually runs — a
+            # disabled monitor must not export voda_tpu_devices=0 as if a
+            # healthy host had no accelerators.
+            from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+            self.tpu_monitor = TpuMonitor(self.registry)
             periodic.append((30.0, self.tpu_monitor.collect_once))
         self.daemon = SchedulerDaemon([self.scheduler], periodic=periodic)
 
