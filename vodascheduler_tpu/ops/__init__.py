@@ -10,6 +10,8 @@ generic fusion of the O(S²) softmax path.
 from vodascheduler_tpu.ops.flash_attention import (
     flash_attention,
     make_flash_attention,
+    make_sp_flash_attention,
 )
 
-__all__ = ["flash_attention", "make_flash_attention"]
+__all__ = ["flash_attention", "make_flash_attention",
+           "make_sp_flash_attention"]

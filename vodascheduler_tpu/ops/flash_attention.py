@@ -70,9 +70,11 @@ def _bcast_lanes(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.tile(x, (1, reps))
 
 
-def _causal_mask(s, i, j, block_q, block_k):
-    rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+def _causal_mask(s, row_start, col_start):
+    """row_start/col_start are global sequence positions (row_start may be
+    a traced scalar — sequence-parallel shards pass their q offset)."""
+    rows = row_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = col_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
@@ -81,8 +83,8 @@ def _causal_mask(s, i, j, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, sm_scale, causal, block_q, block_k, num_k):
+def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                acc_ref, *, sm_scale, causal, block_q, block_k, num_k):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -91,7 +93,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
         acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    run = (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
 
     @pl.when(run)
     def _compute():
@@ -101,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
         if causal:
-            s = _causal_mask(s, i, j, block_q, block_k)
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
 
         m_prev, l_prev = m_ref[...], l_ref[...]          # [bq, LANES]
         m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
@@ -124,7 +127,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, 0] = (m_ref[...] + jnp.log(safe_l))[:, :LSE_LANES]
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
@@ -137,6 +140,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
         kernel,
         grid=(B, H, num_q, num_k),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -159,7 +164,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(q_off, q, k, v)
     return o, lse
 
 
@@ -168,7 +173,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+def _dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                dq_acc, delta_ref, *, sm_scale, causal, block_q, block_k,
                num_k):
     i, j = pl.program_id(2), pl.program_id(3)
@@ -181,7 +186,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         delta_ref[...] = jnp.sum(do * o, axis=1)[:, None] * jnp.ones(
             (1, LANES), jnp.float32)
 
-    run = (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
 
     @pl.when(run)
     def _compute():
@@ -193,7 +199,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
         if causal:
-            s = _causal_mask(s, i, j, block_q, block_k)
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
         lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
         p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -206,9 +212,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, sm_scale, causal, block_q, block_k,
-                num_q):
+def _dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                block_q, block_k, num_q):
     j, i = pl.program_id(2), pl.program_id(3)
 
     @pl.when(i == 0)
@@ -216,7 +222,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dk_acc[...] = jnp.zeros(dk_acc.shape, jnp.float32)
         dv_acc[...] = jnp.zeros(dv_acc.shape, jnp.float32)
 
-    run = (i + 1) * block_q - 1 >= j * block_k if causal else i >= 0
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else i >= 0
 
     @pl.when(run)
     def _compute():
@@ -229,7 +236,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
         if causal:
-            s = _causal_mask(s, i, j, block_q, block_k)
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
         lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
         p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
         delta = jnp.sum(do * o, axis=1)[:, None]             # [bq, 1]
@@ -250,7 +257,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
@@ -262,11 +269,14 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     lse_spec = pl.BlockSpec((1, 1, bq, LSE_LANES),
                             lambda b, h, i, j: (b, h, i, 0))
 
+    off_spec = pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                            memory_space=pltpu.SMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, num_k=num_k),
         grid=(B, H, num_q, num_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        in_specs=[off_spec, q_spec, kv_spec, kv_spec, q_spec, q_spec,
+                  lse_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
@@ -275,19 +285,21 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, g, o, lse)
+    )(q_off, q, k, v, g, o, lse)
 
     # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
     q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
     kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
     lse_spec_t = pl.BlockSpec((1, 1, bq, LSE_LANES),
                               lambda b, h, j, i: (b, h, i, 0))
+    off_spec_t = pl.BlockSpec((1, 1), lambda b, h, j, i: (0, 0),
+                              memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, num_q=num_q),
         grid=(B, H, num_k, num_q),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, q_spec_t,
-                  lse_spec_t],
+        in_specs=[off_spec_t, q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+                  q_spec_t, lse_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -297,7 +309,7 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, g, o, lse)
+    )(q_off, q, k, v, g, o, lse)
     return dq, dk, dv
 
 
@@ -306,33 +318,39 @@ def _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhsd(q, k, v, q_off, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_bhsd_fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse, q_off)
 
 
 def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret)
+    q, k, v, o, lse, q_off = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k,
+                      interpret)
+    return dq, dk, dv, None  # int offset gets no cotangent
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128,
+                    block_k: int = 128, q_offset=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] arrays (model layout).
 
     Heads must already be GQA-expanded (models/layers.py repeats KV heads
     before calling `attn_fn`). Differentiable via the Pallas backward
     kernels. `interpret=None` auto-selects interpreter mode off-TPU.
+
+    `q_offset` (int or traced scalar) is q's global position within the
+    K/V sequence — sequence-parallel shards hold a slice of the queries
+    against the full keys, so causal masking needs the true row index.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -340,10 +358,12 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     if D > LANES and D % LANES:
         raise NotImplementedError(
             f"head_dim {D} > {LANES} must be a multiple of {LANES}")
+    off = jnp.asarray(0 if q_offset is None else q_offset,
+                      jnp.int32).reshape(1, 1)
     qT = q.transpose(0, 2, 1, 3)  # [B,H,S,D]
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qT, kT, vT, causal, block_q, block_k, interpret)
+    out = _flash_bhsd(qT, kT, vT, off, causal, block_q, block_k, interpret)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -386,3 +406,36 @@ def make_flash_attention(mesh: Mesh,
         return sharded(q, k, v)
 
     return attn
+
+
+def make_sp_flash_attention(mesh: Mesh, seq_axis: str = "sp",
+                            batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                            head_axis: str = "tp", causal: bool = True,
+                            interpret: Optional[bool] = None):
+    """Sequence-parallel flash attention: all-gathered K/V, sharded Q.
+
+    The compute-optimal long-context alternative to ring attention
+    (parallel/ring_attention.py): each sp shard holds its query slice,
+    all-gathers the full K/V once over the ICI ring, and runs the tiled
+    MXU kernel with its global `q_offset` for causal masking — backward
+    reverses the all-gather into a reduce-scatter automatically. Memory
+    is O(S) per device for K/V (vs ring's O(S/n)), so prefer ring when
+    the gathered K/V wouldn't fit HBM.
+    """
+    n_shards = mesh.shape.get(seq_axis, 1)
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch, seq_axis if n_shards > 1 else None, head, None)
+
+    def local_fn(q, k, v):
+        if n_shards > 1:
+            k = jax.lax.all_gather(k, seq_axis, axis=1, tiled=True)
+            v = jax.lax.all_gather(v, seq_axis, axis=1, tiled=True)
+            off = jax.lax.axis_index(seq_axis) * q.shape[1]
+        else:
+            off = 0
+        return flash_attention(q, k, v, causal=causal, q_offset=off,
+                               interpret=interpret)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

@@ -89,7 +89,18 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
                 "`causal_attention`; declare it so kernel injection can't "
                 "silently change masking")
         if plan.sp > 1:
-            attn_fn = make_ring_attention(mesh, causal=causal)
+            # Ring (default) streams K/V blocks at O(S/n) memory; the
+            # flash variant all-gathers K/V once and runs the MXU-tiled
+            # kernel with per-shard q offsets — faster when the gathered
+            # K/V fits HBM. VODA_SP_ATTENTION=flash opts in.
+            if os.environ.get("VODA_SP_ATTENTION") == "flash":
+                from vodascheduler_tpu.ops import make_sp_flash_attention
+                attn_fn = make_sp_flash_attention(
+                    mesh, causal=causal,
+                    interpret=(None if jax.default_backend() == "tpu"
+                               else True))
+            else:
+                attn_fn = make_ring_attention(mesh, causal=causal)
         elif _flash_attention_enabled():
             from vodascheduler_tpu.ops import make_flash_attention
             attn_fn = make_flash_attention(mesh, causal=causal)
