@@ -311,3 +311,39 @@ def test_all_algorithms_produce_valid_allocations(name, capacity):
     result = new_algorithm(name).schedule(jobs, capacity)
     validate_result(capacity, result, jobs)
     assert set(result) == {"a", "b", "c", "d"}
+
+
+class TestElasticTiresiasLease:
+    """The TPU lease delta (elastic_tiresias.py LEASE_SECONDS): a running
+    job inside its lease keeps >= min ahead of normal queue order, because
+    every preemption is a checkpoint-restart."""
+
+    def test_recently_started_job_keeps_min_over_new_arrival(self):
+        from vodascheduler_tpu.common.types import JobStatus
+
+        # b is running, demoted to queue 1 (high chip time overall), but
+        # (re)started only 60s ago; a is a fresh queue-0 arrival. Without
+        # the lease, a (queue 0) would take the only 2 chips and evict b.
+        a = make_job("a", num_chips=2, min_chips=2, max_chips=2,
+                     first_start_time=5000.0)
+        b = make_job("b", num_chips=2, min_chips=2, max_chips=2,
+                     first_start_time=1.0, status=JobStatus.RUNNING)
+        b.metrics.chip_seconds = 10 * 3600.0   # queue-1 demotion territory
+        b.metrics.last_chip_seconds = 2 * 3600.0
+        b.priority = 1
+        b.metrics.seconds_since_restart = 60.0  # just restarted
+        result = ElasticTiresias().schedule([a, b], total_chips=2)
+        assert result == {"a": 0, "b": 2}
+
+    def test_lease_expired_job_yields_to_higher_queue(self):
+        from vodascheduler_tpu.algorithms import elastic_tiresias as et
+        from vodascheduler_tpu.common.types import JobStatus
+
+        a = make_job("a", num_chips=2, min_chips=2, max_chips=2,
+                     first_start_time=5000.0)
+        b = make_job("b", num_chips=2, min_chips=2, max_chips=2,
+                     first_start_time=1.0, status=JobStatus.RUNNING)
+        b.priority = 1
+        b.metrics.seconds_since_restart = et.LEASE_SECONDS + 1.0
+        result = ElasticTiresias().schedule([a, b], total_chips=2)
+        assert result == {"a": 2, "b": 0}

@@ -1,0 +1,114 @@
+"""Slice-shape feasibility on the allocation path (VERDICT r1 item 3).
+
+The reference's allocator deals in fungible GPUs (utils.go:18-42); on a
+TPU torus a grant must admit a contiguous sub-slice. These tests drive
+ResourceAllocator with a PoolTopology and assert the post-pass invariants.
+"""
+
+import pytest
+
+from vodascheduler_tpu.algorithms.base import (
+    InvalidAllocationError,
+    validate_result,
+)
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.allocator.allocator import (
+    AllocationRequest,
+    enforce_feasibility,
+)
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.placement.topology import PoolTopology
+
+TOPO = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+
+
+def job(name, min_chips=1, max_chips=8, submit=0.0):
+    spec = JobSpec(name=name, config=JobConfig(min_num_chips=min_chips,
+                                               max_num_chips=max_chips))
+    return TrainingJob.from_spec(spec, submit_time=submit)
+
+
+def test_infeasible_grant_rounds_down_and_redistributes():
+    jobs = [job("a", 1, 5), job("b", 1, 5)]
+    result = enforce_feasibility({"a": 5, "b": 5}, jobs, 64, TOPO)
+    # 5 has no contiguous sub-torus on 4x4x4 (VERDICT: "the allocator
+    # happily grants 5 chips"); both round to 4, the remainder can't lift
+    # anyone (next feasible 8 > max 5).
+    assert result == {"a": 4, "b": 4}
+    validate_result(64, result, jobs, topology=TOPO)
+
+
+def test_remainder_lifts_jobs_to_next_feasible():
+    jobs = [job("a", 1, 16), job("b", 1, 16)]
+    result = enforce_feasibility({"a": 7, "b": 7}, jobs, 16, TOPO)
+    # 7 -> 4 each, remainder 8 lifts both to their next feasible count 8.
+    assert result == {"a": 8, "b": 8}
+    validate_result(16, result, jobs, topology=TOPO)
+
+
+def test_min_above_feasible_rounding_is_rescued_or_zeroed():
+    # min=5: rounding 6 -> 4 < min would strand the job; the second pass
+    # lifts it to the next feasible count above the grant (8) when chips
+    # allow.
+    jobs = [job("a", 5, 12)]
+    result = enforce_feasibility({"a": 6}, jobs, 64, TOPO)
+    assert result == {"a": 8}
+    validate_result(64, result, jobs, topology=TOPO)
+    # ...and zeroes it when they don't.
+    result = enforce_feasibility({"a": 6}, jobs, 6, TOPO)
+    assert result == {"a": 0}
+
+
+def test_feasible_grants_are_never_inflated():
+    # A grant that is already feasible is its own ceiling: spare capacity
+    # must not inflate it (every grant change is a checkpoint-restart, and
+    # e.g. ElasticTiresias deliberately leaves zero-marginal-gain chips
+    # free — code-review r2 finding).
+    jobs = [job("a", 4, 16)]
+    result = enforce_feasibility({"a": 4}, jobs, 64, TOPO)
+    assert result == {"a": 4}
+
+
+def test_lift_is_bounded_by_nearest_feasible_above_grant():
+    # Grant 6 (infeasible) may move to 8 — never past it to max (12).
+    jobs = [job("a", 5, 12)]
+    result = enforce_feasibility({"a": 6}, jobs, 64, TOPO)
+    assert result == {"a": 8}
+    validate_result(64, result, jobs, topology=TOPO)
+
+
+def test_whole_host_tiling_required_for_multi_host_counts():
+    from vodascheduler_tpu.placement.topology import is_feasible_count
+    # 36 = 3x3x4 fits the (4,4,4) torus as raw chips, but no union of
+    # whole 2x2x1 hosts forms that box (36/4 = 9 has no shape within the
+    # (2,2,4) host grid) — code-review r2 finding.
+    assert not is_feasible_count(36, TOPO)
+    assert is_feasible_count(32, TOPO)   # 8 hosts as 2x2x2 blocks x ...
+    jobs = [job("a", 1, 64)]
+    with pytest.raises(InvalidAllocationError):
+        validate_result(64, {"a": 36}, jobs, topology=TOPO)
+
+
+def test_sub_host_grants_round_within_host_block():
+    jobs = [job("a", 1, 3)]
+    result = enforce_feasibility({"a": 3}, jobs, 64, TOPO)
+    assert result == {"a": 2}  # 3 doesn't tile a 2x2x1 host block
+
+
+def test_allocator_applies_topology_end_to_end():
+    store = JobStore()
+    allocator = ResourceAllocator(store)
+    jobs = [job("a", 1, 5, submit=1.0), job("b", 1, 5, submit=2.0)]
+    result = allocator.allocate(AllocationRequest(
+        scheduler_id="pool", num_chips=64, algorithm="ElasticFIFO",
+        ready_jobs=jobs, topology=TOPO))
+    assert all(n in (0, 1, 2, 4) for n in result.values()), result
+    validate_result(64, result, jobs, topology=TOPO)
+
+
+def test_validate_result_rejects_infeasible_with_topology():
+    jobs = [job("a", 1, 8)]
+    validate_result(64, {"a": 5}, jobs)  # fungible-count rules: fine
+    with pytest.raises(InvalidAllocationError):
+        validate_result(64, {"a": 5}, jobs, topology=TOPO)

@@ -66,10 +66,11 @@ def test_job_runs_to_completion(backend, tmp_path):
 def test_scale_restarts_with_checkpoint(backend, tmp_path):
     events = []
     backend.set_event_callback(events.append)
-    backend.start_job(_spec("job-b", epochs=4, steps=5), num_workers=2)
+    backend.start_job(_spec("job-b", epochs=25, steps=10), num_workers=2)
 
     ckpt_dir = str(tmp_path / "job-b" / "ckpt")
-    # Wait for the first epoch checkpoint, then resize 2 -> 4.
+    # Wait for the first epoch checkpoint, then resize 2 -> 4 (the job is
+    # long enough that it cannot drain before the resize lands).
     assert _wait(lambda: latest_step(ckpt_dir) is not None), \
         open(tmp_path / "job-b" / "supervisor.log").read()
     saved = latest_step(ckpt_dir)
@@ -78,8 +79,8 @@ def test_scale_restarts_with_checkpoint(backend, tmp_path):
     assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
                              for e in events)), \
         open(tmp_path / "job-b" / "supervisor.log").read()
-    assert latest_step(ckpt_dir) == 20  # progress preserved across restart
-    assert saved <= 20
+    assert latest_step(ckpt_dir) == 250  # progress preserved across restart
+    assert saved <= 250
     rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-b.csv"))
     workers_seen = {int(r["workers"]) for r in rows}
     assert 4 in workers_seen  # finished at the new size

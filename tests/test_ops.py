@@ -210,3 +210,47 @@ def test_train_step_with_sp_flash_attention(monkeypatch):
                            devices=jax.devices()[:8])
     loss = session.run_steps(1)
     assert np.isfinite(loss)
+
+
+def test_tiny_block_seq_falls_back_to_xla(caplog):
+    """Odd-factor sequence lengths (S=257 -> 1-wide blocks) must take the
+    XLA path instead of a pathologically fine Pallas grid, with a one-time
+    warning (ADVICE r1)."""
+    import logging
+
+    import importlib
+
+    fa_mod = importlib.import_module(
+        "vodascheduler_tpu.ops.flash_attention")  # __init__ shadows the name
+    fa_mod._warned.clear()
+    q, k, v = _qkv(20, B=1, S=257, H=1, D=32)
+    with caplog.at_level(logging.WARNING,
+                         logger="vodascheduler_tpu.ops.flash_attention"):
+        out = flash_attention(q, k, v, interpret=True)
+        out2 = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(out2, ref, atol=3e-5, rtol=3e-5)
+    warnings = [r for r in caplog.records if "XLA attention path" in r.message]
+    assert len(warnings) == 1  # warned once, not per call
+
+
+def test_sharded_fallback_warns_once(caplog):
+    """The mesh-indivisibility fallback (silent perf cliff) must log once
+    (ADVICE r1)."""
+    import logging
+
+    import importlib
+
+    fa_mod = importlib.import_module(
+        "vodascheduler_tpu.ops.flash_attention")  # __init__ shadows the name
+    fa_mod._warned.clear()
+    mesh = build_mesh(MeshPlan(dp=2, tp=4), jax.devices()[:8])
+    fn = make_flash_attention(mesh, interpret=True)
+    q, k, v = _qkv(21, B=4, S=32, H=3, D=16)  # 3 heads, tp=4
+    with caplog.at_level(logging.WARNING,
+                         logger="vodascheduler_tpu.ops.flash_attention"):
+        fn(q, k, v)
+        fn(q, k, v)
+    warnings = [r for r in caplog.records if "falling" in r.message]
+    assert len(warnings) == 1

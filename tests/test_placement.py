@@ -240,3 +240,39 @@ class TestDefragment:
                      [[(hs.host, hs.num_slots) for hs in jp.host_slots]
                       for jp in sched.placement_manager.job_placements.values()])
         assert placed == sum(sched.job_num_chips.values())
+
+
+class TestFeasibilityRounding:
+    """round_to_feasible / next_feasible_above — the slice-shape feasibility
+    vocabulary on the allocation path (VERDICT r1 item 3)."""
+
+    def setup_method(self):
+        from vodascheduler_tpu.placement.topology import PoolTopology
+        self.topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+
+    def test_sub_host_counts_round_within_host_block(self):
+        from vodascheduler_tpu.placement.topology import round_to_feasible
+        # host block 2x2x1: 1, 2, 4 feasible; 3 rounds to 2
+        assert round_to_feasible(1, self.topo) == 1
+        assert round_to_feasible(2, self.topo) == 2
+        assert round_to_feasible(3, self.topo) == 2
+
+    def test_multi_host_counts_whole_host_subtorus(self):
+        from vodascheduler_tpu.placement.topology import round_to_feasible
+        assert round_to_feasible(4, self.topo) == 4
+        assert round_to_feasible(5, self.topo) == 4   # the VERDICT example
+        assert round_to_feasible(7, self.topo) == 4
+        assert round_to_feasible(8, self.topo) == 8
+        assert round_to_feasible(64, self.topo) == 64
+
+    def test_next_feasible_above(self):
+        from vodascheduler_tpu.placement.topology import next_feasible_above
+        assert next_feasible_above(2, self.topo) == 4
+        assert next_feasible_above(4, self.topo) == 8
+        assert next_feasible_above(64, self.topo) is None
+
+    def test_is_feasible_count(self):
+        from vodascheduler_tpu.placement.topology import is_feasible_count
+        assert is_feasible_count(0, self.topo)
+        assert is_feasible_count(8, self.topo)
+        assert not is_feasible_count(5, self.topo)

@@ -63,6 +63,7 @@ def _longer_wins(short: TrainingJob, long_: TrainingJob, result: ScheduleResult)
 
 class AFSL(SchedulerAlgorithm):
     name = "AFS-L"
+    elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {j.name: 0 for j in jobs}

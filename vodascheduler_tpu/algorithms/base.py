@@ -9,10 +9,13 @@ error the caller can surface, and the same checks double as test oracles.
 from __future__ import annotations
 
 import abc
-from typing import Iterable, List
+from typing import TYPE_CHECKING, Iterable, List, Optional
 
 from vodascheduler_tpu.common.job import TrainingJob
 from vodascheduler_tpu.common.types import ScheduleResult
+
+if TYPE_CHECKING:
+    from vodascheduler_tpu.placement.topology import PoolTopology
 
 
 class InvalidAllocationError(AssertionError):
@@ -20,11 +23,15 @@ class InvalidAllocationError(AssertionError):
 
 
 def validate_result(total_chips: int, result: ScheduleResult,
-                    jobs: Iterable[TrainingJob]) -> None:
+                    jobs: Iterable[TrainingJob],
+                    topology: Optional["PoolTopology"] = None) -> None:
     """Invariants (reference: utils.go:18-42):
       - every allocation is >= 0
       - a nonzero allocation is within [min_num_chips, max_num_chips]
       - Σ allocations <= total_chips
+      - with a topology: every allocation is slice-shape feasible (the TPU
+        delta SURVEY.md §7 adds to the reference's fungible-GPU checks —
+        a count with no contiguous sub-torus must never reach the backend)
     """
     bounds = {j.name: (j.config.min_num_chips, j.config.max_num_chips) for j in jobs}
     allocated = 0
@@ -42,6 +49,14 @@ def validate_result(total_chips: int, result: ScheduleResult,
     if allocated > max(0, total_chips):
         raise InvalidAllocationError(
             f"total allocated {allocated} exceeds capacity {total_chips}")
+    if topology is not None:
+        from vodascheduler_tpu.placement.topology import is_feasible_count
+        for job, n in result.items():
+            if not is_feasible_count(n, topology):
+                raise InvalidAllocationError(
+                    f"{job}: allocation {n} has no contiguous slice shape "
+                    f"on torus {topology.torus_dims} "
+                    f"(host block {topology.host_block})")
 
 
 def allocate_minimums(ordered: List[TrainingJob], result: ScheduleResult,
@@ -60,6 +75,11 @@ class SchedulerAlgorithm(abc.ABC):
     """Reference: SchedulerAlgorithm interface (types.go:19-25)."""
 
     name: str = ""
+    # Whether the algorithm hands out chips beyond job minimums (the
+    # Elastic* family, FfDL, AFS-L). Metadata for status surfaces; the
+    # feasibility post-pass itself is elasticity-agnostic because it never
+    # moves a grant past its nearest feasible neighbor.
+    elastic: bool = False
 
     def __init__(self, scheduler_id: str = ""):
         self.scheduler_id = scheduler_id

@@ -21,6 +21,7 @@ from vodascheduler_tpu.common.types import ScheduleResult
 
 class ElasticFIFO(SchedulerAlgorithm):
     name = "ElasticFIFO"
+    elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}

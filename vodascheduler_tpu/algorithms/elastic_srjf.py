@@ -20,6 +20,7 @@ from vodascheduler_tpu.common.types import ScheduleResult
 
 class ElasticSRJF(SchedulerAlgorithm):
     name = "ElasticSRJF"
+    elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}

@@ -36,6 +36,7 @@ from vodascheduler_tpu.common.types import ScheduleResult
 
 class FfDLOptimizer(SchedulerAlgorithm):
     name = "FfDLOptimizer"
+    elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {j.name: 0 for j in jobs}

@@ -18,20 +18,83 @@ import time
 from typing import List, Optional
 
 from vodascheduler_tpu.algorithms import new_algorithm
+from vodascheduler_tpu.algorithms.base import validate_result
 from vodascheduler_tpu.common.job import TrainingJob, base_job_info
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import ScheduleResult
+from vodascheduler_tpu.placement.topology import (
+    PoolTopology,
+    is_feasible_count,
+    next_feasible_above,
+    round_to_feasible,
+)
 
 
 @dataclasses.dataclass
 class AllocationRequest:
-    """Reference: AllocationRequest (pkg/allocator/allocator/types.go:5-10)."""
+    """Reference: AllocationRequest (pkg/allocator/allocator/types.go:5-10).
+
+    TPU delta: the optional `topology` turns chip counts into slice-shape
+    commitments — the allocator's grants are rounded to counts that admit
+    a contiguous sub-torus (SURVEY.md §7 "allocation unit" delta; the
+    reference's GPUs are fungible so utils.go:18-42 never needed this).
+    """
 
     scheduler_id: str
     num_chips: int
     algorithm: str
     ready_jobs: List[TrainingJob]
+    topology: Optional[PoolTopology] = None
+
+
+def enforce_feasibility(result: ScheduleResult, jobs: List[TrainingJob],
+                        total_chips: int,
+                        topology: PoolTopology) -> ScheduleResult:
+    """Round every grant to the slice-shape-feasible count *nearest* it.
+
+    Algorithms reason in fungible chip counts (their speedup curves are
+    keyed by count); this post-pass maps each grant onto the pool's torus
+    with minimal distortion: an infeasible grant moves down to the largest
+    feasible count below it, or — when capacity allows and the rounded
+    count would violate the job's min — up to the smallest feasible count
+    above it. A grant is never moved past its nearest feasible neighbors:
+    chips an algorithm deliberately left free (e.g. ElasticTiresias's
+    zero-marginal-gain stop) stay free, because every grant change is a
+    checkpoint-restart of the receiving job. Jobs whose min cannot be met
+    feasibly within spare capacity are zeroed (min-or-nothing, as in
+    allocate_minimums). Never exceeds capacity or a job's max.
+    """
+    bounds = {j.name: (j.config.min_num_chips, j.config.max_num_chips)
+              for j in jobs}
+    out: ScheduleResult = {}
+    for job, n in result.items():
+        lo, _hi = bounds.get(job, (0, n))
+        f = round_to_feasible(n, topology)
+        out[job] = f if f >= max(lo, 1) else 0
+    free = max(0, total_chips) - sum(out.values())
+
+    # Second pass, largest rounding loss first: move each distorted grant
+    # up to its ceiling — the smallest feasible count >= the original
+    # grant — when spare capacity covers the difference. This both rescues
+    # min-violating roundings (grant 6, min 5 -> 8) and recovers chips the
+    # rounding stranded (7 -> 4 becomes 7 -> 8 when free), while a grant
+    # that was already feasible is its own ceiling and never inflates.
+    by_loss = sorted(result.items(),
+                     key=lambda kv: kv[1] - out.get(kv[0], 0), reverse=True)
+    for job, n in by_loss:
+        if n <= 0 or out[job] == n:
+            continue
+        lo, hi = bounds.get(job, (0, n))
+        ceiling = n if is_feasible_count(n, topology) else \
+            next_feasible_above(n, topology)
+        if ceiling is None or ceiling > hi:
+            continue
+        cost = ceiling - out[job]
+        if 0 < cost <= free:
+            out[job] = ceiling
+            free -= cost
+    return out
 
 
 class ResourceAllocator:
@@ -58,6 +121,11 @@ class ResourceAllocator:
             self.m_info_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
         t0 = time.monotonic()
         result = algo.schedule(request.ready_jobs, request.num_chips)
+        if request.topology is not None:
+            result = enforce_feasibility(result, request.ready_jobs,
+                                         request.num_chips, request.topology)
+            validate_result(request.num_chips, result, request.ready_jobs,
+                            topology=request.topology)
         self.m_algo_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
         return result
 

@@ -11,7 +11,11 @@ interface:
   trace replay.
 - `local.LocalBackend`: real JAX trainer processes (runtime/supervisor.py)
   on the local machine's TPU chips.
+- `multihost.MultiHostBackend`: one supervisor process per host with a
+  backend-issued jax.distributed coordinator — the multi-host execution
+  substrate (hermetic multi-process CPU emulation of a TPU pod).
 """
 
 from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent
 from vodascheduler_tpu.cluster.local import LocalBackend
+from vodascheduler_tpu.cluster.multihost import MultiHostBackend

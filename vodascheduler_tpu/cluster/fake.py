@@ -248,6 +248,13 @@ class FakeClusterBackend(ClusterBackend):
             self.busy_chip_seconds += dt * sim.num_workers
         sim.last_update = now
 
+    def sync_accounting(self) -> None:
+        """Bring every job's busy-chip-second integral up to the current
+        clock time — utilization readers (replay steady-state windows)
+        sample between events, where lazy per-job accrual would lag."""
+        for sim in self.jobs.values():
+            self._accrue(sim)
+
     def _schedule_next_event(self, sim: _SimJob) -> None:
         """Schedule the next epoch-completion (or failure) timer."""
         if sim.num_workers <= 0:

@@ -184,11 +184,18 @@ class LocalBackend(ClusterBackend):
                     self._specs.pop(name, None)
                     self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED,
                                            name, timestamp=time.time()))
-                elif code != PREEMPTED_EXIT_CODE:
+                else:
+                    # Includes a PREEMPTED exit the backend did not request
+                    # (external SIGTERM): surface it rather than stranding
+                    # a job the scheduler still believes is running.
                     self._specs.pop(name, None)
+                    detail = (f"preempted outside scheduler control "
+                              f"(exit code {code})"
+                              if code == PREEMPTED_EXIT_CODE
+                              else f"exit code {code}")
                     self.emit(ClusterEvent(
                         ClusterEventKind.JOB_FAILED, name,
-                        detail=f"exit code {code}", timestamp=time.time()))
+                        detail=detail, timestamp=time.time()))
             with self._lock:
                 # Idle-exit decided under the same lock that registers new
                 # processes, so a job started after the poll above cannot be

@@ -91,6 +91,13 @@ class JobMetrics:
     last_waiting_seconds: float = 0.0
     last_chip_seconds: float = 0.0
 
+    # Running time since the last checkpoint-restart of ANY kind — start
+    # AND resize reset it (unlike last_running_seconds, which only resets
+    # on zero<->nonzero flips). Drives the ElasticTiresias preemption
+    # lease: "restarted recently" must include restarted-by-resize, or a
+    # just-resized job could be evicted back-to-back.
+    seconds_since_restart: float = 0.0
+
     first_start_time: float = MAX_TIME
     last_update_time: float = 0.0
 

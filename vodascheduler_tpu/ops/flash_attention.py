@@ -29,6 +29,7 @@ that replaces them (runtime/train.py wires it in as `attn_fn`).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional, Tuple
 
 import jax
@@ -42,7 +43,12 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+logger = logging.getLogger(__name__)
+
 NEG_INF = -1e30  # finite: avoids inf-inf NaNs in the running-max updates
+# Blocks thinner than this thrash the grid (an Sq*Sk sweep of near-scalar
+# kernel invocations); below it the XLA path wins, so fall back loudly.
+MIN_BLOCK = 8
 LANES = 128
 # The logsumexp is per-row; persisting it lane-replicated would be 128x
 # the HBM traffic/footprint, so the output array keeps a single lane
@@ -56,6 +62,17 @@ def _pick_block(seq: int, preferred: int) -> int:
     while seq % b:
         b //= 2
     return max(b, 1)
+
+
+_warned = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    """Perf-cliff fallbacks are silent correctness-wise; log them once so
+    a production regression is diagnosable from the job log."""
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg)
 
 
 def _bcast_lanes(x: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -358,6 +375,22 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     if D > LANES and D % LANES:
         raise NotImplementedError(
             f"head_dim {D} > {LANES} must be a multiple of {LANES}")
+    # Odd-factor sequence lengths (e.g. S=257) drive _pick_block down to
+    # near-1 blocks — a pathologically fine grid. The XLA path is faster
+    # there; sp-sharded calls (traced q_offset) can't take it because it
+    # has no offset plumbing, so they keep the tiny-block kernel.
+    bq = _pick_block(q.shape[1], block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    if min(bq, bk) < MIN_BLOCK and q_offset is None:
+        _warn_once(
+            f"tiny-block-{q.shape[1]}x{k.shape[1]}",
+            f"flash_attention: seq lengths {q.shape[1]}/{k.shape[1]} only "
+            f"admit {bq}x{bk} blocks (< {MIN_BLOCK}); using the XLA "
+            "attention path instead — pad sequences to a power-of-two "
+            "multiple to re-enable the Pallas kernel")
+        from vodascheduler_tpu.parallel.ring_attention import (
+            reference_attention)
+        return reference_attention(q, k, v, causal=causal)
     off = jnp.asarray(0 if q_offset is None else q_offset,
                       jnp.int32).reshape(1, 1)
     qT = q.transpose(0, 2, 1, 3)  # [B,H,S,D]
@@ -400,6 +433,13 @@ def make_flash_attention(mesh: Mesh,
 
     def attn(q, k, v):
         if q.shape[0] % batch_size or q.shape[2] % head_size:
+            _warn_once(
+                f"indivisible-{q.shape[0]}x{q.shape[2]}-{batch_size}x{head_size}",
+                f"make_flash_attention: batch {q.shape[0]} % {batch_size} "
+                f"or heads {q.shape[2]} % {head_size} nonzero — falling "
+                "back to the O(S^2) XLA attention path for this shape "
+                "(elasticity contract: correctness over speed); pick a "
+                "mesh plan dividing batch/heads to restore the kernel")
             from vodascheduler_tpu.parallel.ring_attention import (
                 reference_attention)
             return reference_attention(q, k, v, causal=causal)

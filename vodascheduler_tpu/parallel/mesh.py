@@ -21,11 +21,14 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+if TYPE_CHECKING:  # placement deps stay out of the import graph at runtime
+    from vodascheduler_tpu.placement.topology import PoolTopology, SliceShape
 
 AXES = ("dp", "fsdp", "tp", "sp", "ep")
 
@@ -64,7 +67,9 @@ def plan_mesh(num_chips: int,
               seq_len: int = 0,
               num_experts: int = 0,
               max_tp: int = 4,
-              chips_per_host: int = 4) -> MeshPlan:
+              chips_per_host: int = 4,
+              topology: Optional["PoolTopology"] = None,
+              slice_shape: Optional["SliceShape"] = None) -> MeshPlan:
     """Pick axis sizes for a chip count and model scale.
 
     Heuristics (scaling-book defaults):
@@ -73,7 +78,19 @@ def plan_mesh(num_chips: int,
       stay intra-host; fsdp over the rest (param memory scales down).
     - long sequences (>= 32k): give sp a factor (ring attention).
     - MoE: ep divides the expert count.
+
+    `topology` (placement/topology.py PoolTopology) replaces the
+    chips_per_host default with the pool's real host block size, so the
+    "tp stays intra-host" property holds on v5e-style 1/8-chip hosts as
+    well as the v4/v5p 4-chip default. `slice_shape` is the granted
+    contiguous sub-torus for this job (the allocator's unit after
+    feasibility rounding); its chip count overrides `num_chips` so the
+    mesh always matches the grant exactly.
     """
+    if slice_shape is not None:
+        num_chips = slice_shape.num_chips
+    if topology is not None:
+        chips_per_host = topology.chips_per_host
     if num_chips <= 0:
         raise ValueError("num_chips must be positive")
     remaining = num_chips
@@ -108,6 +125,12 @@ def build_mesh(plan: MeshPlan,
     if len(devices) < plan.num_chips:
         raise ValueError(
             f"mesh plan needs {plan.num_chips} devices, have {len(devices)}")
+    # Host-major device order: the multi-host backend assigns process ids
+    # in the placement manager's host order (cluster/multihost.py), so
+    # sorting by (process_index, local id) makes tp — the innermost mesh
+    # axis — span consecutive chips of one host before crossing hosts.
+    devices.sort(key=lambda d: (getattr(d, "process_index", 0),
+                                getattr(d, "id", 0)))
     devices = devices[:plan.num_chips]
     shape = (plan.dp, plan.fsdp, plan.sp, plan.ep, plan.tp)
     arr = np.array(devices, dtype=object).reshape(shape)

@@ -125,6 +125,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _ambient_mesh_active() -> bool:
+    """Whether a mesh context is active at trace time.
+
+    Covers both mesh-context mechanisms: the new sharding-in-types
+    context (`jax.sharding.use_mesh`, visible via get_abstract_mesh) and
+    the legacy `with Mesh(...)` context train.py uses, which only the
+    thread-resources env reflects inside a jit trace (get_mesh() is
+    outside-jit-only as of jax 0.9).
+    """
+    if not jax.sharding.get_abstract_mesh().empty:
+        return True
+    try:
+        from jax._src import mesh as _mesh_lib
+        return not _mesh_lib.thread_resources.env.physical_mesh.empty
+    except Exception:  # pragma: no cover - internal layout changed
+        # Can't tell: assume active so mis-sharding errors stay loud.
+        return True
+
+
 def constrain_batch_activation(x: jax.Array) -> jax.Array:
     """Pin an activation's leading (batch) dim to the data axes.
 
@@ -138,14 +157,9 @@ def constrain_batch_activation(x: jax.Array) -> jax.Array:
     under one (train.py) — and no-ops when there is none, keeping
     modules usable standalone.
     """
-    try:
-        # Mirror batch_sharding: batch over the data axes, seq over sp
-        # (sp=1 meshes make the seq axis a no-op; sp>1 meshes already
-        # shard the token batch this way, so divisibility holds).
-        return jax.lax.with_sharding_constraint(x, P(("dp", "fsdp"), "sp"))
-    except RuntimeError as e:
-        # Only the documented standalone case (no ambient mesh) may
-        # no-op; anything else is a real sharding error and stays loud.
-        if "mesh" in str(e).lower():
-            return x
-        raise
+    if not _ambient_mesh_active():
+        return x
+    # Mirror batch_sharding: batch over the data axes, seq over sp
+    # (sp=1 meshes make the seq axis a no-op; sp>1 meshes already
+    # shard the token batch this way, so divisibility holds).
+    return jax.lax.with_sharding_constraint(x, P(("dp", "fsdp"), "sp"))

@@ -72,8 +72,8 @@ def feasible_shapes(num_chips: int, torus_dims: Sequence[int]) -> List[SliceShap
 
     Compactness = lower surface-to-volume; compact slices keep collective
     hops short on ICI. Power-of-two chip counts on power-of-two tori always
-    have a feasible shape; other counts may not (the scheduler rounds chip
-    counts to feasible ones via nearest_feasible_count)."""
+    have a feasible shape; other counts may not (the allocation path rounds
+    chip counts to feasible ones via round_to_feasible)."""
     shapes = [SliceShape(t) for t in _divisor_shapes(num_chips, torus_dims)]
     # Sort by perimeter (sum of dims): the most cube-like first.
     shapes.sort(key=lambda s: (sum(s.dims), max(s.dims)))
@@ -82,18 +82,50 @@ def feasible_shapes(num_chips: int, torus_dims: Sequence[int]) -> List[SliceShap
     return shapes
 
 
-def nearest_feasible_count(n: int, torus_dims: Sequence[int],
-                           granularity: int = 1) -> int:
-    """Largest chip count <= n that admits a contiguous sub-torus shape and
-    is a multiple of `granularity` (host block size when jobs must own whole
-    hosts). Returns 0 if none."""
-    total = math.prod(torus_dims)
-    for k in range(min(n, total), 0, -1):
-        if k % granularity != 0:
-            continue
-        if _divisor_shapes(k, torus_dims):
+def round_to_feasible(n: int, topology: "PoolTopology") -> int:
+    """Largest feasible chip count <= n on this pool.
+
+    Feasible = a contiguous sub-block of one host (sub-host jobs share a
+    host's chips like the reference's fractional-node GPU jobs), or a
+    whole-host-granular contiguous sub-torus (multi-host jobs own whole
+    hosts — the unit that runs one runtime process). This is the TPU
+    shape-feasibility check SURVEY.md §7 derives from `map[job]int`
+    becoming `map[job]sliceShape` (reference invariant enforcement:
+    pkg/algorithm/utils.go:18-42 has no such notion — GPUs are fungible).
+    """
+    for k in range(min(n, topology.total_chips), 0, -1):
+        if is_feasible_count(k, topology):
             return k
     return 0
+
+
+def next_feasible_above(n: int, topology: "PoolTopology") -> Optional[int]:
+    """Smallest feasible chip count > n, or None if the pool tops out."""
+    for k in range(n + 1, topology.total_chips + 1):
+        if is_feasible_count(k, topology):
+            return k
+    return None
+
+
+def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
+    """O(1)-ish direct check (one factorization enumeration, no scan) —
+    this sits on the allocation hot path via enforce_feasibility and
+    validate_result.
+
+    Multi-host slices must be a contiguous block of *whole hosts*, i.e. a
+    sub-grid of the host grid scaled by the host block — so the check
+    factorizes n / chips_per_host over the host grid, not n over the raw
+    torus (e.g. 36 chips on a (4,4,4)/(2,2,1) pool factor as 3x3x4 chips,
+    but no union of whole 2x2x1 hosts forms that box: infeasible).
+    """
+    if n == 0:
+        return True
+    if n < 0:
+        return False
+    cph = topology.chips_per_host
+    if n < cph:
+        return bool(_divisor_shapes(n, topology.host_block))
+    return n % cph == 0 and bool(_divisor_shapes(n // cph, topology.host_grid))
 
 
 @dataclasses.dataclass

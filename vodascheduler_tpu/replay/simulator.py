@@ -43,6 +43,11 @@ class ReplayReport:
     # denominator when the trace's ramp-up and drain-down tails cannot
     # physically fill the fleet
     attainable_utilization: float
+    # raw utilization restricted to the demand-saturated windows (Σ ready
+    # max >= capacity): in steady state the denominator IS the full fleet,
+    # so this is the un-caveated number the BASELINE north star asks for.
+    steady_state_utilization: float
+    steady_state_seconds: float
     total_chips: int
     restarts_total: int
     rescheds_total: float
@@ -112,33 +117,70 @@ class ReplayHarness:
         self._first_submit_at: Optional[float] = None
         self._attainable_chip_seconds = 0.0
         self._attainable_last_t: Optional[float] = None
-        self._sample_attainable()
+        self._attainable_current = 0.0
+        self._sat_capacity_cs = 0.0   # ∫ capacity over saturated windows
+        self._sat_busy_cs = 0.0       # busy chip-seconds within them
+        self._sat_seconds = 0.0
+        self._busy_at_last_accrue = 0.0
+
+        # Event-exact attainable-capacity integration: demand changes only
+        # on submission and on cluster events (completion/failure/host
+        # churn), so accruing the piecewise-constant value right before the
+        # scheduler processes each event — and re-reading it right after —
+        # integrates min(capacity, Σ ready max) exactly, with no sampling
+        # grid. (The scheduler registered its callback in its ctor; wrap it.)
+        scheduler_cb = self.backend._event_cb
+
+        def _instrumented(event):
+            self._accrue_attainable()
+            scheduler_cb(event)
+            self._refresh_attainable()
+
+        self.backend.set_event_callback(_instrumented)
 
         for tj in self.trace:
             self.clock.call_later(tj.submit_offset_seconds,
                                   lambda tj=tj: self._submit(tj))
         for ev in preemptions:
-            if ev.add:
-                self.clock.call_later(
-                    ev.at_seconds,
-                    lambda ev=ev: self.backend.add_host(ev.host, ev.chips))
-            else:
-                self.clock.call_later(
-                    ev.at_seconds,
-                    lambda ev=ev: self.backend.remove_host(ev.host))
+            self.clock.call_later(ev.at_seconds,
+                                  lambda ev=ev: self._apply_preemption(ev))
 
-    def _sample_attainable(self, interval: float = 60.0) -> None:
-        """Integrate attainable capacity (piecewise over `interval`)."""
+    def _accrue_attainable(self) -> None:
+        """Close the window since the last demand/capacity change at the
+        value that held throughout it (and classify it as steady-state if
+        demand saturated the fleet for its whole span)."""
         now = self.clock.now()
+        self.backend.sync_accounting()
+        busy = self.backend.busy_chip_seconds
+        if (self._attainable_last_t is not None
+                and self._first_submit_at is not None):
+            dt = now - self._attainable_last_t
+            self._attainable_chip_seconds += dt * self._attainable_current
+            capacity = self.backend.total_chips()
+            if dt > 0 and capacity > 0 and self._attainable_current >= capacity:
+                self._sat_capacity_cs += dt * capacity
+                self._sat_busy_cs += busy - self._busy_at_last_accrue
+                self._sat_seconds += dt
+        self._busy_at_last_accrue = busy
+        self._attainable_last_t = now
+
+    def _refresh_attainable(self) -> None:
         demand = sum(j.config.max_num_chips
                      for j in self.scheduler.ready_jobs.values())
-        attainable = min(self.backend.total_chips(), demand)
-        if self._attainable_last_t is not None and self._first_submit_at is not None:
-            self._attainable_chip_seconds += (now - self._attainable_last_t) * attainable
-        self._attainable_last_t = now
-        self.clock.call_later(interval, self._sample_attainable)
+        self._attainable_current = min(self.backend.total_chips(), demand)
+
+    def _apply_preemption(self, ev: PreemptionEvent) -> None:
+        # Close the accounting window before capacity changes (the event
+        # the backend emits would close it after, mis-pricing the window).
+        self._accrue_attainable()
+        if ev.add:
+            self.backend.add_host(ev.host, ev.chips)
+        else:
+            self.backend.remove_host(ev.host)
+        self._refresh_attainable()
 
     def _submit(self, tj: TraceJob) -> None:
+        self._accrue_attainable()
         name = self.admission.create_training_job(tj.job_spec(self.pool))
         # Exact-name registration: per-job fault injection must not leak to
         # other jobs of the same family.
@@ -146,6 +188,8 @@ class ReplayHarness:
         self._submitted.append(name)
         if self._first_submit_at is None:
             self._first_submit_at = self.clock.now()
+            self._attainable_last_t = self.clock.now()
+        self._refresh_attainable()
 
     # ---- run -------------------------------------------------------------
 
@@ -195,6 +239,10 @@ class ReplayHarness:
                    if self.store.get_job(n) and self.store.get_job(n).finish_time < 1e300),
                   default=self.clock.now())
         makespan = max(1e-9, end - start)
+        # Close the final accounting window FIRST (syncs lazy per-job busy
+        # accrual too) so raw, attainable, and steady-state utilization
+        # all read the same busy total.
+        self._accrue_attainable()
         # Capacity integrates fleet changes (spot preemption shrinks the
         # denominator for exactly the window the chips were gone).
         capacity = self.backend.capacity_chip_seconds(start, end)
@@ -216,6 +264,9 @@ class ReplayHarness:
             avg_wait_seconds=statistics.mean(waits) if waits else 0.0,
             chip_utilization=util,
             attainable_utilization=min(1.0, attainable_util),
+            steady_state_utilization=(self._sat_busy_cs / self._sat_capacity_cs
+                                      if self._sat_capacity_cs > 0 else 0.0),
+            steady_state_seconds=self._sat_seconds,
             total_chips=self.backend.total_chips(),
             restarts_total=self.backend.restarts_total,
             rescheds_total=self.scheduler.m_resched_total.value(),

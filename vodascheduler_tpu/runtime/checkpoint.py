@@ -29,6 +29,38 @@ import orbax.checkpoint as ocp
 STEP_DIR_RE = re.compile(r"^step_(\d{10})$")
 
 
+def _is_coordinator() -> bool:
+    """In multi-process (multi-host) jobs only process 0 touches the
+    checkpoint directory structure; orbax's own shard writes stay
+    collective."""
+    return jax.process_index() == 0
+
+
+def _sync(tag: str) -> None:
+    """Cross-process barrier (no-op single-process): renames/prunes by the
+    coordinator must not race other processes' next save/restore."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def _ensure_global(x: jax.Array) -> jax.Array:
+    """Multi-process jobs: arrays living outside jit (the PRNG key) are
+    host-local (SingleDeviceSharding), which orbax cannot serialize in a
+    multi-host setting. Every process holds the same value (the key
+    evolves deterministically outside jit), so re-placing it as a fully
+    replicated global array over all devices is value-preserving."""
+    if jax.process_count() <= 1:
+        return x
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None and not sharding.is_fully_addressable:
+        return x  # already a global array
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()), ("all",))
+    return jax.device_put(np.asarray(x), NamedSharding(mesh, PartitionSpec()))
+
+
 def _step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(os.path.abspath(ckpt_dir), f"step_{step:010d}")
 
@@ -83,6 +115,7 @@ class AsyncCheckpointSaver:
         ckptr = self._checkpointer()
         ckptr.wait_until_finished()  # one in flight; previous is committed
         self._finish_retention()
+        rng = _ensure_global(rng)
         step = int(state["step"])
         path = _step_dir(ckpt_dir, step)
         os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
@@ -92,14 +125,18 @@ class AsyncCheckpointSaver:
             # survives a crash mid-save. The suffixed names never match
             # STEP_DIR_RE, so a half-finished swap is invisible to restore.
             tmp, old = path + ".new", path + ".old"
-            shutil.rmtree(tmp, ignore_errors=True)
-            shutil.rmtree(old, ignore_errors=True)
+            if _is_coordinator():
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.rmtree(old, ignore_errors=True)
+            _sync("ckpt:preclean")
             ckptr.save(tmp, {"state": state, "rng": rng})
             ckptr.wait_until_finished()
-            os.rename(path, old)
-            os.rename(tmp, path)
-            shutil.rmtree(old)
-            self._prune(ckpt_dir, keep_last)
+            if _is_coordinator():
+                os.rename(path, old)
+                os.rename(tmp, path)
+                shutil.rmtree(old)
+                self._prune(ckpt_dir, keep_last)
+            _sync("ckpt:swap")
         else:
             ckptr.save(path, {"state": state, "rng": rng})
             self._pending_retention = (ckpt_dir, keep_last)
@@ -108,6 +145,8 @@ class AsyncCheckpointSaver:
         return step
 
     def _prune(self, ckpt_dir: str, keep_last: int) -> None:
+        if not _is_coordinator():
+            return
         steps = list_steps(ckpt_dir)
         for old in steps[:-keep_last] if keep_last > 0 else []:
             shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)

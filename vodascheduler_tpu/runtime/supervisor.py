@@ -159,18 +159,40 @@ def run_job(workdir: str, num_chips: int,
 
     steps_per_epoch = max(1, spec.steps_per_epoch)
     total_steps = spec.config.epochs * steps_per_epoch
-    logger = EpochCsvLogger(metrics_dir, spec.name,
-                            total_epochs=spec.config.epochs,
-                            global_batch_size=spec.global_batch_size)
-    # Trust the checkpoint for position; the CSV may lag a crash.
-    logger.next_epoch = session.step // steps_per_epoch
+    # Multi-host: every process trains (the collectives are global), but
+    # only process 0 owns the job's telemetry CSV — one row per epoch per
+    # job, whatever the process count (the reference's CSV has one writer
+    # per job too: the rank-0 Keras callback, callbacks.py:104-154).
+    logger = None
+    if jax.process_index() == 0:
+        logger = EpochCsvLogger(metrics_dir, spec.name,
+                                total_epochs=spec.config.epochs,
+                                global_batch_size=spec.global_batch_size)
+        # Trust the checkpoint for position; the CSV may lag a crash.
+        logger.next_epoch = session.step // steps_per_epoch
 
+    # The first step after every (re)build compiles the resharded XLA
+    # program (20-40s on TPU). It must not enter the telemetry: the
+    # collector's speedup curves are per-chip-count epoch-time means, and
+    # a compile-poisoned first epoch feeds a negative marginal gain into
+    # every info-based algorithm right after a resize — the opposite of
+    # what the resize earned. So one warmup step runs untimed, and epoch
+    # time is extrapolated from the timed steps (the fake backend models
+    # clean epoch times the same way, cluster/fake.py).
+    warmup_pending = True
+    warmup_step_time = 0.0
     while session.step < total_steps:
-        epoch_start = time.monotonic()
         epoch_end_step = min(total_steps,
                              (session.step // steps_per_epoch + 1)
                              * steps_per_epoch)
         steps_this_epoch = epoch_end_step - session.step
+        if warmup_pending:
+            t0 = time.monotonic()
+            session.run_steps(1)
+            warmup_step_time = time.monotonic() - t0
+            warmup_pending = False
+        timed_steps = 0
+        timed_time = 0.0
         while session.step < epoch_end_step:
             if stop_requested["flag"]:
                 # Durable before exit (save itself drains any still-flying
@@ -179,12 +201,19 @@ def run_job(workdir: str, num_chips: int,
                 session.finish_saves()
                 return PREEMPTED_EXIT_CODE
             n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
+            t0 = time.monotonic()
             session.run_steps(n)
-        epoch_time = time.monotonic() - epoch_start
-        logger.log_epoch(epoch_time_sec=epoch_time,
-                         step_time_sec=epoch_time / steps_this_epoch,
-                         workers=num_chips,
-                         start_time=str(time.time()))
+            timed_time += time.monotonic() - t0
+            timed_steps += n
+        # Single-step epochs may consist only of the warmup step; its
+        # compile-inclusive time is the only sample we have then.
+        step_time = (timed_time / timed_steps if timed_steps
+                     else warmup_step_time)
+        if logger is not None:
+            logger.log_epoch(epoch_time_sec=step_time * steps_this_epoch,
+                             step_time_sec=step_time,
+                             workers=num_chips,
+                             start_time=str(time.time()))
         # Async: the next epoch's compute overlaps this save's shard
         # writes (the device->host copy is synchronous inside save).
         session.save(ckpt_dir, wait=False)

@@ -387,6 +387,11 @@ class Scheduler:
                 num_chips=self.total_chips,
                 algorithm=self.algorithm,
                 ready_jobs=jobs,
+                # Slice-shape feasibility: with a modeled torus, grants are
+                # rounded to counts that admit a contiguous sub-slice
+                # (SURVEY.md §7 allocation-unit delta).
+                topology=(self.placement_manager.topology
+                          if self.placement_manager is not None else None),
             ))
         except Exception:
             log.exception("allocation failed; retrying after rate limit")
@@ -527,6 +532,7 @@ class Scheduler:
         job.status = JobStatus.RUNNING
         job.metrics.last_chip_seconds = 0.0
         job.metrics.last_running_seconds = 0.0
+        job.metrics.seconds_since_restart = 0.0
         # Also consume the waiting window (the reference leaves it,
         # scheduler.go:505-514, letting a freshly-started job immediately
         # satisfy the Tiresias promote test and bounce back to queue 0).
@@ -542,6 +548,12 @@ class Scheduler:
         self.backend.scale_job(name, self.job_num_chips[name], placements)
         self.m_job_restarts.inc()
         self._last_resize_at[name] = self.clock.now()
+        job = self.ready_jobs.get(name)
+        if job is not None:
+            # A resize is a checkpoint-restart too: re-arm the preemption
+            # lease so the just-restarted job isn't evicted back-to-back.
+            job.metrics.seconds_since_restart = 0.0
+            self.store.update_job(job)
 
     def _halt_job(self, name: str) -> None:
         """Reference: haltTrainingJob (scheduler.go:576-590)."""
@@ -577,6 +589,7 @@ class Scheduler:
                 m.total_seconds += elapsed
                 m.last_running_seconds += elapsed
                 m.last_chip_seconds += elapsed * n
+                m.seconds_since_restart += elapsed
             elif job.status == JobStatus.WAITING:
                 m.waiting_seconds += elapsed
                 m.total_seconds += elapsed

@@ -196,11 +196,18 @@ def make_allocator_server(allocator: ResourceAllocator, registry: Registry,
 
     def allocate(body, query):
         data = json.loads(body)
+        topology = None
+        if data.get("topology"):
+            from vodascheduler_tpu.placement.topology import PoolTopology
+            topology = PoolTopology(
+                torus_dims=tuple(data["topology"]["torus_dims"]),
+                host_block=tuple(data["topology"]["host_block"]))
         request = AllocationRequest(
             scheduler_id=data.get("scheduler_id", ""),
             num_chips=int(data["num_chips"]),
             algorithm=data.get("algorithm", config.DEFAULT_ALGORITHM),
             ready_jobs=[job_from_dict(j) for j in data.get("ready_jobs", [])],
+            topology=topology,
         )
         return 200, allocator.allocate(request)
 
@@ -226,6 +233,10 @@ class RemoteAllocator:
             "num_chips": request.num_chips,
             "algorithm": request.algorithm,
             "ready_jobs": [job_to_dict(j) for j in request.ready_jobs],
+            "topology": (
+                {"torus_dims": list(request.topology.torus_dims),
+                 "host_block": list(request.topology.host_block)}
+                if request.topology is not None else None),
         }).encode()
         req = urllib.request.Request(
             f"{self.base_url}/allocation", data=payload,
