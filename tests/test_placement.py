@@ -17,7 +17,8 @@ from vodascheduler_tpu.placement.hungarian import solve_max, _solve_min
 from vodascheduler_tpu.placement.topology import (
     default_pool,
     feasible_shapes,
-    nearest_feasible_count,
+    next_feasible_above,
+    round_to_feasible,
 )
 
 
@@ -62,11 +63,16 @@ class TestTopology:
     def test_infeasible_count(self):
         # 5 chips never tiles a 4x4x4 torus (5 doesn't divide into axes <= 4)
         assert feasible_shapes(5, (4, 4, 4)) == []
-        assert nearest_feasible_count(5, (4, 4, 4)) == 4
+        topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+        assert round_to_feasible(5, topo) == 4
 
-    def test_nearest_feasible_respects_granularity(self):
-        assert nearest_feasible_count(7, (4, 4, 4), granularity=4) == 4
-        assert nearest_feasible_count(16, (4, 4, 4), granularity=4) == 16
+    def test_rounding_respects_host_granularity(self):
+        # Above one host (4 chips), counts snap to whole-host sub-tori.
+        topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+        assert round_to_feasible(7, topo) == 4
+        assert round_to_feasible(16, topo) == 16
+        # 24 chips = 6 hosts = a 1x2x3 box on the (2,2,4) host grid
+        assert next_feasible_above(16, topo) == 24
 
     def test_host_grid_and_distance(self):
         topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
