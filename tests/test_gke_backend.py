@@ -1,0 +1,266 @@
+"""GkeBackend against a fake clientset.
+
+The fake-clientset test the reference sketched but never finished
+(/root/reference/pkg/scheduler/scheduler/scheduler_test.go:50-54):
+pod CRUD, coordinator wiring, phase -> event translation, node-diff host
+churn — all without an API server.
+"""
+
+from typing import Any, Dict, List
+
+import pytest
+
+from vodascheduler_tpu.cluster.backend import ClusterEventKind
+from vodascheduler_tpu.cluster.gke import (
+    COORDINATOR_PORT,
+    TPU_ACCEL_LABEL,
+    TPU_RESOURCE,
+    GkeBackend,
+)
+from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+
+def make_node(name: str, chips: int = 4, ready: bool = True,
+              tpu: bool = True) -> Dict[str, Any]:
+    labels = {TPU_ACCEL_LABEL: "tpu-v5p-slice"} if tpu else {}
+    return {
+        "metadata": {"name": name, "labels": labels},
+        "status": {
+            "allocatable": {TPU_RESOURCE: str(chips)} if tpu else {},
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+
+
+class FakeKube:
+    """In-memory KubeApi: dict-backed pods/nodes/services."""
+
+    def __init__(self, nodes: List[Dict[str, Any]]):
+        self.nodes = list(nodes)
+        self.pods: Dict[str, Dict[str, Any]] = {}
+        self.services: Dict[str, Dict[str, Any]] = {}
+        self.deleted_pods: List[str] = []
+
+    # -- KubeApi --
+    def create_pod(self, namespace, manifest):
+        name = manifest["metadata"]["name"]
+        if name in self.pods:
+            raise RuntimeError(f"pod {name} exists")
+        manifest.setdefault("status", {"phase": "Pending"})
+        self.pods[name] = manifest
+        return manifest
+
+    def delete_pod(self, namespace, name, grace_seconds=30):
+        self.deleted_pods.append(name)
+        self.pods.pop(name, None)
+
+    def list_pods(self, namespace, label_selector=""):
+        out = []
+        for pod in self.pods.values():
+            labels = pod["metadata"].get("labels", {})
+            if self._matches(labels, label_selector):
+                out.append(pod)
+        return out
+
+    def list_nodes(self, label_selector=""):
+        return [n for n in self.nodes
+                if not label_selector
+                or label_selector in n["metadata"].get("labels", {})]
+
+    def create_service(self, namespace, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+        return manifest
+
+    def delete_service(self, namespace, name):
+        self.services.pop(name, None)
+
+    # -- helpers --
+    @staticmethod
+    def _matches(labels: Dict[str, str], selector: str) -> bool:
+        if not selector:
+            return True
+        for clause in selector.split(","):
+            k, _, v = clause.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+    def finish_pod(self, name: str, exit_code: int) -> None:
+        pod = self.pods[name]
+        pod["status"] = {
+            "phase": "Succeeded" if exit_code == 0 else "Failed",
+            "containerStatuses": [
+                {"state": {"terminated": {"exitCode": exit_code}}}],
+        }
+
+
+def template() -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"generateName": "voda-job-worker-",
+                     "labels": {"app": "voda-worker"}},
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": {TPU_ACCEL_LABEL: "tpu-v5p-slice"},
+            "containers": [{
+                "name": "supervisor", "image": "voda-worker:latest",
+                "args": [],
+                "resources": {"limits": {TPU_RESOURCE: "4"}},
+            }],
+        },
+    }
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube([make_node(f"host-{i}") for i in range(4)])
+    # Long interval: the always-on informer thread stays parked and the
+    # tests drive poll_once() deterministically (FakeKube isn't
+    # thread-safe; production uses a real apiserver).
+    backend = GkeBackend(kube, pod_template=template(),
+                         poll_interval_seconds=600.0)
+    events = []
+    backend.set_event_callback(events.append)
+    yield kube, backend, events
+    backend.close()
+
+
+def spec(name: str = "job-a") -> JobSpec:
+    return JobSpec(name=name, model="mnist_mlp")
+
+
+class TestPodCreation:
+    def test_single_host_job(self, world):
+        kube, backend, _ = world
+        backend.start_job(spec(), 4, placements=[("host-1", 4)])
+        assert len(kube.pods) == 1
+        pod = kube.pods["voda-job-a-i1-w0"]
+        assert pod["spec"]["nodeName"] == "host-1"
+        assert "nodeSelector" not in pod["spec"]
+        limits = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert limits[TPU_RESOURCE] == "4"
+        env = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
+        assert "VODA_COORDINATOR_ADDRESS" not in env
+        assert not kube.services  # no coordinator for single-host
+
+    def test_multi_host_job_has_coordinator(self, world):
+        kube, backend, _ = world
+        backend.start_job(spec(), 8,
+                          placements=[("host-0", 4), ("host-1", 4)])
+        assert len(kube.pods) == 2
+        assert "voda-job-a-i1-coord" in kube.services
+        svc = kube.services["voda-job-a-i1-coord"]
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["selector"]["voda/process-id"] == "0"
+        for pid in (0, 1):
+            env = {e["name"]: e["value"] for e in
+                   kube.pods[f"voda-job-a-i1-w{pid}"]["spec"]["containers"][0]["env"]}
+            assert env["VODA_PROCESS_ID"] == str(pid)
+            assert env["VODA_NUM_PROCESSES"] == "2"
+            assert env["VODA_COORDINATOR_ADDRESS"].endswith(
+                f":{COORDINATOR_PORT}")
+
+    def test_placement_mismatch_rejected(self, world):
+        _, backend, _ = world
+        with pytest.raises(ValueError):
+            backend.start_job(spec(), 8, placements=[("host-0", 4)])
+
+    def test_double_start_rejected(self, world):
+        _, backend, _ = world
+        backend.start_job(spec(), 4, placements=[("host-0", 4)])
+        with pytest.raises(RuntimeError):
+            backend.start_job(spec(), 4, placements=[("host-1", 4)])
+
+
+class TestLifecycle:
+    def test_completion_event(self, world):
+        kube, backend, events = world
+        backend.start_job(spec(), 8,
+                          placements=[("host-0", 4), ("host-1", 4)])
+        kube.finish_pod("voda-job-a-i1-w0", 0)
+        kube.finish_pod("voda-job-a-i1-w1", 0)
+        backend.poll_once()
+        kinds = [e.kind for e in events]
+        assert ClusterEventKind.JOB_COMPLETED in kinds
+        assert not kube.pods and not kube.services  # reaped
+        assert backend.running_jobs() == {}
+
+    def test_external_preemption_is_loud_failure(self, world):
+        kube, backend, events = world
+        backend.start_job(spec(), 4, placements=[("host-0", 4)])
+        kube.finish_pod("voda-job-a-i1-w0", PREEMPTED_EXIT_CODE)
+        backend.poll_once()
+        fails = [e for e in events if e.kind == ClusterEventKind.JOB_FAILED]
+        assert len(fails) == 1
+        assert "preempted outside scheduler control" in fails[0].detail
+
+    def test_crash_failure_event(self, world):
+        kube, backend, events = world
+        backend.start_job(spec(), 4, placements=[("host-0", 4)])
+        kube.finish_pod("voda-job-a-i1-w0", 1)
+        backend.poll_once()
+        fails = [e for e in events if e.kind == ClusterEventKind.JOB_FAILED]
+        assert len(fails) == 1
+
+    def test_scale_restarts_pods(self, world):
+        kube, backend, _ = world
+        backend.start_job(spec(), 4, placements=[("host-0", 4)])
+        backend.scale_job("job-a", 8,
+                          placements=[("host-2", 4), ("host-3", 4)])
+        assert "voda-job-a-i1-w0" in kube.deleted_pods
+        assert len(kube.pods) == 2
+        hosts = {p["spec"]["nodeName"] for p in kube.pods.values()}
+        assert hosts == {"host-2", "host-3"}
+        # The recreated set carries a fresh incarnation, so the new pod
+        # names never collide with the old (possibly Terminating) ones.
+        env = {e["name"]: e["value"] for e in
+               kube.pods["voda-job-a-i2-w0"]["spec"]["containers"][0]["env"]}
+        assert env["VODA_NUM_PROCESSES"] == "2"
+
+    def test_stop_deletes_everything(self, world):
+        kube, backend, _ = world
+        backend.start_job(spec(), 8,
+                          placements=[("host-0", 4), ("host-1", 4)])
+        backend.stop_job("job-a")
+        assert not kube.pods and not kube.services
+        assert backend.running_jobs() == {}
+
+    def test_running_jobs_reconstructs_from_pods(self, world):
+        kube, backend, _ = world
+        backend.start_job(spec(), 8,
+                          placements=[("host-0", 4), ("host-1", 4)])
+        # A fresh backend (scheduler crash) sees the same pods.
+        backend2 = GkeBackend(kube, pod_template=template())
+        jobs = backend2.running_jobs()
+        assert jobs["job-a"].num_workers == 8
+        assert sorted(jobs["job-a"].placements) == [("host-0", 4),
+                                                    ("host-1", 4)]
+
+
+class TestHostChurn:
+    def test_list_hosts_filters_ready_tpu_nodes(self):
+        kube = FakeKube([
+            make_node("good", 4),
+            make_node("notready", 4, ready=False),
+            make_node("cpu-only", 0, tpu=False),
+        ])
+        backend = GkeBackend(kube, pod_template=template())
+        assert backend.list_hosts() == {"good": 4}
+
+    def test_node_diff_emits_host_events(self, world):
+        kube, backend, events = world
+        kube.nodes.append(make_node("host-4"))
+        backend.poll_once()
+        added = [e for e in events
+                 if e.kind == ClusterEventKind.HOST_ADDED]
+        assert [e.name for e in added] == ["host-4"]
+        kube.nodes = [n for n in kube.nodes
+                      if n["metadata"]["name"] != "host-0"]
+        backend.poll_once()
+        removed = [e for e in events
+                   if e.kind == ClusterEventKind.HOST_REMOVED]
+        assert [e.name for e in removed] == ["host-0"]
+        assert "host-0" not in backend.list_hosts()
+        assert "host-4" in backend.list_hosts()

@@ -17,5 +17,6 @@ interface:
 """
 
 from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent
+from vodascheduler_tpu.cluster.gke import GkeBackend, InClusterKube
 from vodascheduler_tpu.cluster.local import LocalBackend
 from vodascheduler_tpu.cluster.multihost import MultiHostBackend

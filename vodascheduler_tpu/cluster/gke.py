@@ -1,0 +1,471 @@
+"""GKE/Kubernetes ClusterBackend: worker pods on TPU node pools.
+
+Reference counterpart: the scheduler's entire k8s surface — MPIJob
+create/update/delete (/root/reference/pkg/scheduler/scheduler/scheduler.go:495-612)
+and the node/pod informers (scheduler.go:169-242,689-747;
+/root/reference/pkg/placement/placement_manager.go:84-134). The reference
+delegated per-job process management to the Kubeflow MPI-Operator CRD;
+here the backend stamps worker Pods directly from
+deploy/gke/worker-pod-template.yaml — there is no operator in the middle,
+because a TPU job's "scale" is a checkpoint-restart of the whole process
+set, not an in-place ring rebuild (SURVEY.md §2.3).
+
+Design:
+
+- `KubeApi` is the minimal typed slice of the k8s REST surface the
+  backend needs (create/delete/list pods, list nodes, create/delete
+  services). `InClusterKube` implements it over stdlib HTTP with the
+  serviceaccount token — the `kubernetes` client package is deliberately
+  not a dependency. Tests inject `FakeKube` (tests/test_gke_backend.py),
+  the fake-clientset pattern the reference sketched but never finished
+  (scheduler_test.go:50-54).
+- One worker Pod per placement entry (per host), pinned with
+  `spec.nodeName` so the placement manager's ICI-contiguous host choice
+  is binding. Multi-host jobs get a per-job headless Service addressing
+  process 0 — the jax.distributed coordinator (the TPU-native hostfile
+  replacement).
+- Stop/scale delete the pods with a grace period: kubelet's SIGTERM is
+  the same preemption signal the supervisor already handles (collective
+  checkpoint, exit PREEMPTED_EXIT_CODE) — the k8s transport and the
+  local transports share one protocol.
+- A poll thread turns pod phases into JOB_COMPLETED/JOB_FAILED events
+  and node-list diffs into HOST_ADDED/HOST_REMOVED — the informer analog
+  (reference watches; polling keeps the stdlib client simple and the
+  scheduler contract identical).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
+
+from vodascheduler_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterEvent,
+    ClusterEventKind,
+    JobHandle,
+)
+from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+DEFAULT_NAMESPACE = "voda-scheduler"
+COORDINATOR_PORT = 8476
+# GKE TPU node labels (the nvidia.com/gpu analog lives in allocatable).
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCEL_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+
+class KubeApi(Protocol):
+    """The slice of the k8s API the backend consumes."""
+
+    def create_pod(self, namespace: str, manifest: Dict[str, Any]
+                   ) -> Dict[str, Any]: ...
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_seconds: int = 30) -> None: ...
+
+    def list_pods(self, namespace: str, label_selector: str = ""
+                  ) -> List[Dict[str, Any]]: ...
+
+    def list_nodes(self, label_selector: str = "") -> List[Dict[str, Any]]: ...
+
+    def create_service(self, namespace: str, manifest: Dict[str, Any]
+                       ) -> Dict[str, Any]: ...
+
+    def delete_service(self, namespace: str, name: str) -> None: ...
+
+
+class InClusterKube:
+    """KubeApi over the in-cluster REST endpoint, stdlib only.
+
+    Reads the standard serviceaccount mount (token + CA) and the
+    KUBERNETES_SERVICE_HOST/PORT env the kubelet injects — the same
+    wiring client-go's rest.InClusterConfig() does for the reference.
+    """
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_path: Optional[str] = None):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = base_url or f"https://{host}:{port}"
+        if token is None:
+            with open(os.path.join(self.SA_DIR, "token")) as f:
+                token = f.read().strip()
+        self.token = token
+        ca = ca_path or os.path.join(self.SA_DIR, "ca.crt")
+        self._ctx = ssl.create_default_context(
+            cafile=ca if os.path.exists(ca) else None)
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 query: str = "") -> Any:
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method, headers={
+            "Authorization": f"Bearer {self.token}",
+            "Content-Type": "application/json",
+            "Accept": "application/json",
+        })
+        with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+            payload = r.read()
+        return json.loads(payload) if payload else None
+
+    def create_pod(self, namespace, manifest):
+        return self._request("POST", f"/api/v1/namespaces/{namespace}/pods",
+                             body=manifest)
+
+    def delete_pod(self, namespace, name, grace_seconds=30):
+        try:
+            self._request("DELETE",
+                          f"/api/v1/namespaces/{namespace}/pods/{name}",
+                          query=f"gracePeriodSeconds={grace_seconds}")
+        except urllib.error.HTTPError as e:  # pragma: no cover - network
+            if e.code != 404:
+                raise
+
+    def list_pods(self, namespace, label_selector=""):
+        q = f"labelSelector={label_selector}" if label_selector else ""
+        out = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
+                            query=q)
+        return out.get("items", [])
+
+    def list_nodes(self, label_selector=""):
+        q = f"labelSelector={label_selector}" if label_selector else ""
+        out = self._request("GET", "/api/v1/nodes", query=q)
+        return out.get("items", [])
+
+    def create_service(self, namespace, manifest):
+        return self._request("POST",
+                             f"/api/v1/namespaces/{namespace}/services",
+                             body=manifest)
+
+    def delete_service(self, namespace, name):
+        try:
+            self._request("DELETE",
+                          f"/api/v1/namespaces/{namespace}/services/{name}")
+        except urllib.error.HTTPError as e:  # pragma: no cover - network
+            if e.code != 404:
+                raise
+
+
+def _default_pod_template() -> Dict[str, Any]:
+    path = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "deploy", "gke", "worker-pod-template.yaml")
+    import yaml
+    with open(os.path.abspath(path)) as f:
+        return yaml.safe_load(f)
+
+
+def _job_selector(job: str) -> str:
+    return f"voda/job-name={job}"
+
+
+class GkeBackend(ClusterBackend):
+    """ClusterBackend over a (fake or real) Kubernetes API."""
+
+    def __init__(self, kube: KubeApi,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 pod_template: Optional[Dict[str, Any]] = None,
+                 stop_grace_seconds: int = 120,
+                 poll_interval_seconds: float = 2.0,
+                 image: Optional[str] = None):
+        self.kube = kube
+        self.namespace = namespace
+        self.pod_template = pod_template or _default_pod_template()
+        self.stop_grace_seconds = stop_grace_seconds
+        self.poll_interval_seconds = poll_interval_seconds
+        self.image = image
+        self._specs: Dict[str, JobSpec] = {}
+        self._jobs: Dict[str, JobHandle] = {}
+        self._known_hosts: Dict[str, int] = {}
+        # Per-job incarnation counter folded into pod names: a scale's
+        # recreate must not reuse the names of pods still Terminating
+        # from the graceful delete (the apiserver would 409 — the reason
+        # the template ships generateName; deterministic names + a fresh
+        # incarnation keep both list-by-label and create race-free).
+        self._incarnation: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._closed = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._known_hosts = self._nodes_now()
+        # The node-informer role outlives job presence: host churn (node
+        # pool resizes, spot reclaims) must reach the scheduler even when
+        # nothing is running, so the monitor starts at construction and
+        # runs until close().
+        self._ensure_monitor()
+
+    # ---- hosts (node informer analog) ------------------------------------
+
+    def _nodes_now(self) -> Dict[str, int]:
+        """TPU hosts from the node list: allocatable google.com/tpu chips
+        on Ready nodes (reference: placement_manager.go:84-134 node cache
+        keyed on nvidia.com/gpu capacity)."""
+        hosts: Dict[str, int] = {}
+        for node in self.kube.list_nodes(label_selector=TPU_ACCEL_LABEL):
+            status = node.get("status", {})
+            ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                        for c in status.get("conditions", []))
+            if not ready:
+                continue
+            chips = int(status.get("allocatable", {}).get(TPU_RESOURCE, 0))
+            if chips > 0:
+                hosts[node["metadata"]["name"]] = chips
+        return hosts
+
+    def list_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._known_hosts)
+
+    # ---- job lifecycle ----------------------------------------------------
+
+    def start_job(self, spec: JobSpec, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        with self._lock:
+            if spec.name in self._jobs:
+                raise RuntimeError(f"job {spec.name!r} already running")
+            placements = placements or self._default_placements(num_workers)
+            self._specs[spec.name] = spec
+            self._create_pods(spec, num_workers, placements)
+            self._jobs[spec.name] = JobHandle(
+                name=spec.name, num_workers=num_workers,
+                placements=list(placements))
+        self._ensure_monitor()
+
+    def scale_job(self, name: str, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown job {name!r}")
+        self._delete_pods(name)
+        with self._lock:
+            placements = placements or self._default_placements(num_workers)
+            self._create_pods(spec, num_workers, placements)
+            self._jobs[name] = JobHandle(name=name, num_workers=num_workers,
+                                         placements=list(placements))
+        self._ensure_monitor()
+
+    def stop_job(self, name: str) -> None:
+        self._delete_pods(name)
+        with self._lock:
+            self._jobs.pop(name, None)
+            self._specs.pop(name, None)
+
+    def migrate_workers(self, name: str,
+                        placements: List[Tuple[str, int]]) -> None:
+        handle = self._jobs.get(name)
+        if handle is not None:
+            self.scale_job(name, handle.num_workers, placements)
+
+    def running_jobs(self) -> Dict[str, JobHandle]:
+        """Reconstructed from live pods (crash-resume path — the reference
+        lists MPIJobs on scheduler restart, scheduler.go:1019)."""
+        jobs: Dict[str, JobHandle] = {}
+        for pod in self.kube.list_pods(self.namespace,
+                                       label_selector="app=voda-worker"):
+            labels = pod["metadata"].get("labels", {})
+            job = labels.get("voda/job-name")
+            if not job or pod.get("status", {}).get("phase") not in (
+                    "Pending", "Running"):
+                continue
+            chips = int(labels.get("voda/num-chips", 0))
+            host = pod["spec"].get("nodeName", "")
+            handle = jobs.setdefault(job, JobHandle(name=job, num_workers=0))
+            handle.num_workers += chips
+            handle.placements.append((host, chips))
+            gen = int(labels.get("voda/incarnation", 0))
+            with self._lock:
+                # Crash-resume: recover the incarnation counter so the
+                # next scale doesn't reuse live pod/service names.
+                self._incarnation[job] = max(self._incarnation.get(job, 0),
+                                             gen)
+        with self._lock:
+            self._jobs.update(jobs)
+        return dict(jobs)
+
+    # ---- pod construction --------------------------------------------------
+
+    def _default_placements(self, num_workers: int) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        remaining = num_workers
+        for host, chips in self.list_hosts().items():
+            if remaining <= 0:
+                break
+            take = min(chips, remaining)
+            out.append((host, take))
+            remaining -= take
+        if remaining > 0:
+            raise RuntimeError(
+                f"not enough chips: need {num_workers}")
+        return out
+
+    def _pod_name(self, job: str, pid: int) -> str:
+        gen = self._incarnation.get(job, 0)
+        return f"voda-{job}-i{gen}-w{pid}"
+
+    def _svc_name(self, job: str) -> str:
+        gen = self._incarnation.get(job, 0)
+        return f"voda-{job}-i{gen}-coord"
+
+    def _create_pods(self, spec: JobSpec, num_chips: int,
+                     placements: List[Tuple[str, int]]) -> None:
+        total = sum(c for _, c in placements)
+        if total != num_chips:
+            raise ValueError(
+                f"placements cover {total} chips, job wants {num_chips}")
+        self._incarnation[spec.name] = self._incarnation.get(spec.name, 0) + 1
+        multi = len(placements) > 1
+        coordinator = ""
+        if multi:
+            # Headless service resolving to the process-0 pod: a stable
+            # coordinator DNS name before any pod IP exists.
+            svc = self._svc_name(spec.name)
+            coordinator = (f"{svc}.{self.namespace}.svc:{COORDINATOR_PORT}")
+            self.kube.create_service(self.namespace, {
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": svc, "namespace": self.namespace,
+                             "labels": {"voda/job-name": spec.name}},
+                "spec": {
+                    "clusterIP": "None",
+                    "selector": {"voda/job-name": spec.name,
+                                 "voda/process-id": "0"},
+                    "ports": [{"port": COORDINATOR_PORT,
+                               "targetPort": COORDINATOR_PORT}],
+                },
+            })
+        for pid, (host, chips) in enumerate(placements):
+            manifest = json.loads(json.dumps(self.pod_template))  # deep copy
+            meta = manifest.setdefault("metadata", {})
+            meta.pop("generateName", None)
+            meta["name"] = self._pod_name(spec.name, pid)
+            meta["namespace"] = self.namespace
+            labels = meta.setdefault("labels", {})
+            labels.update({"app": "voda-worker",
+                           "voda/job-name": spec.name,
+                           "voda/process-id": str(pid),
+                           "voda/num-chips": str(chips),
+                           "voda/incarnation":
+                               str(self._incarnation[spec.name])})
+            podspec = manifest["spec"]
+            podspec["nodeName"] = host      # placement manager's binding
+            podspec.pop("nodeSelector", None)  # nodeName supersedes it
+            container = podspec["containers"][0]
+            if self.image:
+                container["image"] = self.image
+            container["args"] = ["--workdir", f"/jobs/{spec.name}",
+                                 "--num-chips", str(num_chips)]
+            env = [
+                {"name": "VODA_JOB_NAME", "value": spec.name},
+            ]
+            if multi:
+                env += [
+                    {"name": "VODA_COORDINATOR_ADDRESS", "value": coordinator},
+                    {"name": "VODA_NUM_PROCESSES",
+                     "value": str(len(placements))},
+                    {"name": "VODA_PROCESS_ID", "value": str(pid)},
+                ]
+            container["env"] = env
+            container.setdefault("resources", {}).setdefault(
+                "limits", {})[TPU_RESOURCE] = str(chips)
+            self.kube.create_pod(self.namespace, manifest)
+
+    def _delete_pods(self, job: str) -> None:
+        gens = {self._incarnation.get(job, 0)}
+        for pod in self.kube.list_pods(self.namespace,
+                                       label_selector=_job_selector(job)):
+            gens.add(int(pod["metadata"].get("labels", {})
+                         .get("voda/incarnation", 0)))
+            self.kube.delete_pod(self.namespace, pod["metadata"]["name"],
+                                 grace_seconds=self.stop_grace_seconds)
+        for gen in gens:
+            self.kube.delete_service(self.namespace,
+                                     f"voda-{job}-i{gen}-coord")
+
+    # ---- monitor (informer analog) ----------------------------------------
+
+    def _ensure_monitor(self) -> None:
+        with self._lock:
+            if self._monitor is None or not self._monitor.is_alive():
+                self._monitor = threading.Thread(target=self._monitor_loop,
+                                                 daemon=True)
+                self._monitor.start()
+
+    def poll_once(self) -> None:
+        """One informer sweep: pod phases -> job events, node diff ->
+        host events. Public so tests (and a cron-style deployment) can
+        drive it without the thread."""
+        self._sweep_jobs()
+        self._sweep_nodes()
+
+    def _sweep_jobs(self) -> None:
+        with self._lock:
+            jobs = list(self._jobs)
+        for job in jobs:
+            pods = self.kube.list_pods(self.namespace,
+                                       label_selector=_job_selector(job))
+            if not pods:
+                continue  # being created or already reaped
+            phases = [p.get("status", {}).get("phase") for p in pods]
+            if any(ph in ("Pending", "Running", None) for ph in phases):
+                continue
+            codes = []
+            for p in pods:
+                for cs in p.get("status", {}).get("containerStatuses", []):
+                    term = cs.get("state", {}).get("terminated")
+                    if term is not None:
+                        codes.append(int(term.get("exitCode", -1)))
+            with self._lock:
+                self._jobs.pop(job, None)
+                self._specs.pop(job, None)
+            for p in pods:
+                self.kube.delete_pod(self.namespace, p["metadata"]["name"],
+                                     grace_seconds=0)
+            self.kube.delete_service(self.namespace, self._svc_name(job))
+            if codes and all(c == 0 for c in codes):
+                self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED, job,
+                                       timestamp=time.time()))
+            elif codes and all(c in (0, PREEMPTED_EXIT_CODE) for c in codes):
+                # Checkpointed exit the backend did not request (node
+                # drain / spot reclaim): loud failure so the scheduler
+                # requeues — same contract as multihost.py:276-283.
+                self.emit(ClusterEvent(
+                    ClusterEventKind.JOB_FAILED, job,
+                    detail=f"preempted outside scheduler control {codes}",
+                    timestamp=time.time()))
+            else:
+                self.emit(ClusterEvent(ClusterEventKind.JOB_FAILED, job,
+                                       detail=f"exit codes {codes}",
+                                       timestamp=time.time()))
+
+    def _sweep_nodes(self) -> None:
+        now = self._nodes_now()
+        with self._lock:
+            before = dict(self._known_hosts)
+            self._known_hosts = now
+        for host in now.keys() - before.keys():
+            self.emit(ClusterEvent(ClusterEventKind.HOST_ADDED, host,
+                                   timestamp=time.time()))
+        for host in before.keys() - now.keys():
+            self.emit(ClusterEvent(ClusterEventKind.HOST_REMOVED, host,
+                                   timestamp=time.time()))
+
+    def _monitor_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # pragma: no cover - keep informer alive
+                pass
+            self._closed.wait(self.poll_interval_seconds)
+
+    def close(self) -> None:
+        self._closed.set()
+        for name in list(self._jobs):
+            self.stop_job(name)

@@ -45,6 +45,11 @@ class LlamaConfig:
 
 # Llama-3-8B (the baseline config's model)
 LLAMA3_8B = LlamaConfig()
+# ~350M single-chip config: same architecture scaled so full fp32
+# optimizer state (~12 bytes/param ≈ 4.2 GB) plus activations fits one
+# 16 GB v5e chip — the hardware-bench flagship (bench.py MFU section).
+LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
+                         num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048)
 # Tiny config for tests / compile checks
 LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, mlp_hidden=128, max_seq_len=128,
@@ -59,8 +64,11 @@ class Llama(nn.Module):
     causal_attention = True
 
     @nn.compact
-    def __call__(self, tokens):
-        """tokens [B, S] int32 -> logits [B, S, vocab]."""
+    def __call__(self, tokens, targets=None):
+        """tokens [B, S] int32 -> logits [B, S, vocab], or — when `targets`
+        [B, S] is given — the mean token cross-entropy WITHOUT materializing
+        full-vocab logits (ops/chunked_ce.py): the lm_head matmul runs
+        per sequence chunk under remat, the framework's fused-loss path."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
@@ -74,5 +82,11 @@ class Llama(nn.Module):
             x = DecoderBlock(attn_cfg, cfg.mlp_hidden, attn_fn=self.attn_fn,
                              name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
-        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                        dtype=dtype, param_dtype=jnp.float32)(x)
+        # Head weight as an explicit param (not nn.Dense) so the fused
+        # loss can chunk the matmul; the logits path is Dense-equivalent.
+        w = self.param("lm_head_kernel", nn.initializers.lecun_normal(),
+                       (cfg.dim, cfg.vocab_size), jnp.float32)
+        if targets is None:
+            return x @ w.astype(dtype)
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        return chunked_softmax_ce(x, w, targets)

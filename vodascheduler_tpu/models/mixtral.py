@@ -106,7 +106,7 @@ class Mixtral(nn.Module):
     causal_attention = True
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, targets=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
@@ -115,5 +115,10 @@ class Mixtral(nn.Module):
         for i in range(cfg.num_layers):
             x = MixtralBlock(cfg, attn_fn=self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
-        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                        dtype=dtype, param_dtype=jnp.float32)(x)
+        # Fused-loss head, as in llama.py: chunked CE when targets given.
+        w = self.param("lm_head_kernel", nn.initializers.lecun_normal(),
+                       (cfg.dim, cfg.vocab_size), jnp.float32)
+        if targets is None:
+            return x @ w.astype(dtype)
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        return chunked_softmax_ce(x, w, targets)

@@ -50,6 +50,13 @@ def _lm_loss(apply_fn, params, batch):
         logits.astype(jnp.float32), batch["targets"]).mean()
 
 
+def _lm_fused_loss(apply_fn, params, batch):
+    """Loss computed inside the model (chunked CE — ops/chunked_ce.py):
+    full-vocab logits never materialize. For modules whose __call__
+    accepts `targets` (llama, mixtral)."""
+    return apply_fn(params, batch["inputs"], targets=batch["targets"])
+
+
 def _mlm_loss(apply_fn, params, batch):
     logits = apply_fn(params, batch["inputs"])
     return optax.softmax_cross_entropy_with_integer_labels(
@@ -122,16 +129,21 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
         "llama3_8b": lambda: ModelBundle(
             name="llama3_8b", module=llama.Llama(llama.LLAMA3_8B),
             make_batch=_lm_batch(llama.LLAMA3_8B.vocab_size, 4096),
-            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, params_b=8.0,
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=8.0,
             seq_len=4096),
+        "llama_350m": lambda: ModelBundle(
+            name="llama_350m", module=llama.Llama(llama.LLAMA_350M),
+            make_batch=_lm_batch(llama.LLAMA_350M.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=2048),
         "llama_tiny": lambda: ModelBundle(
             name="llama_tiny", module=llama.Llama(llama.LLAMA_TINY),
             make_batch=_lm_batch(llama.LLAMA_TINY.vocab_size, 64),
-            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, seq_len=64),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64),
         "mixtral_8x7b": lambda: ModelBundle(
             name="mixtral_8x7b", module=mixtral.Mixtral(mixtral.MIXTRAL_8X7B_LIKE),
             make_batch=_lm_batch(mixtral.MIXTRAL_8X7B_LIKE.vocab_size, 4096),
-            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, params_b=47.0,
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=47.0,
             seq_len=4096, num_experts=8),
         "nmt_base": lambda: ModelBundle(
             name="nmt_base",
@@ -147,7 +159,7 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
         "mixtral_tiny": lambda: ModelBundle(
             name="mixtral_tiny", module=mixtral.Mixtral(mixtral.MIXTRAL_TINY),
             make_batch=_lm_batch(mixtral.MIXTRAL_TINY.vocab_size, 64),
-            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, seq_len=64,
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64,
             num_experts=4),
     }
 

@@ -50,10 +50,17 @@ NEG_INF = -1e30  # finite: avoids inf-inf NaNs in the running-max updates
 # kernel invocations); below it the XLA path wins, so fall back loudly.
 MIN_BLOCK = 8
 LANES = 128
-# The logsumexp is per-row; persisting it lane-replicated would be 128x
-# the HBM traffic/footprint, so the output array keeps a single lane
-# (VMEM tiles are padded either way; HBM stores only this width).
-LSE_LANES = 1
+# The logsumexp persists to HBM as [B, H, num_q, LSE_SUBLANES, block_q]
+# (q-block values on lanes, one real sublane row padded to the minimum 8).
+# The last two dims of every block equal the full array dims, which Pallas
+# accepts for ANY block_q — including the bq<128 blocks _pick_block emits
+# for odd sequence lengths — where a [B, H, S] layout would violate the
+# 128-lane block-divisibility rule. A [B, H, S, 1] layout instead costs
+# 128x lane padding — at 24 layers of training residuals that padding
+# alone is GBs of HBM; this one is 16x smaller. The kernels transpose the
+# (rows, LANES) lane-replicated running stats to lane-major at flush time
+# (one 2-D VMEM transpose per q block).
+LSE_SUBLANES = 8
 
 
 def _pick_block(seq: int, preferred: int) -> int:
@@ -141,7 +148,10 @@ def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                        * _bcast_lanes(l_inv, acc_ref.shape[-1])
                        ).astype(o_ref.dtype)
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        lse_ref[0, 0] = (m_ref[...] + jnp.log(safe_l))[:, :LSE_LANES]
+        # (bq, LANES) lane-replicated -> (1, bq) lane-major, sublane-padded.
+        lse_t = (m_ref[...] + jnp.log(safe_l)).T[:1]
+        lse_ref[0, 0, 0] = jnp.broadcast_to(
+            lse_t, (LSE_SUBLANES, lse_t.shape[1]))
 
 
 def _fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
@@ -165,12 +175,13 @@ def _fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, LSE_LANES),
-                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+                         lambda b, h, i, j: (b, h, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, num_q, LSE_SUBLANES, bq),
+                                 jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),   # running max
@@ -217,8 +228,8 @@ def _dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         s *= sm_scale
         if causal:
             s = _causal_mask(s, q_off + i * block_q, j * block_k)
-        lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
-        p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
+        lse = lse_ref[0, 0, 0][:1].T                         # [bq, 1]
+        p = jnp.exp(s - lse)                                 # [bq, bk]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
         ds = p * (dov - delta_ref[...][:, :1]) * sm_scale
@@ -254,8 +265,8 @@ def _dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         s *= sm_scale
         if causal:
             s = _causal_mask(s, q_off + i * block_q, j * block_k)
-        lse = lse_ref[0, 0]                                  # [bq, LSE_LANES]
-        p = jnp.exp(s - lse[:, :1])                          # [bq, bk]
+        lse = lse_ref[0, 0, 0][:1].T                         # [bq, 1]
+        p = jnp.exp(s - lse)                                 # [bq, bk]
         delta = jnp.sum(do * o, axis=1)[:, None]             # [bq, 1]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
@@ -283,8 +294,8 @@ def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq, LSE_LANES),
-                            lambda b, h, i, j: (b, h, i, 0))
+    lse_spec = pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+                            lambda b, h, i, j: (b, h, i, 0, 0))
 
     off_spec = pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
                             memory_space=pltpu.SMEM)
@@ -307,8 +318,8 @@ def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
     # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
     q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
     kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
-    lse_spec_t = pl.BlockSpec((1, 1, bq, LSE_LANES),
-                              lambda b, h, j, i: (b, h, i, 0))
+    lse_spec_t = pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+                              lambda b, h, j, i: (b, h, i, 0, 0))
     off_spec_t = pl.BlockSpec((1, 1), lambda b, h, j, i: (0, 0),
                               memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(

@@ -58,6 +58,10 @@ class TrainSetup:
     train_step: Callable[[Any, Any], Tuple[Any, jax.Array]]
     make_batch: Callable[[int, jax.Array], Any]  # sharded synthetic batch
     eval_shape_state: Any
+    # Un-jitted step, for callers that fuse their own loop around it
+    # (hwbench scans K steps inside one jit to amortize dispatch overhead).
+    train_step_raw: Optional[Callable[[Any, Any],
+                                      Tuple[Any, jax.Array]]] = None
 
 
 def make_train_setup(bundle: ModelBundle, num_chips: int,
@@ -117,11 +121,12 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     # pytree untouched by the optimizer; BatchNorm models run on their
     # init-time stats in synthetic-benchmark mode (see resnet.py).
     if bundle.has_batch_stats:
-        def apply_fn_extra(params, extra, x):
-            return module.apply({"params": params, **extra}, x, train=False)
+        def apply_fn_extra(params, extra, x, **kw):
+            return module.apply({"params": params, **extra}, x, train=False,
+                                **kw)
     else:
-        def apply_fn_extra(params, extra, x):
-            return module.apply({"params": params}, x)
+        def apply_fn_extra(params, extra, x, **kw):
+            return module.apply({"params": params}, x, **kw)
 
     def init_state(rng) -> Dict[str, Any]:
         batch = bundle.make_batch(global_batch_size, rng)
@@ -135,7 +140,8 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     def train_step(state, batch):
         def loss_fn(params):
             return bundle.loss_fn(
-                lambda p, x: apply_fn_extra(p, state["extra"], x), params, batch)
+                lambda p, x, **kw: apply_fn_extra(p, state["extra"], x, **kw),
+                params, batch)
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         updates, opt_state = optimizer.update(grads, state["opt_state"],
@@ -179,7 +185,8 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     return TrainSetup(mesh=mesh, plan=plan, state_shardings=state_shardings,
                       batch_shardings=batch_shardings, init_fn=init_jit,
                       train_step=step_jit, make_batch=make_batch,
-                      eval_shape_state=state_shapes)
+                      eval_shape_state=state_shapes,
+                      train_step_raw=train_step)
 
 
 class TrainSession:
