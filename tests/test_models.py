@@ -115,3 +115,53 @@ class TestNmt:
         assert r.step == 2
         r.run_steps(1)
         assert r.step == 3
+
+
+class TestRoutedMoE:
+    """Routed (GShard one-hot-matmul) dispatch vs the dense oracle
+    (VERDICT r2 item 7: capacity-bounded routing over ep behind the same
+    MoEBlock interface)."""
+
+    def _block_out(self, dispatch: str, capacity_factor: float = 100.0):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from vodascheduler_tpu.models import mixtral
+
+        cfg = dataclasses.replace(mixtral.MIXTRAL_TINY, dispatch=dispatch,
+                                  capacity_factor=capacity_factor)
+        block = mixtral.MoEBlock(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg.dim),
+                              dtype=jnp.bfloat16)
+        params = block.init(jax.random.PRNGKey(0), x)
+        return block.apply(params, x)
+
+    def test_routed_matches_dense_at_full_capacity(self):
+        import jax.numpy as jnp
+        dense = self._block_out("dense")
+        routed = self._block_out("routed", capacity_factor=100.0)
+        err = jnp.max(jnp.abs(dense.astype(jnp.float32)
+                              - routed.astype(jnp.float32)))
+        assert float(err) < 0.05, float(err)
+
+    def test_capacity_drops_tokens_not_crashes(self):
+        # Tight capacity: output differs from dense but stays finite.
+        import jax.numpy as jnp
+        routed = self._block_out("routed", capacity_factor=0.5)
+        assert bool(jnp.all(jnp.isfinite(routed.astype(jnp.float32))))
+
+    def test_routed_trains_with_ep(self):
+        # The default mixtral_tiny bundle now routes; 2 steps on a
+        # dp x ep mesh exercise dispatch/combine under ep sharding.
+        s = TrainSession(get_model("mixtral_tiny"), num_chips=8,
+                         global_batch_size=8, plan=MeshPlan(dp=2, ep=4))
+        loss = s.run_steps(2)
+        assert 0 < loss < 20
+
+    def test_capacity_is_static_and_lane_rounded(self):
+        from vodascheduler_tpu.ops.moe_dispatch import expert_capacity
+        assert expert_capacity(1024, 8, 2, 1.25) == 320
+        assert expert_capacity(32, 4, 2, 1.0) == 16
+        assert expert_capacity(8, 8, 2, 1.0) == 8  # capped at T

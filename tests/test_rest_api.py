@@ -111,7 +111,8 @@ def test_metrics_exposition(urls):
     with urllib.request.urlopen(f"{urls['scheduler']}/metrics",
                                 timeout=10.0) as r:
         text = r.read().decode()
-    assert "voda_scheduler_total_chips 4" in text
+    # Series carry the pool const-label (multi-pool composition).
+    assert 'voda_scheduler_total_chips{pool="default"} 4' in text
 
 
 def test_allocation_endpoint_stateless(urls):

@@ -68,6 +68,9 @@ def main(argv=None) -> int:
                             "VODA_SCHEDULER_SERVER",
                             f"http://{config.SERVICE_HOST}:{config.SCHEDULER_PORT}"),
                         help="scheduler base URL (get status / algorithm / ratelimit)")
+    parser.add_argument("--pool", default=os.environ.get("VODA_POOL"),
+                        help="target pool on a multi-pool control plane "
+                             "(scheduler commands)")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p_create = sub.add_parser("create", help="submit a training job")
@@ -87,6 +90,8 @@ def main(argv=None) -> int:
     p_rate.add_argument("seconds", type=float)
 
     args = parser.parse_args(argv)
+    from urllib.parse import quote as _q
+    pool_q = f"?pool={_q(args.pool, safe='')}" if args.pool else ""
 
     if args.command == "create":
         with open(args.filename, "rb") as f:
@@ -103,16 +108,16 @@ def main(argv=None) -> int:
         rows = _request(f"{args.server}/training")
         _print_table(rows, ["name", "pool", "status", "priority"])
     elif args.command == "get" and args.what == "status":
-        rows = _request(f"{args.scheduler_server}/training")
+        rows = _request(f"{args.scheduler_server}/training{pool_q}")
         _print_table(rows, ["name", "status", "chips", "priority",
                             "running_seconds", "waiting_seconds",
                             "chip_seconds"])
     elif args.command == "algorithm":
-        out = _request(f"{args.scheduler_server}/algorithm", "PUT",
+        out = _request(f"{args.scheduler_server}/algorithm{pool_q}", "PUT",
                        json.dumps({"algorithm": args.name}).encode())
         print(f"algorithm set: {out['algorithm']}")
     elif args.command == "ratelimit":
-        out = _request(f"{args.scheduler_server}/ratelimit", "PUT",
+        out = _request(f"{args.scheduler_server}/ratelimit{pool_q}", "PUT",
                        json.dumps({"seconds": args.seconds}).encode())
         print(f"rate limit set: {out['seconds']}s")
     return 0

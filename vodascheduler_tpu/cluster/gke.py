@@ -177,13 +177,17 @@ class GkeBackend(ClusterBackend):
                  pod_template: Optional[Dict[str, Any]] = None,
                  stop_grace_seconds: int = 120,
                  poll_interval_seconds: float = 2.0,
-                 image: Optional[str] = None):
+                 image: Optional[str] = None,
+                 topology: Optional[Any] = None):
         self.kube = kube
         self.namespace = namespace
         self.pod_template = pod_template or _default_pod_template()
         self.stop_grace_seconds = stop_grace_seconds
         self.poll_interval_seconds = poll_interval_seconds
         self.image = image
+        # Pool topology (PoolTopology) injected as VODA_TOPOLOGY in every
+        # worker pod so supervisors plan meshes on the real host block.
+        self.topology = topology
         self._specs: Dict[str, JobSpec] = {}
         self._jobs: Dict[str, JobHandle] = {}
         self._known_hosts: Dict[str, int] = {}
@@ -193,6 +197,9 @@ class GkeBackend(ClusterBackend):
         # the template ships generateName; deterministic names + a fresh
         # incarnation keep both list-by-label and create race-free).
         self._incarnation: Dict[str, int] = {}
+        # Consecutive sweeps that found zero pods for a tracked job
+        # (vanished-pod detection, see _sweep_jobs).
+        self._missing_pods: Dict[str, int] = {}
         self._lock = threading.RLock()
         self._closed = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -284,9 +291,12 @@ class GkeBackend(ClusterBackend):
             gen = int(labels.get("voda/incarnation", 0))
             with self._lock:
                 # Crash-resume: recover the incarnation counter so the
-                # next scale doesn't reuse live pod/service names.
+                # next scale doesn't reuse live pod/service names, and a
+                # minimal spec so scale_job/_create_pods (which need only
+                # the name) work on resumed jobs.
                 self._incarnation[job] = max(self._incarnation.get(job, 0),
                                              gen)
+                self._specs.setdefault(job, JobSpec(name=job))
         with self._lock:
             self._jobs.update(jobs)
         return dict(jobs)
@@ -365,6 +375,9 @@ class GkeBackend(ClusterBackend):
             env = [
                 {"name": "VODA_JOB_NAME", "value": spec.name},
             ]
+            if self.topology is not None:
+                env.append({"name": "VODA_TOPOLOGY",
+                            "value": str(self.topology)})
             if multi:
                 env += [
                     {"name": "VODA_COORDINATOR_ADDRESS", "value": coordinator},
@@ -412,7 +425,28 @@ class GkeBackend(ClusterBackend):
             pods = self.kube.list_pods(self.namespace,
                                        label_selector=_job_selector(job))
             if not pods:
-                continue  # being created or already reaped
+                # _create_pods runs before the job enters _jobs, so an
+                # empty list for a tracked job means external deletion
+                # (force-delete, node GC). One sweep of grace absorbs
+                # list/create races, then fail loudly — a silent skip
+                # would strand the job as "running" forever (same
+                # contract as multihost.py's external-preemption path).
+                with self._lock:
+                    strikes = self._missing_pods.get(job, 0) + 1
+                    self._missing_pods[job] = strikes
+                    if strikes < 2:
+                        continue
+                    self._jobs.pop(job, None)
+                    self._specs.pop(job, None)
+                    self._missing_pods.pop(job, None)
+                self.kube.delete_service(self.namespace, self._svc_name(job))
+                self.emit(ClusterEvent(
+                    ClusterEventKind.JOB_FAILED, job,
+                    detail="pods vanished outside scheduler control",
+                    timestamp=time.time()))
+                continue
+            with self._lock:
+                self._missing_pods.pop(job, None)
             phases = [p.get("status", {}).get("phase") for p in pods]
             if any(ph in ("Pending", "Running", None) for ph in phases):
                 continue

@@ -77,11 +77,15 @@ class MultiHostBackend(ClusterBackend):
                  num_hosts: int = 2, chips_per_host: int = 4,
                  metrics_dir: Optional[str] = None,
                  stop_grace_seconds: float = 120.0,
-                 poll_interval_seconds: float = 0.2):
+                 poll_interval_seconds: float = 0.2,
+                 topology: Optional[object] = None):
         self.workdir = os.path.abspath(workdir)
         self.metrics_dir = metrics_dir or os.path.join(self.workdir, "metrics")
         self.hosts = dict(hosts) if hosts is not None else {
             f"host-{i}": chips_per_host for i in range(num_hosts)}
+        # Pool topology forwarded to supervisors as VODA_TOPOLOGY (mesh
+        # planning keeps tp within this pool's host block).
+        self.topology = topology
         self.stop_grace_seconds = stop_grace_seconds
         self.poll_interval_seconds = poll_interval_seconds
         os.makedirs(self.workdir, exist_ok=True)
@@ -204,6 +208,8 @@ class MultiHostBackend(ClusterBackend):
             # jax.distributed joins them into the global mesh. A single-
             # entry placement needs no coordinator (plain local job).
             env["VODA_FORCE_CPU_DEVICES"] = str(chips)
+            if self.topology is not None:
+                env["VODA_TOPOLOGY"] = str(self.topology)
             if not single:
                 env["VODA_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
                 env["VODA_NUM_PROCESSES"] = str(len(placements))

@@ -27,10 +27,12 @@ def timed(summary: "Summary", **labels: str):
 
 
 class Counter:
-    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help_
         self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
         self._values: Dict[Tuple[str, ...], float] = {}
         self._lock = threading.Lock()
 
@@ -48,7 +50,7 @@ class Counter:
         with self._lock:
             values = dict(self._values) or {(): 0.0} if not self.label_names else dict(self._values)
         for key, v in values.items():
-            lines.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+            lines.append(f"{self.name}{_merge_labels(self.const_labels, self.label_names, key)} {v}")
         return lines
 
 
@@ -59,10 +61,12 @@ class Gauge:
 
     def __init__(self, name: str, help_: str,
                  fn: Optional[Callable[[], float]] = None,
-                 label_names: Tuple[str, ...] = ()):
+                 label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help_
         self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
         self._fn = fn
         self._value = 0.0
         self._values: Dict[Tuple[str, ...], float] = {}
@@ -101,19 +105,23 @@ class Gauge:
             with self._lock:
                 for key, v in self._values.items():
                     lines.append(
-                        f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+                        f"{self.name}{_merge_labels(self.const_labels, self.label_names, key)} {v}")
         else:
-            lines.append(f"{self.name} {self.value()}")
+            lines.append(
+                f"{self.name}{_merge_labels(self.const_labels, (), ())} "
+                f"{self.value()}")
         return lines
 
 
 class Summary:
     """Count/sum summary (quantile-free, like an untimed reference Summary)."""
 
-    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help_
         self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
         self._sum: Dict[Tuple[str, ...], float] = {}
         self._count: Dict[Tuple[str, ...], int] = {}
         self._lock = threading.Lock()
@@ -137,7 +145,7 @@ class Summary:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
         with self._lock:
             for key in self._count:
-                labels = _fmt_labels(self.label_names, key)
+                labels = _merge_labels(self.const_labels, self.label_names, key)
                 lines.append(f"{self.name}_sum{labels} {self._sum[key]}")
                 lines.append(f"{self.name}_count{labels} {self._count[key]}")
         return lines
@@ -150,6 +158,16 @@ def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
     return "{" + inner + "}"
 
 
+def _merge_labels(const: Dict[str, str], names: Tuple[str, ...],
+                  values: Tuple[str, ...]) -> str:
+    """Const labels (e.g. pool="v5p") prepended to the variable labels —
+    how N pools share one registry without colliding series (the
+    reference runs one process per pool instead)."""
+    all_names = tuple(const.keys()) + names
+    all_values = tuple(const.values()) + values
+    return _fmt_labels(all_names, all_values)
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: List[object] = []
@@ -158,19 +176,46 @@ class Registry:
         self._metrics.append(metric)
         return metric
 
-    def counter(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Counter:
-        return self.register(Counter(name, help_, labels))
+    def counter(self, name: str, help_: str, labels: Tuple[str, ...] = (),
+                const_labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self.register(Counter(name, help_, labels,
+                                     const_labels=const_labels))
 
     def gauge(self, name: str, help_: str,
               fn: Optional[Callable[[], float]] = None,
-              labels: Tuple[str, ...] = ()) -> Gauge:
-        return self.register(Gauge(name, help_, fn, label_names=labels))
+              labels: Tuple[str, ...] = (),
+              const_labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self.register(Gauge(name, help_, fn, label_names=labels,
+                                   const_labels=const_labels))
 
-    def summary(self, name: str, help_: str, labels: Tuple[str, ...] = ()) -> Summary:
-        return self.register(Summary(name, help_, labels))
+    def summary(self, name: str, help_: str, labels: Tuple[str, ...] = (),
+                const_labels: Optional[Dict[str, str]] = None) -> Summary:
+        return self.register(Summary(name, help_, labels,
+                                     const_labels=const_labels))
 
     def exposition(self) -> str:
-        lines: List[str] = []
+        # Multi-pool registrations repeat metric names (same name, a
+        # different pool const-label). The text format requires all of a
+        # family's lines as ONE group with a single HELP/TYPE header, so
+        # group collected lines by family name, in first-seen order.
+        headers: Dict[str, List[str]] = {}
+        samples: Dict[str, List[str]] = {}
+        order: List[str] = []
         for m in self._metrics:
-            lines.extend(m.collect())
+            name = m.name
+            if name not in samples:
+                order.append(name)
+                headers[name] = []
+                samples[name] = []
+            for line in m.collect():
+                if line.startswith("# "):
+                    if not headers[name] or line not in headers[name]:
+                        if len(headers[name]) < 2:
+                            headers[name].append(line)
+                else:
+                    samples[name].append(line)
+        lines: List[str] = []
+        for name in order:
+            lines.extend(headers[name])
+            lines.extend(samples[name])
         return "\n".join(lines) + "\n"

@@ -1,12 +1,18 @@
 """Mixtral-style sparse MoE decoder (BASELINE.md config 5's MoE family).
 
-TPU-first MoE: top-2 gating with *dense dispatch* — every expert computes
-every token, weighted by the router's (renormalized, top-k-masked) probs
-via one batched einsum over the expert axis. For the expert counts here
-(8) this trades FLOPs for an XLA-friendly static dataflow: no gather/
-scatter, no capacity overflow, perfectly shardable over `ep` (each device
-holds its experts' weights; psum over ep combines outputs). Token-dropping
-all_to_all dispatch is the planned pallas upgrade for large expert counts.
+TPU-first MoE, two dispatches behind one MoEBlock:
+
+- "routed" (default): capacity-bounded token routing in the GShard
+  one-hot-matmul formulation (ops/moe_dispatch.py) — each expert
+  computes only its routed tokens (~top_k/E of the FLOPs of dense),
+  all shapes static, and under an `ep`-sharded mesh the dispatch/
+  combine einsums lower to the all_to_all pair GSPMD derives from the
+  shardings. Over-capacity tokens drop (combine weight 0) and ride the
+  residual — the standard top-k MoE contract.
+- "dense": every expert computes every token, weighted by the gates —
+  E/top_k more FLOPs but zero routing machinery; the small-scale
+  fallback and the parity oracle the routed path is tested against
+  (tests/test_models.py).
 """
 
 from __future__ import annotations
@@ -34,6 +40,8 @@ class MixtralConfig:
     top_k: int = 2
     rope_base: float = 1000000.0
     dtype: str = "bfloat16"
+    dispatch: str = "routed"          # "routed" | "dense"
+    capacity_factor: float = 1.25     # routed: slots per expert vs even load
 
     @property
     def head_dim(self) -> int:
@@ -59,10 +67,8 @@ class MoEBlock(nn.Module):
                           dtype=jnp.float32, param_dtype=jnp.float32)(
                               x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)             # [B,S,E]
-        top_vals, _ = jax.lax.top_k(probs, cfg.top_k)
-        threshold = top_vals[..., -1:]                       # kth largest
-        gate = jnp.where(probs >= threshold, probs, 0.0)
-        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+        from vodascheduler_tpu.ops.moe_dispatch import top_k_gating
+        gate = top_k_gating(probs, cfg.top_k)
 
         # expert weights stacked on a leading E axis (shardable over ep)
         E, H = cfg.num_experts, cfg.mlp_hidden
@@ -70,6 +76,12 @@ class MoEBlock(nn.Module):
         w_gate = self.param("experts_gate_kernel", init, (E, D, H))
         w_up = self.param("experts_up_kernel", init, (E, D, H))
         w_down = self.param("experts_down_kernel", init, (E, H, D))
+
+        if cfg.dispatch == "routed":
+            from vodascheduler_tpu.ops.moe_dispatch import routed_ffn
+            return routed_ffn(x, gate, w_gate, w_up, w_down,
+                              capacity_factor=cfg.capacity_factor,
+                              top_k=cfg.top_k)
 
         xb = x.astype(jnp.bfloat16)
         h = jnp.einsum("bsd,edh->besh", xb, w_gate.astype(jnp.bfloat16))

@@ -1,0 +1,102 @@
+"""Token-routed MoE dispatch: capacity-bounded, all-static, ep-shardable.
+
+The GShard/Mesh-TensorFlow formulation, chosen deliberately for TPU: the
+dispatch and combine are ONE-HOT MATMULS, not gathers —
+
+    dispatch [T,E,C] one-hot  x  tokens [T,D]  ->  expert inputs [E,C,D]
+    combine  [T,E,C] weights  x  outputs [E,C,D] -> tokens [T,D]
+
+Every shape is static (capacity C fixed ahead of time), so XLA tiles the
+whole thing onto the MXU, and with the expert axis sharded over `ep` the
+two einsums lower to exactly the all_to_all pair a hand-written dispatch
+would issue (tokens are dp-sharded on T, expert inputs ep-sharded on E —
+GSPMD inserts the transposing collectives). Tokens routed beyond an
+expert's capacity are dropped (their combine weight is 0, so they pass
+through the residual unchanged) — the standard top-k MoE contract.
+
+Reference parity: the reference has no MoE; Mixtral is a BASELINE.md
+config-5 family. models/mixtral.py uses this as its default dispatch and
+keeps the dense everyone-computes-everything path (`dispatch="dense"`)
+as the small-scale/testing fallback; the two are parity-tested against
+each other in tests/test_models.py with a capacity factor high enough
+that nothing drops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(probs: jnp.ndarray, top_k: int,
+                 eps: float = 1e-9) -> jnp.ndarray:
+    """Top-k mask + renormalize: [..., E] probs -> [..., E] gates where
+    only each token's k largest survive, rescaled to sum to 1."""
+    top_vals, _ = jax.lax.top_k(probs, top_k)
+    threshold = top_vals[..., -1:]
+    gate = jnp.where(probs >= threshold, probs, 0.0)
+    return gate / jnp.maximum(gate.sum(-1, keepdims=True), eps)
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token slots: ceil(T*k/E * factor), lane-rounded (the
+    [E,C,D] buffers tile better when C is a multiple of 8), capped at T."""
+    c = math.ceil(num_tokens * top_k / num_experts * capacity_factor)
+    c = min(num_tokens, max(8, -(-c // 8) * 8))
+    return c
+
+
+def route(gates: jnp.ndarray, capacity: int
+          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dispatch/combine tensors from per-token gates.
+
+    gates [T, E] (0 where not routed). Tokens claim expert slots in
+    token order (cumsum priority — earlier sequence positions win,
+    matching the GShard position-in-expert rule); a token that finds its
+    expert full is dropped for that expert.
+
+    Returns (dispatch [T,E,C] one-hot float, combine [T,E,C] weights).
+    """
+    routed = gates > 0.0                                   # [T,E]
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(routed.astype(jnp.int32), axis=0) - 1  # [T,E]
+    kept = routed & (pos < capacity)
+    onehot = jax.nn.one_hot(jnp.where(kept, pos, capacity), capacity,
+                            dtype=gates.dtype)              # [T,E,C]
+    dispatch = onehot * kept[..., None]
+    combine = dispatch * gates[..., None]
+    return dispatch, combine
+
+
+def routed_ffn(x: jnp.ndarray, gates: jnp.ndarray,
+               w_gate: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
+               capacity_factor: float = 1.25,
+               top_k: int = 2) -> jnp.ndarray:
+    """Top-k routed SwiGLU experts over a [B, S, D] activation.
+
+    w_gate/w_up [E, D, H], w_down [E, H, D] — the same stacked-expert
+    layout the dense path uses, so the two dispatches share weights.
+    Compute runs in bf16 (MXU), routing math in fp32.
+    """
+    B, S, D = x.shape
+    E = w_gate.shape[0]
+    T = B * S
+    gates_f = gates.reshape(T, E).astype(jnp.float32)
+    capacity = expert_capacity(T, E, top_k, capacity_factor)
+    dispatch, combine = route(gates_f, capacity)
+
+    xb = x.reshape(T, D).astype(jnp.bfloat16)
+    disp_b = dispatch.astype(jnp.bfloat16)
+    # all_to_all #1 (under ep sharding): tokens -> expert slots.
+    expert_in = jnp.einsum("tec,td->ecd", disp_b, xb)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w_gate.astype(jnp.bfloat16))
+    u = jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(jnp.bfloat16))
+    y = jnp.einsum("ech,ehd->ecd", jax.nn.silu(h) * u,
+                   w_down.astype(jnp.bfloat16))
+    # all_to_all #2: expert slots -> tokens, combine-weighted in fp32.
+    out = jnp.einsum("tec,ecd->td", combine, y.astype(jnp.float32))
+    return out.reshape(B, S, D).astype(x.dtype)

@@ -65,19 +65,22 @@ class PlacementManager:
         # summary + migrated/deleted/cross-node gauges of the last pass).
         if registry is None:
             registry = Registry()
+        pool_l = {"pool": pool_id}  # N pools, one registry, no collisions
         self.m_algo_duration = registry.summary(
             "voda_placement_algo_duration_seconds",
-            "Placement pass duration", ("mode",))
+            "Placement pass duration", ("mode",), const_labels=pool_l)
         self.m_workers_migrated = registry.gauge(
             "voda_placement_workers_migrated",
-            "Workers that changed host in the last placement pass")
+            "Workers that changed host in the last placement pass",
+            const_labels=pool_l)
         self.m_full_restarts = registry.gauge(
             "voda_placement_full_restarts",
             "Jobs whose entire worker set moved in the last pass "
-            "(reference: launchers deleted)")
+            "(reference: launchers deleted)", const_labels=pool_l)
         self.m_jobs_cross_host = registry.gauge(
             "voda_placement_jobs_cross_host",
-            "Jobs spanning more than one host after the last pass")
+            "Jobs spanning more than one host after the last pass",
+            const_labels=pool_l)
 
     # ---- host membership (reference: node informer handlers :174-304) ----
 

@@ -194,6 +194,19 @@ class PoolTopology:
         shapes = feasible_shapes(num_chips, self.torus_dims)
         return shapes[0] if shapes else None
 
+    def __str__(self) -> str:
+        """Round-trippable "4x4x4/2x2x1" form — the VODA_TOPOLOGY env
+        value backends hand to supervisors (torus dims / host block)."""
+        return (f"{'x'.join(str(d) for d in self.torus_dims)}/"
+                f"{'x'.join(str(d) for d in self.host_block)}")
+
+    @staticmethod
+    def parse(s: str) -> "PoolTopology":
+        torus, _, block = s.partition("/")
+        return PoolTopology(
+            torus_dims=tuple(int(d) for d in torus.split("x")),
+            host_block=tuple(int(d) for d in block.split("x")))
+
 
 def default_pool(num_hosts: int, chips_per_host: int = 4) -> PoolTopology:
     """Convenience: a 1D host ring with `chips_per_host`-chip hosts — the

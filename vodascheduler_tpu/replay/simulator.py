@@ -81,6 +81,7 @@ class ReplayHarness:
         # cuts +1-chip resize oscillation, improving both utilization and
         # mean JCT; 1.0 restores reference apply-every-diff semantics.
         scale_out_hysteresis: float = 2.0,
+        resize_cooldown_seconds: float = 120.0,
         collector_interval_seconds: float = 60.0,
         preemptions: Sequence[PreemptionEvent] = (),
         start_epoch: float = 1753760000.0,
@@ -106,7 +107,8 @@ class ReplayHarness:
             pool, self.backend, self.store, ResourceAllocator(self.store),
             self.clock, bus=self.bus, placement_manager=pm,
             algorithm=algorithm, rate_limit_seconds=rate_limit_seconds,
-            scale_out_hysteresis=scale_out_hysteresis)
+            scale_out_hysteresis=scale_out_hysteresis,
+            resize_cooldown_seconds=resize_cooldown_seconds)
         self.admission = AdmissionService(self.store, self.bus, self.clock)
         self.collector = MetricsCollector(
             self.store, BackendRowSource(self.backend), self.clock,

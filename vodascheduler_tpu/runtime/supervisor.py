@@ -149,13 +149,23 @@ def run_job(workdir: str, num_chips: int,
               file=sys.stderr)
         return 2
 
+    # Pool topology from the backend (VODA_TOPOLOGY="4x4x4/2x2x1"): mesh
+    # planning then respects the pool's real host block (tp intra-host)
+    # and the allocator's feasibility-rounded slice shape for this grant.
+    topology = None
+    topo_env = os.environ.get("VODA_TOPOLOGY")
+    if topo_env:
+        from vodascheduler_tpu.placement.topology import PoolTopology
+        topology = PoolTopology.parse(topo_env)
+
     if latest_step(ckpt_dir) is not None:
         session = TrainSession.resume(
             bundle, num_chips, ckpt_dir, devices=devices,
-            global_batch_size=spec.global_batch_size)
+            global_batch_size=spec.global_batch_size, topology=topology)
     else:
         session = TrainSession(bundle, num_chips, devices=devices,
-                               global_batch_size=spec.global_batch_size)
+                               global_batch_size=spec.global_batch_size,
+                               topology=topology)
 
     steps_per_epoch = max(1, spec.steps_per_epoch)
     total_steps = spec.config.epochs * steps_per_epoch

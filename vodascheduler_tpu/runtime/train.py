@@ -68,12 +68,21 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
                      devices: Optional[Sequence[jax.Device]] = None,
                      learning_rate: float = 1e-3,
                      plan: Optional[MeshPlan] = None,
-                     global_batch_size: int = 8) -> TrainSetup:
+                     global_batch_size: int = 8,
+                     topology: Optional[Any] = None) -> TrainSetup:
     devices = list(devices if devices is not None else jax.devices())[:num_chips]
     if plan is None:
+        # The pool topology (PoolTopology via the backend's VODA_TOPOLOGY
+        # env) reshapes planning for the pool's real host block — tp stays
+        # intra-host on v5e-style 1/8-chip hosts as well as the 4-chip
+        # default — and the granted slice shape (the allocator's
+        # feasibility-rounded unit) pins the chip count exactly.
+        slice_shape = (topology.slice_for(num_chips)
+                       if topology is not None else None)
         plan = plan_mesh(num_chips, model_params_b=bundle.params_b,
                          seq_len=bundle.seq_len,
-                         num_experts=bundle.num_experts)
+                         num_experts=bundle.num_experts,
+                         topology=topology, slice_shape=slice_shape)
     mesh = build_mesh(plan, devices)
     module = bundle.module
 
@@ -196,13 +205,15 @@ class TrainSession:
                  global_batch_size: int = 8, seed: int = 0,
                  devices: Optional[Sequence[jax.Device]] = None,
                  plan: Optional[MeshPlan] = None, init: bool = True,
-                 learning_rate: float = 1e-3):
+                 learning_rate: float = 1e-3,
+                 topology: Optional[Any] = None):
         self.bundle = bundle
         self.num_chips = num_chips
         self.global_batch_size = global_batch_size
         self.setup = make_train_setup(bundle, num_chips, devices=devices,
                                       plan=plan, learning_rate=learning_rate,
-                                      global_batch_size=global_batch_size)
+                                      global_batch_size=global_batch_size,
+                                      topology=topology)
         self.rng = jax.random.PRNGKey(seed)
         self.state = self.setup.init_fn(jax.random.PRNGKey(seed)) if init \
             else None
@@ -257,7 +268,8 @@ class TrainSession:
                devices: Optional[Sequence[jax.Device]] = None,
                plan: Optional[MeshPlan] = None,
                step: Optional[int] = None,
-               learning_rate: float = 1e-3) -> "TrainSession":
+               learning_rate: float = 1e-3,
+               topology: Optional[Any] = None) -> "TrainSession":
         """Rebuild a session at a (possibly different) chip count from a
         checkpoint — the elastic-resize restore path (SURVEY.md §7:
         resize = restart-with-reshard). `learning_rate` may differ from the
@@ -266,7 +278,7 @@ class TrainSession:
         from vodascheduler_tpu.runtime import checkpoint as ckpt
         session = cls(bundle, num_chips, global_batch_size=global_batch_size,
                       devices=devices, plan=plan, init=False,
-                      learning_rate=learning_rate)
+                      learning_rate=learning_rate, topology=topology)
         session.state, session.rng = ckpt.restore_checkpoint(
             ckpt_dir, session.setup, step=step)
         return session

@@ -151,34 +151,50 @@ class Scheduler:
     def _init_metrics(self, registry: Registry) -> None:
         """Reference series: pkg/scheduler/scheduler/metrics.go:12-196."""
         self.registry = registry
+        # pool const-label: N pools share one registry/exposition without
+        # colliding series (reference: one scheduler process per GPU type).
+        pool_l = {"pool": self.pool_id}
         self.m_resched_total = registry.counter(
-            "voda_scheduler_resched_total", "Reschedulings executed")
+            "voda_scheduler_resched_total", "Reschedulings executed",
+            const_labels=pool_l)
         self.m_resched_seconds = registry.summary(
-            "voda_scheduler_resched_duration_seconds", "Rescheduling latency")
+            "voda_scheduler_resched_duration_seconds", "Rescheduling latency",
+            const_labels=pool_l)
         self.m_alloc_seconds = registry.summary(
             "voda_scheduler_resched_allocation_duration_seconds",
-            "Allocator call latency")
+            "Allocator call latency", const_labels=pool_l)
         self.m_jobs_completed = registry.counter(
-            "voda_scheduler_jobs_completed_total", "Jobs completed")
+            "voda_scheduler_jobs_completed_total", "Jobs completed",
+            const_labels=pool_l)
         self.m_jobs_failed = registry.counter(
-            "voda_scheduler_jobs_failed_total", "Jobs failed")
+            "voda_scheduler_jobs_failed_total", "Jobs failed",
+            const_labels=pool_l)
         self.m_jobs_created = registry.counter(
-            "voda_scheduler_jobs_created_total", "Jobs accepted")
+            "voda_scheduler_jobs_created_total", "Jobs accepted",
+            const_labels=pool_l)
         self.m_jobs_deleted = registry.counter(
-            "voda_scheduler_jobs_deleted_total", "Jobs deleted by user")
+            "voda_scheduler_jobs_deleted_total", "Jobs deleted by user",
+            const_labels=pool_l)
         self.m_job_restarts = registry.counter(
             "voda_scheduler_job_restarts_total",
-            "Checkpoint-restart incarnations (start/scale/migration)")
+            "Checkpoint-restart incarnations (start/scale/migration)",
+            const_labels=pool_l)
         registry.gauge("voda_scheduler_ready_jobs",
-                       "Jobs in the ready queue", fn=lambda: float(len(self.ready_jobs)))
+                       "Jobs in the ready queue",
+                       fn=lambda: float(len(self.ready_jobs)),
+                       const_labels=pool_l)
         registry.gauge("voda_scheduler_running_jobs", "Jobs allocated chips",
-                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n > 0)))
+                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n > 0)),
+                       const_labels=pool_l)
         registry.gauge("voda_scheduler_waiting_jobs", "Ready jobs with zero chips",
-                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n == 0)))
+                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n == 0)),
+                       const_labels=pool_l)
         registry.gauge("voda_scheduler_total_chips", "Pool chip capacity",
-                       fn=lambda: float(self.total_chips))
+                       fn=lambda: float(self.total_chips),
+                       const_labels=pool_l)
         registry.gauge("voda_scheduler_allocated_chips", "Chips allocated",
-                       fn=lambda: float(sum(self.job_num_chips.values())))
+                       fn=lambda: float(sum(self.job_num_chips.values())),
+                       const_labels=pool_l)
 
     def _start_ticker(self) -> None:
         def tick() -> None:

@@ -12,9 +12,10 @@ replaces Mongo, and each piece still stands alone for a split deployment
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Union
 
 from vodascheduler_tpu import config
 from vodascheduler_tpu.allocator import ResourceAllocator
@@ -39,6 +40,52 @@ from vodascheduler_tpu.service.rest import (
 log = logging.getLogger(__name__)
 
 
+@dataclasses.dataclass
+class PoolSpec:
+    """One TPU pool of the control plane.
+
+    The reference deploys one scheduler per GPU type, each its own Helm
+    release fed by a per-type queue (helm/voda-scheduler/,
+    scheduler.go:189-190). Here N pools compose into one process: one
+    scheduler + placement manager + backend per pool over the shared
+    store/bus/allocator.
+    """
+
+    name: str
+    topology: Optional[object] = None    # placement.topology.PoolTopology
+    chips: Optional[int] = None          # capacity when no topology given
+    algorithm: Optional[str] = None      # per-pool override
+
+
+def parse_pools(spec: str, default_algorithm: str) -> List[PoolSpec]:
+    """Parse `--pools "v5p=4x4x4/2x2x1,v5e=16"`: each entry is
+    name=torus/host_block (a real topology) or name=N (flat chip count).
+    An optional :Algorithm suffix overrides the default per pool."""
+    from vodascheduler_tpu.placement.topology import PoolTopology
+    out: List[PoolSpec] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rest = entry.partition("=")
+        algo = default_algorithm
+        if ":" in rest:
+            rest, _, algo = rest.partition(":")
+        if not rest:
+            out.append(PoolSpec(name=name, algorithm=algo))
+        elif "/" in rest:
+            out.append(PoolSpec(name=name, topology=PoolTopology.parse(rest),
+                                algorithm=algo))
+        else:
+            out.append(PoolSpec(name=name, chips=int(rest), algorithm=algo))
+    if not out:
+        raise ValueError(f"no pools in {spec!r}")
+    names = [p.name for p in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate pool names in {spec!r}: {names}")
+    return out
+
+
 class VodaApp:
     def __init__(self, workdir: str = config.WORKDIR,
                  pool: str = config.DEFAULT_POOL,
@@ -52,7 +99,8 @@ class VodaApp:
                  allocator_port: int = config.ALLOCATOR_PORT,
                  rate_limit_seconds: float = 30.0,
                  collector_interval_seconds: float = 60.0,
-                 resume: bool = False):
+                 resume: bool = False,
+                 pools: Union[None, str, List[PoolSpec]] = None):
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.clock = Clock()
@@ -62,28 +110,67 @@ class VodaApp:
 
         self.allocator = ResourceAllocator(self.store, registry=self.registry)
 
-        jobs_dir = os.path.join(self.workdir, "jobs")
-        if backend == "local":
-            from vodascheduler_tpu.cluster.local import LocalBackend
-            self.backend = LocalBackend(jobs_dir, chips=chips,
-                                        hermetic_devices=hermetic_devices)
+        # Pool set: explicit multi-pool spec, or the single-pool args
+        # (reference: one scheduler Deployment per GPU type; here one
+        # Scheduler per pool in-process, same shared store/bus).
+        if pools is None:
+            pool_specs = [PoolSpec(name=pool, chips=chips,
+                                   algorithm=algorithm)]
+        elif isinstance(pools, str):
+            pool_specs = parse_pools(pools, algorithm)
         else:
+            pool_specs = list(pools)
+        names = [p.name for p in pool_specs]
+        if len(set(names)) != len(names):
+            # Two schedulers with one pool_id would race on the same bus
+            # topic and collide their const-labeled metric series.
+            raise ValueError(f"duplicate pool names: {names}")
+
+        if backend != "local":
             raise ValueError(f"unknown backend {backend!r} (the app serves "
                              "real local training; simulation lives in replay/)")
 
-        self.placement = PlacementManager(pool_id=pool,
-                                          registry=self.registry)
-        self.scheduler = Scheduler(
-            pool_id=pool, backend=self.backend, store=self.store,
-            allocator=self.allocator, clock=self.clock, bus=self.bus,
-            algorithm=algorithm, rate_limit_seconds=rate_limit_seconds,
-            resume=resume, registry=self.registry,
-            placement_manager=self.placement)
+        from vodascheduler_tpu.cluster.local import LocalBackend
+        self.backends: Dict[str, LocalBackend] = {}
+        self.placements: Dict[str, PlacementManager] = {}
+        self.schedulers: Dict[str, Scheduler] = {}
+        self.collectors: Dict[str, MetricsCollector] = {}
+        single = len(pool_specs) == 1
+        for ps in pool_specs:
+            # Single-pool keeps the flat jobs/ dir (back-compat with
+            # existing workdirs); multi-pool namespaces per pool.
+            jobs_dir = os.path.join(self.workdir, "jobs") if single else \
+                os.path.join(self.workdir, "jobs", ps.name)
+            pool_chips = ps.chips
+            if pool_chips is None and ps.topology is not None:
+                pool_chips = ps.topology.total_chips
+            be = LocalBackend(jobs_dir, chips=pool_chips,
+                              hermetic_devices=hermetic_devices,
+                              topology=ps.topology)
+            pm = PlacementManager(pool_id=ps.name, topology=ps.topology,
+                                  registry=self.registry)
+            sched = Scheduler(
+                pool_id=ps.name, backend=be, store=self.store,
+                allocator=self.allocator, clock=self.clock, bus=self.bus,
+                algorithm=ps.algorithm or algorithm,
+                rate_limit_seconds=rate_limit_seconds,
+                resume=resume, registry=self.registry,
+                placement_manager=pm)
+            self.backends[ps.name] = be
+            self.placements[ps.name] = pm
+            self.schedulers[ps.name] = sched
+            self.collectors[ps.name] = MetricsCollector(
+                self.store, CsvDirRowSource(be.metrics_dir),
+                interval_seconds=collector_interval_seconds)
+
+        # Back-compat single-pool attributes (first pool).
+        first = pool_specs[0].name
+        self.backend = self.backends[first]
+        self.placement = self.placements[first]
+        self.scheduler = self.schedulers[first]
+        self.collector = self.collectors[first]
         self.admission = AdmissionService(self.store, self.bus, self.clock,
                                           registry=self.registry)
-        self.collector = MetricsCollector(
-            self.store, CsvDirRowSource(self.backend.metrics_dir),
-            interval_seconds=collector_interval_seconds)
         # Chip telemetry on the shared /metrics endpoints (reference
         # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
         # Collected only when this process may own a jax backend: hermetic
@@ -100,7 +187,8 @@ class VodaApp:
             from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
             self.tpu_monitor = TpuMonitor(self.registry)
             periodic.append((30.0, self.tpu_monitor.collect_once))
-        self.daemon = SchedulerDaemon([self.scheduler], periodic=periodic)
+        self.daemon = SchedulerDaemon(list(self.schedulers.values()),
+                                      periodic=periodic)
 
         # Warm the native kernels off the resched hot path (first use would
         # otherwise block a resched on a synchronous g++ build).
@@ -112,15 +200,16 @@ class VodaApp:
         self.service_server = make_service_server(
             self.admission, self.registry, host=host, port=service_port)
         self.scheduler_server = make_scheduler_server(
-            self.scheduler, self.registry, host=host, port=scheduler_port)
+            self.schedulers, self.registry, host=host, port=scheduler_port)
         self.allocator_server = make_allocator_server(
             self.allocator, self.registry, host=host, port=allocator_port)
 
     def _collect_and_resched(self) -> None:
         """Collector pass; fresh curves can change info-driven allocations
         (reference: collector writes Mongo, next resched reads it §3.5)."""
-        if self.collector.collect_all() > 0:
-            self.scheduler.trigger_resched()
+        for name, collector in self.collectors.items():
+            if collector.collect_all() > 0:
+                self.schedulers[name].trigger_resched()
 
     def start(self) -> None:
         self.daemon.start()
@@ -136,9 +225,11 @@ class VodaApp:
         self.scheduler_server.stop()
         self.allocator_server.stop()
         self.daemon.stop()
-        self.scheduler.stop()
-        if hasattr(self.backend, "close"):
-            self.backend.close()
+        for sched in self.schedulers.values():
+            sched.stop()
+        for be in self.backends.values():
+            if hasattr(be, "close"):
+                be.close()
         self.store.flush()
 
 
@@ -156,6 +247,13 @@ def main(argv=None) -> int:
                              "(no TPU needed)")
     parser.add_argument("--chips", type=int, default=None,
                         help="pool capacity override")
+    parser.add_argument("--pools", default=None,
+                        help="multi-pool spec: name=torus/hostblock or "
+                             "name=chips, comma-separated, optional "
+                             ":Algorithm suffix — e.g. "
+                             "'v5p=4x4x4/2x2x1,v5e=16:ElasticFIFO'. One "
+                             "scheduler per pool (reference: one scheduler "
+                             "deployment per GPU type)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--resume", action="store_true",
                         help="reconstruct state from store + running jobs "
@@ -168,7 +266,8 @@ def main(argv=None) -> int:
                   algorithm=args.algorithm,
                   hermetic_devices=args.hermetic_devices, chips=args.chips,
                   host=args.host, resume=args.resume,
-                  collector_interval_seconds=args.collector_interval)
+                  collector_interval_seconds=args.collector_interval,
+                  pools=args.pools)
     app.start()
     try:
         import threading

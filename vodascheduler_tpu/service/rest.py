@@ -164,27 +164,60 @@ def make_service_server(admission: AdmissionService, registry: Registry,
 def make_scheduler_server(scheduler, registry: Registry,
                           host: str = "0.0.0.0",
                           port: int = config.SCHEDULER_PORT) -> RestServer:
-    """Per-pool scheduler API (reference: scheduler.go:256-261)."""
+    """Scheduler API (reference: scheduler.go:256-261).
+
+    Accepts a single Scheduler or a {pool: Scheduler} dict; with several
+    pools the `?pool=` query (or a "pool" body key) routes the request —
+    the single-port composition of the reference's one-service-per-pool
+    deployment. Default: the sole pool, else 400 listing the choices.
+    """
+    schedulers = scheduler if isinstance(scheduler, dict) else \
+        {getattr(scheduler, "pool_id", "default"): scheduler}
+
+    def pick(body, query):
+        pool = (query.get("pool", [None])[0]
+                if isinstance(query.get("pool"), list) else query.get("pool"))
+        if pool is None and body:
+            try:
+                data = yaml.safe_load(body)
+                if isinstance(data, dict):
+                    pool = data.get("pool")
+            except Exception:
+                pool = None
+        if pool is None:
+            if len(schedulers) == 1:
+                return next(iter(schedulers.values()))
+            raise ValueError(
+                f"multiple pools {sorted(schedulers)}: pass ?pool=<name>")
+        if pool not in schedulers:
+            raise ValueError(f"unknown pool {pool!r}; have {sorted(schedulers)}")
+        return schedulers[pool]
 
     def get_training(body, query):
-        return 200, scheduler.status_table()
+        return 200, pick(body, query).status_table()
 
     def put_algorithm(body, query):
         data = yaml.safe_load(body)
         name = data["algorithm"] if isinstance(data, dict) else str(data).strip()
-        scheduler.set_algorithm(name)
+        pick(body, query).set_algorithm(name)
         return 200, {"algorithm": name}
 
     def put_ratelimit(body, query):
         data = yaml.safe_load(body)
         seconds = float(data["seconds"] if isinstance(data, dict) else data)
-        scheduler.set_rate_limit(seconds)
+        pick(body, query).set_rate_limit(seconds)
         return 200, {"seconds": seconds}
+
+    def get_pools(body, query):
+        return 200, {name: {"algorithm": s.algorithm,
+                            "total_chips": s.total_chips}
+                     for name, s in schedulers.items()}
 
     return RestServer({
         ("GET", "/training"): get_training,
         ("PUT", "/algorithm"): put_algorithm,
         ("PUT", "/ratelimit"): put_ratelimit,
+        ("GET", "/pools"): get_pools,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
 
