@@ -1,58 +1,121 @@
 #!/usr/bin/env python
-"""Headline benchmark: 64-job Philly-style trace replay on a simulated
-v5p-64 pool under Elastic-Tiresias.
+"""Headline benchmark: 64-job Philly-style trace replay WITH spot
+preemption on a simulated v5p-64 pool under Elastic-Tiresias, plus — when
+an accelerator is present — measured hardware numbers (model step time /
+MFU and flash-vs-XLA attention) from runtime/hwbench.py.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-vs_baseline is measured chip utilization against the BASELINE.json north
-star (>= 0.85 chip utilization on this scenario). The whole control plane
-(admission, allocator, scheduler, placement, metrics-feedback loop) is the
-production code path; only the cluster and clock are simulated, so the
-number reflects real scheduling behavior, not a model of it.
+The whole control plane (admission, allocator, scheduler, placement,
+metrics-feedback loop) is the production code path; only the cluster and
+clock are simulated, so the replay number reflects real scheduling
+behavior. The hardware section is never simulated.
+
+Knob choice (rate_limit=20s, scale_out_hysteresis=1.5, resize_cooldown=60s)
+is the knee of a rate x hysteresis x cooldown sweep (r3): avg JCT 2752s at
+0.92 steady-state utilization without preemption — both better than r1's
+3195s/0.87 and far off r2's util-max corner (45s/2.0: util 0.945 but JCT
+6776s). BASELINE.json's metric is "avg JCT + cluster util"; the sweep
+optimizes JCT subject to util >= 0.85 instead of maxing either alone.
 """
 
 import json
+import os
 import sys
 
-sys.path.insert(0, ".")
-
-from vodascheduler_tpu.placement import PoolTopology
-from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TARGET_UTILIZATION = 0.85  # BASELINE.json north star
+JCT_TARGET_SECONDS = 3195.0         # r1's avg JCT — never regress past it
+# The r3 sweep knee (see module docstring); used by the run AND the report.
+RATE_LIMIT_SECONDS = 20.0
+SCALE_OUT_HYSTERESIS = 1.5
+RESIZE_COOLDOWN_SECONDS = 60.0
+
+
+def run_replay():
+    from vodascheduler_tpu.placement import PoolTopology
+    from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
+    from vodascheduler_tpu.replay.simulator import PreemptionEvent
+
+    trace = philly_like_trace(num_jobs=64, seed=20260729)
+    topology = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))  # 64
+    # Spot preemption (BASELINE config 5): two hosts reclaimed mid-trace,
+    # returned later — the fleet dips 8/64 chips for ~1.4 simulated hours.
+    names = [topology.host_name(c) for c in topology.host_coords()]
+    preemptions = [
+        PreemptionEvent(at_seconds=4000.0, host=names[3]),
+        PreemptionEvent(at_seconds=4600.0, host=names[7]),
+        PreemptionEvent(at_seconds=9000.0, host=names[3], add=True,
+                        chips=topology.chips_per_host),
+        PreemptionEvent(at_seconds=12000.0, host=names[7], add=True,
+                        chips=topology.chips_per_host),
+    ]
+    harness = ReplayHarness(trace, algorithm="ElasticTiresias",
+                            topology=topology,
+                            rate_limit_seconds=RATE_LIMIT_SECONDS,
+                            scale_out_hysteresis=SCALE_OUT_HYSTERESIS,
+                            resize_cooldown_seconds=RESIZE_COOLDOWN_SECONDS,
+                            preemptions=preemptions)
+    return harness.run()
+
+
+def maybe_hardware():
+    """Measured numbers from the real chip; None off-accelerator (or when
+    VODA_BENCH_HW=0 skips it), an {"error": ...} marker if the
+    accelerator is present but the bench fails (e.g. tunnel flake) — the
+    replay headline must still print."""
+    if os.environ.get("VODA_BENCH_HW") == "0":
+        return None
+    try:
+        import jax
+        if jax.default_backend() not in ("tpu", "gpu"):
+            return None
+        from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
+        return run_hardware_bench(
+            model_points=(("llama_350m", 8),),
+            attention_points=((8, 1024), (4, 2048), (2, 4096), (1, 8192)))
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def main() -> None:
-    trace = philly_like_trace(num_jobs=64, seed=20260729)
-    topology = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))  # 64 chips
-    harness = ReplayHarness(trace, algorithm="ElasticTiresias",
-                            topology=topology, rate_limit_seconds=45.0)
-    report = harness.run()
+    report = run_replay()
+    detail = {
+        # BASELINE metric is "avg JCT + cluster util": both headline-level.
+        "avg_jct_seconds": round(report.avg_jct_seconds, 1),
+        "jct_target_seconds": JCT_TARGET_SECONDS,
+        "jct_vs_target": round(report.avg_jct_seconds / JCT_TARGET_SECONDS, 4),
+        "p95_jct_seconds": round(report.p95_jct_seconds, 1),
+        "steady_state_hours": round(report.steady_state_seconds / 3600.0, 2),
+        "attainable_utilization": round(report.attainable_utilization, 4),
+        "raw_chip_utilization": round(report.chip_utilization, 4),
+        "makespan_seconds": round(report.makespan_seconds, 1),
+        "jobs_completed": report.completed,
+        "jobs_failed": report.failed,
+        "restarts": report.restarts_total,
+        "rescheds": report.rescheds_total,
+        "spot_preemption": "2 hosts reclaimed @4000s/4600s, returned @9000s/12000s",
+        "knobs": {"rate_limit_seconds": RATE_LIMIT_SECONDS,
+                  "scale_out_hysteresis": SCALE_OUT_HYSTERESIS,
+                  "resize_cooldown_seconds": RESIZE_COOLDOWN_SECONDS},
+    }
+    hw = maybe_hardware()
+    if hw is not None:
+        detail["hardware"] = hw
     result = {
-        # Steady-state chip utilization: busy chip-seconds / full fleet
-        # capacity, integrated over exactly the windows where queued demand
-        # saturates the fleet (Σ ready jobs' max >= capacity) — the raw,
-        # un-caveated number the BASELINE north star asks for, measured
-        # where the trace physically allows the fleet to be full. The
-        # ramp/drain tails (demand < capacity) are reported via
-        # attainable_utilization in detail.
-        "metric": "steady_state_chip_utilization_philly64_elastic_tiresias_v5p64",
+        # Steady-state chip utilization: busy chip-seconds / fleet capacity
+        # over the windows where queued demand saturates the fleet; the
+        # capacity integral prices the preemption dip exactly. avg JCT
+        # rides in detail with an explicit target (VERDICT r2 item 3).
+        "metric": ("steady_state_chip_utilization_philly64_spot_"
+                   "elastic_tiresias_v5p64"),
         "value": round(report.steady_state_utilization, 4),
         "unit": "fraction",
-        "vs_baseline": round(report.steady_state_utilization / BASELINE_TARGET_UTILIZATION, 4),
-        "detail": {
-            "steady_state_hours": round(report.steady_state_seconds / 3600.0, 2),
-            "attainable_utilization": round(report.attainable_utilization, 4),
-            "raw_chip_utilization": round(report.chip_utilization, 4),
-            "avg_jct_seconds": round(report.avg_jct_seconds, 1),
-            "p95_jct_seconds": round(report.p95_jct_seconds, 1),
-            "makespan_seconds": round(report.makespan_seconds, 1),
-            "jobs_completed": report.completed,
-            "jobs_failed": report.failed,
-            "restarts": report.restarts_total,
-            "rescheds": report.rescheds_total,
-        },
+        "vs_baseline": round(report.steady_state_utilization
+                             / BASELINE_TARGET_UTILIZATION, 4),
+        "detail": detail,
     }
     print(json.dumps(result))
 

@@ -144,8 +144,6 @@ class TestTwoPoolApp:
             if jobs and all(j["status"] == "Completed" for j in jobs):
                 break
             time.sleep(1.0)
-        jobs = {j["name"].rsplit("-", 1)[0] + "-" + j["pool"]: j
-                for j in _req(f"{base}/training")}
         states = {j["pool"]: j["status"] for j in _req(f"{base}/training")}
         assert states == {"v5p": "Completed", "v5e": "Completed"}
         # Each pool's scheduler saw only its own job.
@@ -154,6 +152,21 @@ class TestTwoPoolApp:
             table = _req(f"{sched_base}/training?pool={pool}")
             assert len(table) == 1
             assert pool in table[0]["name"]
+
+    def test_unknown_pool_rejected_at_admission(self, two_pool_app):
+        # The bus queues events for unsubscribed topics silently, so an
+        # unvalidated typo'd pool would be accepted and stuck forever.
+        app = two_pool_app
+        base = f"http://127.0.0.1:{app.service_server.port}"
+        try:
+            _req(f"{base}/training", "POST", json.dumps({
+                "name": "ghost", "pool": "nope", "model": "mnist_mlp"}))
+            code = 200
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+        assert all("ghost" not in j["name"]
+                   for j in _req(f"{base}/training"))
 
     def test_scheduler_routes_and_pools_endpoint(self, two_pool_app):
         app = two_pool_app

@@ -200,6 +200,10 @@ class GkeBackend(ClusterBackend):
         # Consecutive sweeps that found zero pods for a tracked job
         # (vanished-pod detection, see _sweep_jobs).
         self._missing_pods: Dict[str, int] = {}
+        # Jobs mid-resize: the delete->create window legitimately has no
+        # pods, so sweeps must not read it as vanished (or as terminal
+        # phases of the dying incarnation).
+        self._resizing: set = set()
         self._lock = threading.RLock()
         self._closed = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -252,12 +256,20 @@ class GkeBackend(ClusterBackend):
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
-        self._delete_pods(name)
         with self._lock:
-            placements = placements or self._default_placements(num_workers)
-            self._create_pods(spec, num_workers, placements)
-            self._jobs[name] = JobHandle(name=name, num_workers=num_workers,
-                                         placements=list(placements))
+            self._resizing.add(name)
+        try:
+            self._delete_pods(name)
+            with self._lock:
+                placements = placements or self._default_placements(
+                    num_workers)
+                self._create_pods(spec, num_workers, placements)
+                self._jobs[name] = JobHandle(name=name,
+                                             num_workers=num_workers,
+                                             placements=list(placements))
+        finally:
+            with self._lock:
+                self._resizing.discard(name)
         self._ensure_monitor()
 
     def stop_job(self, name: str) -> None:
@@ -420,8 +432,11 @@ class GkeBackend(ClusterBackend):
 
     def _sweep_jobs(self) -> None:
         with self._lock:
-            jobs = list(self._jobs)
+            jobs = [j for j in self._jobs if j not in self._resizing]
         for job in jobs:
+            with self._lock:
+                if job in self._resizing:
+                    continue
             pods = self.kube.list_pods(self.namespace,
                                        label_selector=_job_selector(job))
             if not pods:

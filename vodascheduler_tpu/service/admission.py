@@ -35,10 +35,17 @@ class AdmissionError(Exception):
 
 class AdmissionService:
     def __init__(self, store: JobStore, bus: EventBus, clock: Clock,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 valid_pools: Optional[set] = None):
         self.store = store
         self.bus = bus
         self.clock = clock
+        # When set, jobs naming a pool outside it are rejected at
+        # admission: the bus queues events for unsubscribed topics
+        # silently, so an unvalidated typo'd (or defaulted) pool would be
+        # accepted 200 and then sit Submitted forever with no scheduler
+        # ever seeing it.
+        self.valid_pools = valid_pools
         registry = registry or Registry()
         # Reference series: pkg/service/service/metrics.go.
         self.m_created = registry.counter(
@@ -60,6 +67,11 @@ class AdmissionService:
             return self._create_training_job(spec)
 
     def _create_training_job(self, spec: JobSpec) -> str:
+        if self.valid_pools is not None and spec.pool not in self.valid_pools:
+            self.m_errors.inc()
+            raise AdmissionError(
+                f"unknown pool {spec.pool!r}; configured pools: "
+                f"{sorted(self.valid_pools)}")
         now = self.clock.now()
         # Second-resolution timestamps collide when jobs arrive in the same
         # second (guaranteed in trace replay); bump until unique.

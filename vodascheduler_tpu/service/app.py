@@ -170,7 +170,8 @@ class VodaApp:
         self.scheduler = self.schedulers[first]
         self.collector = self.collectors[first]
         self.admission = AdmissionService(self.store, self.bus, self.clock,
-                                          registry=self.registry)
+                                          registry=self.registry,
+                                          valid_pools=set(names))
         # Chip telemetry on the shared /metrics endpoints (reference
         # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
         # Collected only when this process may own a jax backend: hermetic
