@@ -154,3 +154,29 @@ class EncoderBlock(nn.Module):
         h = nn.Dense(x.shape[-1], name="fc2", dtype=x.dtype,
                      param_dtype=jnp.float32)(h)
         return x + h
+
+
+def scan_stack(body_cls, num_layers: int, remat: bool = False,
+               name: str = "layers_scan", **body_kwargs):
+    """nn.scan over a (carry, None) -> (carry, None) layer body module.
+
+    The big-model compile-time shape: XLA compiles ONE layer body instead
+    of an L-times unrolled HLO. Params gain a leading layer axis under
+    `name` — parallel/sharding.py derives scanned-path rules keyed on the
+    "layers_scan" prefix that shift every spec right by one (keep the
+    default name unless you extend the rules). `remat=True` additionally
+    recomputes each layer in the backward (HBM for activations drops to
+    layer boundaries at ~1/3 extra FLOPs) — decoupled from scanning so
+    models that fit comfortably don't pay the recompute.
+
+    Used by models/llama.py and models/mixtral.py; the invocation
+    (variable_axes/split_rngs/metadata_params) lives here once because
+    the sharding-rule contract depends on it.
+    """
+    body = nn.remat(body_cls, prevent_cse=False) if remat else body_cls
+    return nn.scan(body,
+                   variable_axes={"params": 0},
+                   split_rngs={"params": True},
+                   length=num_layers,
+                   metadata_params={nn.PARTITION_NAME: None})(
+        name=name, **body_kwargs)

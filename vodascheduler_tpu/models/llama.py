@@ -29,6 +29,17 @@ class LlamaConfig:
     max_seq_len: int = 8192
     rope_base: float = 500000.0
     dtype: str = "bfloat16"
+    # Scan-over-layers: the idiomatic big-model TPU shape — XLA compiles
+    # ONE layer body instead of an L-times unrolled HLO (compile time and
+    # program size drop ~L-fold). Off for tiny test configs where
+    # unrolled compiles instantly and is easier to introspect.
+    scan_layers: bool = False
+    # Per-layer remat (independent of scanning): backward recomputes each
+    # layer from its boundary — activation HBM drops to O(L*S*D) at ~1/3
+    # extra FLOPs. On for models whose activations don't fit (8B); off
+    # for the single-chip bench flagship so measured MFU prices no
+    # recompute.
+    remat_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -44,16 +55,32 @@ class LlamaConfig:
 
 
 # Llama-3-8B (the baseline config's model)
-LLAMA3_8B = LlamaConfig()
+LLAMA3_8B = LlamaConfig(scan_layers=True, remat_layers=True)
 # ~350M single-chip config: same architecture scaled so full fp32
 # optimizer state (~12 bytes/param ≈ 4.2 GB) plus activations fits one
 # 16 GB v5e chip — the hardware-bench flagship (bench.py MFU section).
 LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
-                         num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048)
+                         num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048,
+                         scan_layers=True)
 # Tiny config for tests / compile checks
 LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, mlp_hidden=128, max_seq_len=128,
                          rope_base=10000.0)
+# Tiny scanned variant (tests pin the scan path's training + sharding)
+LLAMA_TINY_SCAN = dataclasses.replace(LLAMA_TINY, scan_layers=True)
+
+
+class _ScanBody(nn.Module):
+    """One decoder layer in scan-carry form: (x, None) -> (x, None)."""
+
+    attn_cfg: "AttnConfig"
+    mlp_hidden: int
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        return DecoderBlock(self.attn_cfg, self.mlp_hidden,
+                            attn_fn=self.attn_fn, name="block")(x), None
 
 
 class Llama(nn.Module):
@@ -78,9 +105,16 @@ class Llama(nn.Module):
                               num_kv_heads=cfg.num_kv_heads,
                               head_dim=cfg.head_dim, causal=True,
                               rope_base=cfg.rope_base)
-        for i in range(cfg.num_layers):
-            x = DecoderBlock(attn_cfg, cfg.mlp_hidden, attn_fn=self.attn_fn,
-                             name=f"layer_{i}")(x)
+        if cfg.scan_layers:
+            from vodascheduler_tpu.models.layers import scan_stack
+            x, _ = scan_stack(_ScanBody, cfg.num_layers,
+                              remat=cfg.remat_layers, attn_cfg=attn_cfg,
+                              mlp_hidden=cfg.mlp_hidden,
+                              attn_fn=self.attn_fn)(x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = DecoderBlock(attn_cfg, cfg.mlp_hidden,
+                                 attn_fn=self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
         # Head weight as an explicit param (not nn.Dense) so the fused
         # loss can chunk the matmul; the logits path is Dense-equivalent.

@@ -42,13 +42,15 @@ class MixtralConfig:
     dtype: str = "bfloat16"
     dispatch: str = "routed"          # "routed" | "dense"
     capacity_factor: float = 1.25     # routed: slots per expert vs even load
+    scan_layers: bool = False         # nn.scan over layers (see llama.py)
+    remat_layers: bool = False        # per-layer remat, decoupled from scan
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.num_heads
 
 
-MIXTRAL_8X7B_LIKE = MixtralConfig()
+MIXTRAL_8X7B_LIKE = MixtralConfig(scan_layers=True, remat_layers=True)
 MIXTRAL_TINY = MixtralConfig(vocab_size=256, dim=64, num_layers=2,
                              num_heads=4, num_kv_heads=2, mlp_hidden=128,
                              num_experts=4, top_k=2, rope_base=10000.0)
@@ -110,6 +112,18 @@ class MixtralBlock(nn.Module):
         return x
 
 
+class _ScanBody(nn.Module):
+    """One Mixtral layer in scan-carry form (llama.py pattern)."""
+
+    cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        return MixtralBlock(self.cfg, attn_fn=self.attn_fn,
+                            name="block")(x), None
+
+
 class Mixtral(nn.Module):
     cfg: MixtralConfig
     attn_fn: Optional[Callable] = None
@@ -124,8 +138,15 @@ class Mixtral(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
                      param_dtype=jnp.float32, dtype=dtype)(tokens)
         x = constrain_batch_activation(x)
-        for i in range(cfg.num_layers):
-            x = MixtralBlock(cfg, attn_fn=self.attn_fn, name=f"layer_{i}")(x)
+        if cfg.scan_layers:
+            from vodascheduler_tpu.models.layers import scan_stack
+            x, _ = scan_stack(_ScanBody, cfg.num_layers,
+                              remat=cfg.remat_layers, cfg=cfg,
+                              attn_fn=self.attn_fn)(x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = MixtralBlock(cfg, attn_fn=self.attn_fn,
+                                 name=f"layer_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
         # Fused-loss head, as in llama.py: chunked CE when targets given.
         w = self.param("lm_head_kernel", nn.initializers.lecun_normal(),

@@ -36,31 +36,45 @@ class ShardingRules:
         return self.default
 
 
-# Transformer rules (llama/bert/vit family): TP shards attention heads and
-# MLP hidden; FSDP shards the other big axis of every matrix.
-TRANSFORMER_RULES = ShardingRules(rules=[
-    # token/position embeddings: vocab over fsdp, model dim over tp.
-    # (Not the transpose: dim-over-fsdp propagates into the gather output
-    # with a permuted device order GSPMD can only fix by involuntary full
-    # rematerialization of the [B,S,D] activation — see
-    # constrain_batch_activation. vocab-over-fsdp also reduce-scatters
-    # the embedding grad instead of replicating it.)
-    (r"embed.*embedding$", P("fsdp", "tp")),
-    # attention projections: qkv shard heads (tp), o shards model dim
+# Per-layer transformer rules: TP shards attention heads and MLP hidden;
+# FSDP shards the other big axis of every matrix; MoE experts over ep.
+# The scanned variants below are DERIVED from this list — never add a
+# scanned rule by hand (a hand-copy that drifted would silently put
+# fsdp/tp on the stacked layer axis).
+_LAYER_RULES = [
     (r"(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp")),
     (r"o_proj.*kernel$", P("tp", "fsdp")),
-    # MLP: up/gate shard hidden (tp); down shards model dim back
     (r"(up_proj|gate_proj|fc1).*kernel$", P("fsdp", "tp")),
     (r"(down_proj|fc2).*kernel$", P("tp", "fsdp")),
-    # MoE expert weights: experts over ep, then like MLP
     (r"experts.*(up|gate).*kernel$", P("ep", "fsdp", "tp")),
     (r"experts.*down.*kernel$", P("ep", "tp", "fsdp")),
     (r"router.*kernel$", P("fsdp", None)),
-    # final head
-    (r"lm_head.*kernel$", P("fsdp", "tp")),
-    # norms / biases / scales: replicate
-    (r"(norm|scale|bias|ln)", P()),
-])
+]
+
+# Transformer rules (llama/bert/vit/mixtral family). Scan-over-layers
+# params carry a leading layer axis ("layers_scan" in the path): same
+# specs shifted right by one, layer axis unsharded — generated from
+# _LAYER_RULES so the two sets cannot diverge. Ordered first (first
+# match wins); norms/scales fall through to the replicate rule either way.
+TRANSFORMER_RULES = ShardingRules(rules=(
+    [(r"layers_scan.*" + pattern, P(None, *spec))
+     for pattern, spec in _LAYER_RULES]
+    + [
+        # token/position embeddings: vocab over fsdp, model dim over tp.
+        # (Not the transpose: dim-over-fsdp propagates into the gather
+        # output with a permuted device order GSPMD can only fix by
+        # involuntary full rematerialization of the [B,S,D] activation —
+        # see constrain_batch_activation. vocab-over-fsdp also
+        # reduce-scatters the embedding grad instead of replicating it.)
+        (r"embed.*embedding$", P("fsdp", "tp")),
+    ]
+    + _LAYER_RULES
+    + [
+        # final head
+        (r"lm_head.*kernel$", P("fsdp", "tp")),
+        # norms / biases / scales: replicate
+        (r"(norm|scale|bias|ln)", P()),
+    ]))
 
 # Conv/vision rules (resnet): fsdp over output channels of large convs.
 CONV_RULES = ShardingRules(rules=[
