@@ -451,7 +451,8 @@ class GkeBackend(ClusterBackend):
                     self._missing_pods[job] = strikes
                     if strikes < 2:
                         continue
-                    self._jobs.pop(job, None)
+                    if self._jobs.pop(job, None) is None:
+                        continue  # concurrent sweep already reaped
                     self._specs.pop(job, None)
                     self._missing_pods.pop(job, None)
                 self.kube.delete_service(self.namespace, self._svc_name(job))
@@ -472,7 +473,8 @@ class GkeBackend(ClusterBackend):
                     if term is not None:
                         codes.append(int(term.get("exitCode", -1)))
             with self._lock:
-                self._jobs.pop(job, None)
+                if self._jobs.pop(job, None) is None:
+                    continue  # a concurrent sweep already reaped + emitted
                 self._specs.pop(job, None)
             for p in pods:
                 self.kube.delete_pod(self.namespace, p["metadata"]["name"],

@@ -100,7 +100,8 @@ class VodaApp:
                  rate_limit_seconds: float = 30.0,
                  collector_interval_seconds: float = 60.0,
                  resume: bool = False,
-                 pools: Union[None, str, List[PoolSpec]] = None):
+                 pools: Union[None, str, List[PoolSpec]] = None,
+                 kube: Optional[object] = None):
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.clock = Clock()
@@ -126,12 +127,14 @@ class VodaApp:
             # topic and collide their const-labeled metric series.
             raise ValueError(f"duplicate pool names: {names}")
 
-        if backend != "local":
-            raise ValueError(f"unknown backend {backend!r} (the app serves "
-                             "real local training; simulation lives in replay/)")
+        if backend not in ("local", "gke"):
+            raise ValueError(f"unknown backend {backend!r} (local = "
+                             "supervisor subprocesses on this machine; gke = "
+                             "worker pods via the in-cluster k8s API; "
+                             "simulation lives in replay/)")
 
         from vodascheduler_tpu.cluster.local import LocalBackend
-        self.backends: Dict[str, LocalBackend] = {}
+        self.backends: Dict[str, object] = {}
         self.placements: Dict[str, PlacementManager] = {}
         self.schedulers: Dict[str, Scheduler] = {}
         self.collectors: Dict[str, MetricsCollector] = {}
@@ -144,9 +147,27 @@ class VodaApp:
             pool_chips = ps.chips
             if pool_chips is None and ps.topology is not None:
                 pool_chips = ps.topology.total_chips
-            be = LocalBackend(jobs_dir, chips=pool_chips,
-                              hermetic_devices=hermetic_devices,
-                              topology=ps.topology)
+            if backend == "gke":
+                # One namespace per pool (reference: one scheduler
+                # deployment per GPU type, each watching its own pods).
+                from vodascheduler_tpu.cluster.gke import (
+                    DEFAULT_NAMESPACE,
+                    GkeBackend,
+                    InClusterKube,
+                )
+                ns = DEFAULT_NAMESPACE if single else \
+                    f"{DEFAULT_NAMESPACE}-{ps.name}"
+                be = GkeBackend(kube if kube is not None else InClusterKube(),
+                                namespace=ns, topology=ps.topology)
+                # GkeBackend has no local metrics dir; collector reads
+                # the shared PVC path the worker pods write to.
+                be.metrics_dir = os.path.join(self.workdir, "metrics",
+                                              ps.name)
+                os.makedirs(be.metrics_dir, exist_ok=True)
+            else:
+                be = LocalBackend(jobs_dir, chips=pool_chips,
+                                  hermetic_devices=hermetic_devices,
+                                  topology=ps.topology)
             pm = PlacementManager(pool_id=ps.name, topology=ps.topology,
                                   registry=self.registry)
             sched = Scheduler(
@@ -255,6 +276,10 @@ def main(argv=None) -> int:
                              "'v5p=4x4x4/2x2x1,v5e=16:ElasticFIFO'. One "
                              "scheduler per pool (reference: one scheduler "
                              "deployment per GPU type)")
+    parser.add_argument("--backend", default="local",
+                        choices=["local", "gke"],
+                        help="execution substrate: local supervisor "
+                             "subprocesses, or GKE worker pods (in-cluster)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--resume", action="store_true",
                         help="reconstruct state from store + running jobs "
@@ -264,7 +289,7 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO)
     app = VodaApp(workdir=args.workdir, pool=args.pool,
-                  algorithm=args.algorithm,
+                  algorithm=args.algorithm, backend=args.backend,
                   hermetic_devices=args.hermetic_devices, chips=args.chips,
                   host=args.host, resume=args.resume,
                   collector_interval_seconds=args.collector_interval,
