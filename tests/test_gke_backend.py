@@ -297,9 +297,14 @@ class TestVodaAppGke:
             while _time.time() < deadline and not kube.pods:
                 _time.sleep(0.2)
             assert kube.pods, "scheduler never created worker pods"
-            env = {e["name"]: e["value"] for e in
-                   list(kube.pods.values())[0]["spec"]["containers"][0]["env"]}
+            container = list(kube.pods.values())[0]["spec"]["containers"][0]
+            env = {e["name"]: e["value"] for e in container["env"]}
             assert env.get("VODA_TOPOLOGY") == "4x1x1/2x1x1"
+            # Worker CSVs land on the shared PVC where the collector
+            # (workdir-side mount) reads them.
+            args = container["args"]
+            assert args[args.index("--metrics-dir") + 1] == "/jobs/metrics"
+            assert app.backend.metrics_dir.endswith("/metrics")
             for pod in list(kube.pods):
                 kube.finish_pod(pod, 0)
             app.backend.poll_once()

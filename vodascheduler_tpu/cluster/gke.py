@@ -178,7 +178,9 @@ class GkeBackend(ClusterBackend):
                  stop_grace_seconds: int = 120,
                  poll_interval_seconds: float = 2.0,
                  image: Optional[str] = None,
-                 topology: Optional[Any] = None):
+                 topology: Optional[Any] = None,
+                 pool: str = "",
+                 pod_metrics_dir: str = "/jobs/metrics"):
         self.kube = kube
         self.namespace = namespace
         self.pod_template = pod_template or _default_pod_template()
@@ -188,6 +190,15 @@ class GkeBackend(ClusterBackend):
         # Pool topology (PoolTopology) injected as VODA_TOPOLOGY in every
         # worker pod so supervisors plan meshes on the real host block.
         self.topology = topology
+        # Multi-pool: all pools share one provisioned namespace; pods are
+        # labeled voda/pool and every job-pod listing filters on it, so a
+        # crash-resumed backend never adopts another pool's jobs.
+        self.pool = pool
+        # Where worker pods write their epoch CSVs — a path on the shared
+        # PVC as mounted IN THE POD (/jobs). The control plane reads the
+        # same directory through its own mount (VodaApp passes the
+        # host-side path to the collector).
+        self.pod_metrics_dir = pod_metrics_dir
         self._specs: Dict[str, JobSpec] = {}
         self._jobs: Dict[str, JobHandle] = {}
         self._known_hosts: Dict[str, int] = {}
@@ -243,6 +254,7 @@ class GkeBackend(ClusterBackend):
         with self._lock:
             if spec.name in self._jobs:
                 raise RuntimeError(f"job {spec.name!r} already running")
+            self._missing_pods.pop(spec.name, None)  # fresh vanish grace
             placements = placements or self._default_placements(num_workers)
             self._specs[spec.name] = spec
             self._create_pods(spec, num_workers, placements)
@@ -287,9 +299,12 @@ class GkeBackend(ClusterBackend):
     def running_jobs(self) -> Dict[str, JobHandle]:
         """Reconstructed from live pods (crash-resume path — the reference
         lists MPIJobs on scheduler restart, scheduler.go:1019)."""
+        selector = "app=voda-worker"
+        if self.pool:
+            selector += f",voda/pool={self.pool}"
         jobs: Dict[str, JobHandle] = {}
         for pod in self.kube.list_pods(self.namespace,
-                                       label_selector="app=voda-worker"):
+                                       label_selector=selector):
             labels = pod["metadata"].get("labels", {})
             job = labels.get("voda/job-name")
             if not job or pod.get("status", {}).get("phase") not in (
@@ -376,6 +391,8 @@ class GkeBackend(ClusterBackend):
                            "voda/num-chips": str(chips),
                            "voda/incarnation":
                                str(self._incarnation[spec.name])})
+            if self.pool:
+                labels["voda/pool"] = self.pool
             podspec = manifest["spec"]
             podspec["nodeName"] = host      # placement manager's binding
             podspec.pop("nodeSelector", None)  # nodeName supersedes it
@@ -383,7 +400,8 @@ class GkeBackend(ClusterBackend):
             if self.image:
                 container["image"] = self.image
             container["args"] = ["--workdir", f"/jobs/{spec.name}",
-                                 "--num-chips", str(num_chips)]
+                                 "--num-chips", str(num_chips),
+                                 "--metrics-dir", self.pod_metrics_dir]
             env = [
                 {"name": "VODA_JOB_NAME", "value": spec.name},
             ]
@@ -447,12 +465,17 @@ class GkeBackend(ClusterBackend):
                 # would strand the job as "running" forever (same
                 # contract as multihost.py's external-preemption path).
                 with self._lock:
+                    if job not in self._jobs:
+                        # Concurrent sweep already reaped it; drop any
+                        # stale strike so a restarted same-name job gets
+                        # its full grace again.
+                        self._missing_pods.pop(job, None)
+                        continue
                     strikes = self._missing_pods.get(job, 0) + 1
                     self._missing_pods[job] = strikes
                     if strikes < 2:
                         continue
-                    if self._jobs.pop(job, None) is None:
-                        continue  # concurrent sweep already reaped
+                    self._jobs.pop(job, None)
                     self._specs.pop(job, None)
                     self._missing_pods.pop(job, None)
                 self.kube.delete_service(self.namespace, self._svc_name(job))

@@ -148,21 +148,32 @@ class VodaApp:
             if pool_chips is None and ps.topology is not None:
                 pool_chips = ps.topology.total_chips
             if backend == "gke":
-                # One namespace per pool (reference: one scheduler
-                # deployment per GPU type, each watching its own pods).
+                # All pools share the ONE provisioned namespace
+                # (deploy/gke provisions voda-scheduler: RBAC + the
+                # voda-state PVC); pods carry a voda/pool label so each
+                # pool's backend only lists/adopts its own jobs. Capacity
+                # comes from live node discovery, never a declared count.
+                if ps.chips is not None:
+                    raise ValueError(
+                        f"pool {ps.name!r}: chips= is meaningless with "
+                        "--backend gke (capacity is discovered from TPU "
+                        "node allocatable); declare a topology or drop it")
                 from vodascheduler_tpu.cluster.gke import (
-                    DEFAULT_NAMESPACE,
                     GkeBackend,
                     InClusterKube,
                 )
-                ns = DEFAULT_NAMESPACE if single else \
-                    f"{DEFAULT_NAMESPACE}-{ps.name}"
+                # Worker pods mount the shared PVC at /jobs; the control
+                # plane mounts the same volume at workdir. Metrics CSVs
+                # land in <PVC>/metrics/<pool>/ and the collector reads
+                # them through the workdir-side mount.
+                pod_metrics = f"/jobs/metrics/{ps.name}" if not single \
+                    else "/jobs/metrics"
                 be = GkeBackend(kube if kube is not None else InClusterKube(),
-                                namespace=ns, topology=ps.topology)
-                # GkeBackend has no local metrics dir; collector reads
-                # the shared PVC path the worker pods write to.
-                be.metrics_dir = os.path.join(self.workdir, "metrics",
-                                              ps.name)
+                                topology=ps.topology,
+                                pool="" if single else ps.name,
+                                pod_metrics_dir=pod_metrics)
+                be.metrics_dir = os.path.join(
+                    self.workdir, *pod_metrics.split("/")[2:])
                 os.makedirs(be.metrics_dir, exist_ok=True)
             else:
                 be = LocalBackend(jobs_dir, chips=pool_chips,
