@@ -65,10 +65,26 @@ def maybe_hardware():
     """Measured numbers from the real chip; None off-accelerator (or when
     VODA_BENCH_HW=0 skips it), an {"error": ...} marker if the
     accelerator is present but the bench fails (e.g. tunnel flake) — the
-    replay headline must still print."""
+    replay headline must still print. A SIGALRM watchdog
+    (VODA_BENCH_HW_TIMEOUT, default 1800s) turns a wedged remote-compile
+    into an error instead of hanging the whole bench."""
     if os.environ.get("VODA_BENCH_HW") == "0":
         return None
+    old_handler = None
     try:
+        # Watchdog is best-effort: SIGALRM only exists on unix main
+        # threads; anywhere else the bench just runs unguarded.
+        import signal
+
+        def _alarm(signum, frame):
+            raise TimeoutError("hardware bench exceeded its time budget")
+
+        try:
+            timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
+            old_handler = signal.signal(signal.SIGALRM, _alarm)
+            signal.alarm(timeout)
+        except (AttributeError, ValueError):
+            old_handler = None
         import jax
         if jax.default_backend() not in ("tpu", "gpu"):
             return None
@@ -78,6 +94,11 @@ def maybe_hardware():
             attention_points=((8, 1024), (4, 2048), (2, 4096), (1, 8192)))
     except Exception as e:  # noqa: BLE001 - report, don't die
         return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        if old_handler is not None:
+            import signal
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_handler)
 
 
 def main() -> None:

@@ -219,3 +219,44 @@ class TestScannedLayers:
             "experts_gate_kernel"]
         spec = experts.sharding.spec
         assert spec[0] is None and "ep" in str(spec)
+
+
+class TestPipelineParallel:
+    """SPMD pipeline (parallel/pipeline.py): GPipe microbatch rotation
+    over the scanned layer stack, pp axis on the stacked layer dim."""
+
+    def test_pipeline_matches_sequential(self):
+        # Same params, same batch: the pipelined dataflow must compute
+        # exactly the sequential scanned forward (single device — the
+        # schedule itself is device-count-independent).
+        from vodascheduler_tpu.models import llama
+        m = llama.Llama(llama.LLAMA_TINY_SCAN)
+        rng = jax.random.PRNGKey(0)
+        toks = jax.random.randint(rng, (4, 32), 0, 256)
+        tgts = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256)
+        vs = m.init(rng, toks)
+        seq = m.apply(vs, toks, targets=tgts)
+        fwd = llama.pipeline_loss_fn(llama.LLAMA_TINY_SCAN,
+                                     num_stages=2, num_microbatches=2)
+        pp = fwd(vs["params"], toks, targets=tgts)
+        assert abs(float(seq) - float(pp)) < 2e-2, (float(seq), float(pp))
+
+    def test_pipeline_trains_on_pp_mesh(self):
+        from vodascheduler_tpu.models import llama
+        from vodascheduler_tpu.models.registry import get_model
+        bundle = get_model("llama_tiny")
+        bundle.module = llama.Llama(llama.LLAMA_TINY_SCAN)
+        s = TrainSession(bundle, num_chips=8, global_batch_size=8,
+                         plan=MeshPlan(dp=2, pp=2, tp=2))
+        l0 = s.run_steps(1)
+        l1 = s.run_steps(10)
+        assert l1 < l0
+        # The stacked layer axis is actually sharded over pp.
+        q = s.state["params"]["layers_scan"]["block"]["attn"]["q_proj"]["kernel"]
+        assert "pp" in str(q.sharding.spec)
+
+    def test_pp_requires_scanned_llama(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="scan_layers"):
+            TrainSession(get_model("llama_tiny"), num_chips=8,
+                         global_batch_size=8, plan=MeshPlan(dp=4, pp=2))

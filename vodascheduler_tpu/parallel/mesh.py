@@ -10,6 +10,8 @@ annotate shardings, let XLA insert the collectives):
 - `sp`   sequence/context parallelism for long-context attention
          (ring attention over ppermute, ring_attention.py)
 - `ep`   expert parallelism for MoE (all_to_all token routing)
+- `pp`   pipeline parallelism over the scanned layer stack (GPipe-style
+         microbatch rotation via ppermute, parallel/pipeline.py)
 
 `plan_mesh` chooses axis sizes for a chip count + model scale, preferring
 tp within a host (fastest ICI hops), fsdp across the slice, dp outermost —
@@ -30,7 +32,7 @@ from jax.sharding import Mesh
 if TYPE_CHECKING:  # placement deps stay out of the import graph at runtime
     from vodascheduler_tpu.placement.topology import PoolTopology, SliceShape
 
-AXES = ("dp", "fsdp", "tp", "sp", "ep")
+AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,14 +44,15 @@ class MeshPlan:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def num_chips(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
 
     def axis_sizes(self) -> Dict[str, int]:
         return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
-                "sp": self.sp, "ep": self.ep}
+                "sp": self.sp, "ep": self.ep, "pp": self.pp}
 
     def active_axes(self) -> Tuple[str, ...]:
         return tuple(a for a in AXES if getattr(self, a) > 1)
@@ -118,8 +121,11 @@ def build_mesh(plan: MeshPlan,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Materialize the plan over devices (default: all local devices).
 
-    Axis order is (dp, fsdp, sp, ep, tp) with tp innermost so adjacent
-    devices (shortest ICI hops) serve the highest-bandwidth axis.
+    Axis order is (dp, pp, fsdp, sp, ep, tp) with tp innermost so
+    adjacent devices (shortest ICI hops) serve the highest-bandwidth
+    axis; pp sits outermost after dp — stage-to-stage traffic is one
+    point-to-point activation transfer per tick, the cheapest collective
+    in the program, so it tolerates the longest hops.
     """
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < plan.num_chips:
@@ -132,6 +138,6 @@ def build_mesh(plan: MeshPlan,
     devices.sort(key=lambda d: (getattr(d, "process_index", 0),
                                 getattr(d, "id", 0)))
     devices = devices[:plan.num_chips]
-    shape = (plan.dp, plan.fsdp, plan.sp, plan.ep, plan.tp)
+    shape = (plan.dp, plan.pp, plan.fsdp, plan.sp, plan.ep, plan.tp)
     arr = np.array(devices, dtype=object).reshape(shape)
-    return Mesh(arr, axis_names=("dp", "fsdp", "sp", "ep", "tp"))
+    return Mesh(arr, axis_names=("dp", "pp", "fsdp", "sp", "ep", "tp"))

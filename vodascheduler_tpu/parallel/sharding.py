@@ -53,11 +53,14 @@ _LAYER_RULES = [
 
 # Transformer rules (llama/bert/vit/mixtral family). Scan-over-layers
 # params carry a leading layer axis ("layers_scan" in the path): same
-# specs shifted right by one, layer axis unsharded — generated from
-# _LAYER_RULES so the two sets cannot diverge. Ordered first (first
-# match wins); norms/scales fall through to the replicate rule either way.
+# specs shifted right by one, the layer axis assigned to `pp` — on a
+# pipeline mesh each stage holds its contiguous block of layers; on
+# pp=1 meshes _fit_spec drops the axis and the stack replicates across
+# nothing (plain scan). Generated from _LAYER_RULES so the two sets
+# cannot diverge. Ordered first (first match wins); norms/scales fall
+# through to the replicate rule either way.
 TRANSFORMER_RULES = ShardingRules(rules=(
-    [(r"layers_scan.*" + pattern, P(None, *spec))
+    [(r"layers_scan.*" + pattern, P("pp", *spec))
      for pattern, spec in _LAYER_RULES]
     + [
         # token/position embeddings: vocab over fsdp, model dim over tp.

@@ -86,12 +86,47 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     mesh = build_mesh(plan, devices)
     module = bundle.module
 
+    # Pipeline parallelism: plan.pp > 1 swaps the forward dataflow for
+    # the spmd pipeline (parallel/pipeline.py) over the scanned layer
+    # stack — params/init/shardings are unchanged (the rules already put
+    # the stacked layer axis on pp); only the loss path differs.
+    pp_forward = None
+    if plan.pp > 1:
+        from vodascheduler_tpu.models import llama as _llama
+        if not (isinstance(module, _llama.Llama) and module.cfg.scan_layers):
+            raise ValueError(
+                "pp > 1 requires a scan_layers Llama-family model "
+                f"(got {type(module).__name__})")
+        if plan.sp > 1:
+            raise ValueError("pp x sp composition is not supported yet")
+        data = plan.dp * plan.fsdp
+
+        def _valid(m: int) -> bool:
+            return (global_batch_size % m == 0
+                    and (global_batch_size // m) % data == 0)
+
+        # Prefer 4x/2x the stage count (smaller bubble), else ANY valid
+        # microbatch count >= pp (e.g. batch 10 over pp=4 runs at M=5).
+        preferred = (4 * plan.pp, 2 * plan.pp, plan.pp)
+        fallback = sorted(m for m in range(plan.pp, global_batch_size + 1)
+                          if _valid(m))
+        num_micro = next((m for m in preferred if _valid(m)),
+                         fallback[0] if fallback else None)
+        if num_micro is None:
+            raise ValueError(
+                f"global batch {global_batch_size} admits no microbatch "
+                f"count >= pp={plan.pp} with microbatches divisible by "
+                f"{data} data shards")
+        pp_forward = _llama.pipeline_loss_fn(module.cfg, plan.pp, num_micro)
+
     # Attention kernel selection: long-context meshes (real sp axis) get
     # ring attention; otherwise, on TPU, the Pallas flash kernel replaces
     # the O(S²) XLA softmax path (ops/flash_attention.py). Both shard via
     # shard_map with the same batch/head specs the GSPMD rules use.
+    # Pipelined plans keep the XLA path (kernel injection under the
+    # stage vmap is future work).
     attn_fn = None
-    if hasattr(module, "attn_fn"):
+    if hasattr(module, "attn_fn") and pp_forward is None:
         # Modules exposing attn_fn declare their masking with the
         # `causal_attention` class attribute — the injected kernel replaces
         # the layer's own cfg.causal, so it must match.
@@ -148,6 +183,9 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
 
     def train_step(state, batch):
         def loss_fn(params):
+            if pp_forward is not None:
+                return pp_forward(params, batch["inputs"],
+                                  targets=batch["targets"])
             return bundle.loss_fn(
                 lambda p, x, **kw: apply_fn_extra(p, state["extra"], x, **kw),
                 params, batch)
