@@ -1,0 +1,74 @@
+"""Hardware-bench plumbing, hermetically (VODA_HWBENCH_ON_CPU tiny
+shapes): the measurement path the driver runs on the real chip must
+produce a complete, well-formed section even off-accelerator."""
+
+import os
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def cpu_escape_hatch(monkeypatch):
+    monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
+
+
+def test_model_point_and_attention_point():
+    from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
+    out = run_hardware_bench(model_points=(("llama_tiny", 4),),
+                             attention_points=((2, 128),))
+    assert out["models"] and out["attention"]
+    model = out["models"][0]
+    assert model["model"] == "llama_tiny"
+    assert model["step_time_ms"] > 0
+    assert model["tokens_per_sec"] > 0
+    assert model["num_params"] > 0
+    attn = out["attention"][0]
+    assert attn["flash_ms"] > 0 and attn["xla_ms"] > 0
+    assert "flash_speedup" in attn
+
+
+def test_point_errors_are_isolated():
+    from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
+    out = run_hardware_bench(model_points=(("no_such_model", 4),),
+                             attention_points=())
+    assert "error" in out["models"][0]
+
+
+def test_refuses_cpu_without_escape_hatch(monkeypatch):
+    monkeypatch.delenv("VODA_HWBENCH_ON_CPU")
+    from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
+    with pytest.raises(RuntimeError, match="accelerator"):
+        run_hardware_bench()
+
+
+def test_two_point_differencing_cancels_overhead():
+    """The two-point estimator must subtract fixed per-call overhead:
+    feed it a fake timer where t(k) = C + k*s and check it returns s."""
+    from vodascheduler_tpu.runtime import hwbench
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+
+    def make_scanned(k):
+        def run():
+            clock.t += 5.0 + 0.25 * k  # 5s overhead + 0.25s/iter
+            return 0.0
+        return run
+
+    real_counter = hwbench.time.perf_counter
+    real_fetch = hwbench._fetch
+    hwbench.time.perf_counter = clock
+    hwbench._fetch = lambda x: 0.0
+    try:
+        s = hwbench.time_per_iteration(make_scanned, k_small=2, k_big=10,
+                                       reps=1)
+    finally:
+        hwbench.time.perf_counter = real_counter
+        hwbench._fetch = real_fetch
+    assert abs(s - 0.25) < 1e-9

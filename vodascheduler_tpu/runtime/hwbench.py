@@ -270,10 +270,33 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         "models": [],
         "attention": [],
     }
+    # Per-point isolation: one failing shape/kernel must not void the
+    # rest of the hardware section (this runs unattended at round end).
     for model_name, bsz in model_points:
-        out["models"].append(bench_model_step(model_name, bsz).as_dict())
+        try:
+            out["models"].append(bench_model_step(model_name, bsz).as_dict())
+        except Exception as e:  # noqa: BLE001
+            # Retry on the XLA attention path: a Pallas-kernel failure
+            # should still yield a measured MFU number.
+            os.environ["VODA_FLASH_ATTENTION"] = "0"
+            try:
+                res = bench_model_step(model_name, bsz).as_dict()
+                res["note"] = (f"flash path failed "
+                               f"({type(e).__name__}: {e}); XLA attention")
+                out["models"].append(res)
+            except Exception as e2:  # noqa: BLE001
+                out["models"].append({
+                    "model": model_name, "batch": bsz,
+                    "error": f"{type(e2).__name__}: {e2}"})
+            finally:
+                os.environ.pop("VODA_FLASH_ATTENTION", None)
     for bsz, seq in attention_points:
-        out["attention"].append(bench_attention_point(bsz, seq))
+        try:
+            out["attention"].append(bench_attention_point(bsz, seq))
+        except Exception as e:  # noqa: BLE001
+            out["attention"].append({
+                "batch": bsz, "seq": seq,
+                "error": f"{type(e).__name__}: {e}"})
     return out
 
 
