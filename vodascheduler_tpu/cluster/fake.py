@@ -19,8 +19,7 @@ like the reference's training-side CSV logger (examples/.../callbacks.py).
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.cluster.backend import (
     ClusterBackend,

@@ -43,7 +43,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
+from typing import Any, Dict, List, Optional, Protocol, Tuple
 
 from vodascheduler_tpu.cluster.backend import (
     ClusterBackend,

@@ -19,7 +19,6 @@ measurement ever arrives.
 
 from __future__ import annotations
 
-import abc
 import os
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 FIELDS = [
     "epoch", "epoch_time_sec", "step_time_sec", "workers",

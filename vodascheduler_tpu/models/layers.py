@@ -180,3 +180,44 @@ def scan_stack(body_cls, num_layers: int, remat: bool = False,
                    length=num_layers,
                    metadata_params={nn.PARTITION_NAME: None})(
         name=name, **body_kwargs)
+
+
+def pipelined_lm_forward(cfg, block: nn.Module, num_stages: int,
+                         num_microbatches: int):
+    """Shared pipelined decoder-LM forward/loss for scan_layers families.
+
+    Rebuilds the family's submodules (embed / `block` / final norm /
+    lm_head) and applies them to the matching param subtrees of the
+    scanned module's tree — init/checkpoint/sharding stay on the normal
+    module; only the dataflow changes, with the layer stack run through
+    parallel/pipeline.py. `cfg` needs vocab_size, dim, dtype and
+    remat_layers; `block` is one decoder layer taking [B, S, D].
+    Exposed per family as a `pipeline_loss_fn` class attribute the
+    runtime resolves (runtime/train.py) — train.py stays family-agnostic.
+    """
+    from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+    from vodascheduler_tpu.parallel.pipeline import spmd_pipeline
+    from vodascheduler_tpu.parallel.sharding import (
+        constrain_batch_activation,
+    )
+
+    dtype = jnp.dtype(cfg.dtype)
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
+                     dtype=dtype)
+    norm = RMSNorm()
+
+    def forward(params, tokens, targets=None):
+        x = embed.apply({"params": params["embed"]}, tokens)
+        x = constrain_batch_activation(x)
+        x = spmd_pipeline(
+            lambda p, h: block.apply({"params": p}, h),
+            params["layers_scan"]["block"], x,
+            num_stages=num_stages, num_microbatches=num_microbatches,
+            remat=cfg.remat_layers)
+        x = norm.apply({"params": params["final_norm"]}, x)
+        w = params["lm_head_kernel"]
+        if targets is None:
+            return x @ w.astype(dtype)
+        return chunked_softmax_ce(x, w, targets)
+
+    return forward

@@ -86,42 +86,18 @@ class _ScanBody(nn.Module):
 def pipeline_loss_fn(cfg: LlamaConfig, num_stages: int,
                      num_microbatches: int) -> Callable:
     """(params, tokens, targets|None) -> loss | logits, with the decoder
-    stack pipelined over the mesh's `pp` axis (parallel/pipeline.py).
-
-    Built from the same submodule classes Llama composes, applied to the
-    corresponding param subtrees — the param tree is IDENTICAL to the
-    scan_layers=True module's, so init/checkpoint/sharding machinery is
-    untouched; only the forward dataflow changes. Attention runs the XLA
-    path (kernel injection under the stage vmap is future work — the
-    runtime skips flash injection when plan.pp > 1)."""
-    from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
-    from vodascheduler_tpu.parallel.pipeline import spmd_pipeline
-
+    stack pipelined over the mesh's `pp` axis — the shared scan_layers
+    pipelined forward (models/layers.py pipelined_lm_forward) over this
+    family's DecoderBlock. Attention runs the XLA path (kernel injection
+    under the stage vmap is future work — the runtime skips flash
+    injection when plan.pp > 1)."""
+    from vodascheduler_tpu.models.layers import pipelined_lm_forward
     attn_cfg = AttnConfig(num_heads=cfg.num_heads,
                           num_kv_heads=cfg.num_kv_heads,
                           head_dim=cfg.head_dim, causal=True,
                           rope_base=cfg.rope_base)
-    dtype = jnp.dtype(cfg.dtype)
-    embed = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
-                     dtype=dtype)
-    block = DecoderBlock(attn_cfg, cfg.mlp_hidden)
-    norm = RMSNorm()
-
-    def forward(params, tokens, targets=None):
-        x = embed.apply({"params": params["embed"]}, tokens)
-        x = constrain_batch_activation(x)
-        x = spmd_pipeline(
-            lambda p, h: block.apply({"params": p}, h),
-            params["layers_scan"]["block"], x,
-            num_stages=num_stages, num_microbatches=num_microbatches,
-            remat=cfg.remat_layers)
-        x = norm.apply({"params": params["final_norm"]}, x)
-        w = params["lm_head_kernel"]
-        if targets is None:
-            return x @ w.astype(dtype)
-        return chunked_softmax_ce(x, w, targets)
-
-    return forward
+    return pipelined_lm_forward(cfg, DecoderBlock(attn_cfg, cfg.mlp_hidden),
+                                num_stages, num_microbatches)
 
 
 class Llama(nn.Module):
@@ -130,6 +106,8 @@ class Llama(nn.Module):
 
     # Decoder LM: the runtime may inject a causal kernel (flash / ring)
     causal_attention = True
+    # Pipeline-capable (runtime/train.py resolves this when plan.pp > 1)
+    pipeline_loss_fn = staticmethod(pipeline_loss_fn)
 
     @nn.compact
     def __call__(self, tokens, targets=None):

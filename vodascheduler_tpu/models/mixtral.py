@@ -124,12 +124,24 @@ class _ScanBody(nn.Module):
                             name="block")(x), None
 
 
+def pipeline_loss_fn(cfg: MixtralConfig, num_stages: int,
+                     num_microbatches: int) -> Callable:
+    """Pipelined Mixtral forward/loss: the shared scan_layers pipelined
+    forward over MixtralBlock — MoE layers pipelined over pp, experts
+    still sharded over ep inside each stage (the pp x ep composition)."""
+    from vodascheduler_tpu.models.layers import pipelined_lm_forward
+    return pipelined_lm_forward(cfg, MixtralBlock(cfg),
+                                num_stages, num_microbatches)
+
+
 class Mixtral(nn.Module):
     cfg: MixtralConfig
     attn_fn: Optional[Callable] = None
 
     # Decoder LM: the runtime may inject a causal kernel (flash / ring)
     causal_attention = True
+    # Pipeline-capable (runtime/train.py resolves this when plan.pp > 1)
+    pipeline_loss_fn = staticmethod(pipeline_loss_fn)
 
     @nn.compact
     def __call__(self, tokens, targets=None):

@@ -18,8 +18,6 @@ sequence — keeping the runtime's single-input apply signature
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
-
 import flax.linen as nn
 import jax.numpy as jnp
 

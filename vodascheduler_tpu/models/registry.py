@@ -9,7 +9,7 @@ examples likewise default to synthetic/auto-downloaded data.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict
 
 import jax
 import jax.numpy as jnp

@@ -18,8 +18,6 @@ models/mixtral.py when called with `targets`.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import optax

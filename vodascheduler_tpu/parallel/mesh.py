@@ -22,8 +22,7 @@ Elastic resize = plan_mesh at the new count + checkpoint reshard.
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np

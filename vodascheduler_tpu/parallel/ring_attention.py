@@ -15,7 +15,6 @@ collective-permute DMA concurrently with compute).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax

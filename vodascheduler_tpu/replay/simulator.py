@@ -11,14 +11,13 @@ from __future__ import annotations
 
 import dataclasses
 import statistics
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 from vodascheduler_tpu.allocator import ResourceAllocator
 from vodascheduler_tpu.cluster.fake import FakeClusterBackend
 from vodascheduler_tpu.common.clock import VirtualClock
 from vodascheduler_tpu.common.events import EventBus
 from vodascheduler_tpu.common.store import JobStore
-from vodascheduler_tpu.common.types import JobStatus
 from vodascheduler_tpu.metricscollector import BackendRowSource, MetricsCollector
 from vodascheduler_tpu.placement import PlacementManager, PoolTopology
 from vodascheduler_tpu.replay.trace import TraceJob

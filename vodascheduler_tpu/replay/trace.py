@@ -14,7 +14,7 @@ import dataclasses
 import json
 import math
 import random
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 from vodascheduler_tpu.cluster.fake import WorkloadProfile
 from vodascheduler_tpu.common.job import JobConfig, JobSpec

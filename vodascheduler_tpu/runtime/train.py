@@ -92,11 +92,16 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
     # the stacked layer axis on pp); only the loss path differs.
     pp_forward = None
     if plan.pp > 1:
-        from vodascheduler_tpu.models import llama as _llama
-        if not (isinstance(module, _llama.Llama) and module.cfg.scan_layers):
+        # Family-agnostic dispatch: pipeline-capable modules expose a
+        # `pipeline_loss_fn(cfg, num_stages, num_micro)` class attribute
+        # (llama.py / mixtral.py) and must be in scan_layers form (the
+        # stacked layer axis is what shards over pp).
+        _pp_loss = getattr(type(module), "pipeline_loss_fn", None)
+        if _pp_loss is None or not getattr(module.cfg, "scan_layers", False):
             raise ValueError(
-                "pp > 1 requires a scan_layers Llama-family model "
-                f"(got {type(module).__name__})")
+                "pp > 1 requires a pipeline-capable model in scan_layers "
+                f"form (got {type(module).__name__}, scan_layers="
+                f"{getattr(module.cfg, 'scan_layers', False)})")
         if plan.sp > 1:
             raise ValueError("pp x sp composition is not supported yet")
         data = plan.dp * plan.fsdp
@@ -117,7 +122,7 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
                 f"global batch {global_batch_size} admits no microbatch "
                 f"count >= pp={plan.pp} with microbatches divisible by "
                 f"{data} data shards")
-        pp_forward = _llama.pipeline_loss_fn(module.cfg, plan.pp, num_micro)
+        pp_forward = _pp_loss(module.cfg, plan.pp, num_micro)
 
     # Attention kernel selection: long-context meshes (real sp axis) get
     # ring attention; otherwise, on TPU, the Pallas flash kernel replaces

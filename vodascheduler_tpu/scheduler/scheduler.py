@@ -44,7 +44,6 @@ from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import (
     EventVerb,
     JobStatus,
-    MAX_TIME,
     ScheduleResult,
 )
 from vodascheduler_tpu.placement import PlacementManager

@@ -24,7 +24,7 @@ from vodascheduler_tpu.common.job import (
 )
 from vodascheduler_tpu.common.metrics import Registry, timed
 from vodascheduler_tpu.common.store import JobStore
-from vodascheduler_tpu.common.types import EventVerb, JobStatus
+from vodascheduler_tpu.common.types import EventVerb
 
 log = logging.getLogger(__name__)
 
