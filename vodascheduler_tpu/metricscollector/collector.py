@@ -20,7 +20,7 @@ measurement ever arrives.
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Optional, Protocol, Sequence
+from typing import Dict, List, Optional, Protocol
 
 from vodascheduler_tpu.cluster.fake import FakeClusterBackend, MetricsRow
 from vodascheduler_tpu.common.clock import Clock, VirtualClock

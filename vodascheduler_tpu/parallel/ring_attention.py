@@ -15,7 +15,7 @@ collective-permute DMA concurrently with compute).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -26,7 +26,7 @@ TPU-first deltas:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.common.metrics import Registry, timed
 from vodascheduler_tpu.placement import hungarian

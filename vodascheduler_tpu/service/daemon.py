@@ -12,7 +12,7 @@ code run in real time.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 
 class SchedulerDaemon:
