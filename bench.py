@@ -79,15 +79,30 @@ def maybe_hardware():
         def _alarm(signum, frame):
             raise TimeoutError("hardware bench exceeded its time budget")
 
+        # Backend init through a dead TPU tunnel HANGS inside native code
+        # holding the GIL — SIGALRM can't interrupt it — so probe the
+        # accelerator in a SUBPROCESS with a hard timeout before
+        # committing this process (and the driver's bench run) to it.
+        import subprocess
+        import sys
+        probe = int(os.environ.get("VODA_BENCH_HW_PROBE_TIMEOUT", "120"))
+        probe_res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, numpy;"
+             "print(jax.default_backend());"
+             "float(numpy.asarray(jax.numpy.ones(()) + 1))"],
+            capture_output=True, text=True, timeout=probe)
+        if probe_res.returncode != 0:
+            return {"error": f"accelerator probe failed: "
+                             f"{probe_res.stderr.strip()[-300:]}"}
+        if probe_res.stdout.strip().splitlines()[-1] not in ("tpu", "gpu"):
+            return None
         try:
             timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
             old_handler = signal.signal(signal.SIGALRM, _alarm)
             signal.alarm(timeout)
         except (AttributeError, ValueError):
             old_handler = None
-        import jax
-        if jax.default_backend() not in ("tpu", "gpu"):
-            return None
         from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
         return run_hardware_bench(
             model_points=(("llama_350m", 8),),
