@@ -59,9 +59,15 @@ LLAMA3_8B = LlamaConfig(scan_layers=True, remat_layers=True)
 # ~350M single-chip config: same architecture scaled so full fp32
 # optimizer state (~12 bytes/param ≈ 4.2 GB) plus activations fits one
 # 16 GB v5e chip — the hardware-bench flagship (bench.py MFU section).
+# remat_layers is ON: without it the scanned stack saves every layer's
+# attention/MLP intermediates for backward (~0.5 GB/layer at B=8 S=2048;
+# 48 GB alone for the XLA path's f32 score matrices) and OOMs the chip —
+# measured, not estimated (r3 hardware run). MFU keeps the standard
+# convention: analytic FLOPs exclude the recompute, so the number prices
+# remat honestly.
 LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
                          num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048,
-                         scan_layers=True)
+                         scan_layers=True, remat_layers=True)
 # Tiny config for tests / compile checks
 LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, mlp_hidden=128, max_seq_len=128,

@@ -8,8 +8,12 @@ logsumexp so the backward can recompute attention weights blockwise
 (FlashAttention-2 style) — no O(S²) materialization in either pass.
 
 Design notes (vs the generic XLA lowering of softmax attention):
-- all matmuls are [block_q, D] x [D, block_k] shapes with
-  `preferred_element_type=f32` → MXU with fp32 accumulation;
+- all matmuls keep their inputs in the model dtype (bf16) with
+  `preferred_element_type=f32` → native-rate MXU with fp32 accumulation.
+  (Upcasting inputs to f32 first — the r2 version — forfeits the MXU's
+  bf16 throughput: measured 0.75x vs XLA on a v5e; bf16 inputs +
+  512x1024 blocks measure 6-8x FASTER than XLA at S=4096/8192, r3
+  hardware sweep in doc/benchmarks.md);
 - running max / denominator live in (block_q, 128) VMEM scratch (lane-
   replicated, the native TPU vector layout for per-row scalars);
 - causal blocks strictly above the diagonal are predicated off with
@@ -122,8 +126,13 @@ def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        # Matmul inputs stay in the model dtype (bf16): the MXU multiplies
+        # bf16 natively with f32 accumulation (preferred_element_type);
+        # upcasting first would push the dots onto the multi-pass f32
+        # MXU path at a fraction of the throughput. Softmax statistics
+        # stay f32 on the VPU.
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
@@ -136,9 +145,11 @@ def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         corr = jnp.exp(m_prev - m_next)                  # [bq, LANES]
         m_ref[...] = m_next
         l_ref[...] = l_prev * corr + jnp.sum(p, axis=1)[:, None]
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         acc_ref[...] = (acc_ref[...] * _bcast_lanes(corr, acc_ref.shape[-1])
-                        + jnp.dot(p, v, preferred_element_type=jnp.float32))
+                        + jax.lax.dot_general(
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
 
     @pl.when(j == num_k - 1)
     def _flush():
@@ -219,10 +230,14 @@ def _dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 dot inputs, f32 accumulation — see _fwd_kernel. ds is
+        # cast back to the model dtype for its MXU pass (FlashAttention
+        # TPU kernels do the same; gradient noise floor is far above
+        # bf16 rounding here).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
@@ -233,7 +248,9 @@ def _dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
         ds = p * (dov - delta_ref[...][:, :1]) * sm_scale
-        dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(j == num_k - 1)
     def _flush():
@@ -255,11 +272,11 @@ def _dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        o = o_ref[0, 0].astype(jnp.float32)
+        # bf16 dot inputs, f32 accumulation — see _fwd_kernel.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s *= sm_scale
@@ -267,16 +284,16 @@ def _dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             s = _causal_mask(s, q_off + i * block_q, j * block_k)
         lse = lse_ref[0, 0, 0][:1].T                         # [bq, 1]
         p = jnp.exp(s - lse)                                 # [bq, bk]
-        delta = jnp.sum(do * o, axis=1)[:, None]             # [bq, 1]
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0, 0], axis=1)[:, None]
         dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
         ds = p * (dov - delta) * sm_scale                    # [bq, bk]
         # dk += ds^T q ; dv += p^T do   (contract over the bq rows)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(i == num_q - 1)
@@ -367,8 +384,8 @@ def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, q_offset=None,
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024, q_offset=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] arrays (model layout).
 
@@ -386,19 +403,24 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     if D > LANES and D % LANES:
         raise NotImplementedError(
             f"head_dim {D} > {LANES} must be a multiple of {LANES}")
-    # Odd-factor sequence lengths (e.g. S=257) drive _pick_block down to
-    # near-1 blocks — a pathologically fine grid. The XLA path is faster
-    # there; sp-sharded calls (traced q_offset) can't take it because it
-    # has no offset plumbing, so they keep the tiny-block kernel.
+    # Odd-factor sequence lengths (e.g. S=257) admit only degenerate
+    # blocks: either near-1 (pathologically fine grid) or — now that the
+    # defaults exceed typical S — one full-sequence block off the MXU
+    # tiling (sublane 8 / lane 128), which _bcast_lanes cannot widen and
+    # Mosaic has no tested layout for. Both take the XLA path instead;
+    # sp-sharded calls (traced q_offset) can't, because it has no offset
+    # plumbing, so they keep the kernel.
     bq = _pick_block(q.shape[1], block_q)
     bk = _pick_block(k.shape[1], block_k)
-    if min(bq, bk) < MIN_BLOCK and q_offset is None:
+    aligned = (bq % LSE_SUBLANES == 0
+               and (bk <= LANES or bk % LANES == 0))
+    if (min(bq, bk) < MIN_BLOCK or not aligned) and q_offset is None:
         _warn_once(
             f"tiny-block-{q.shape[1]}x{k.shape[1]}",
-            f"flash_attention: seq lengths {q.shape[1]}/{k.shape[1]} only "
-            f"admit {bq}x{bk} blocks (< {MIN_BLOCK}); using the XLA "
-            "attention path instead — pad sequences to a power-of-two "
-            "multiple to re-enable the Pallas kernel")
+            f"flash_attention: seq lengths {q.shape[1]}/{k.shape[1]} admit "
+            f"only {bq}x{bk} blocks (< {MIN_BLOCK} or off the 8x128 MXU "
+            "tiling); using the XLA attention path instead — pad sequences "
+            "to a power-of-two multiple to re-enable the Pallas kernel")
         from vodascheduler_tpu.parallel.ring_attention import (
             reference_attention)
         return reference_attention(q, k, v, causal=causal)
