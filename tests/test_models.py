@@ -203,6 +203,30 @@ class TestScannedLayers:
         assert llama.LLAMA_350M.scan_layers
         assert not llama.LLAMA_TINY.scan_layers
 
+    def test_remat_policy_numerics_match_full_remat(self):
+        """Selective remat (REMAT_POLICIES) changes what's saved, not
+        what's computed: the training trajectory must match full remat."""
+        import dataclasses
+
+        from vodascheduler_tpu.models import llama
+        from vodascheduler_tpu.models.registry import get_model
+
+        losses = {}
+        for policy in (None, "dots_attn"):
+            cfg = dataclasses.replace(llama.LLAMA_TINY_SCAN,
+                                      remat_layers=True, remat_policy=policy)
+            bundle = get_model("llama_tiny")
+            bundle.module = llama.Llama(cfg)
+            s = TrainSession(bundle, num_chips=4, global_batch_size=4,
+                             plan=MeshPlan(dp=2, tp=2), seed=7)
+            losses[policy] = s.run_steps(3)
+        assert losses["dots_attn"] == pytest.approx(losses[None], rel=1e-4)
+
+    def test_remat_policy_unknown_name_raises(self):
+        from vodascheduler_tpu.models.layers import _resolve_remat_policy
+        with pytest.raises(ValueError, match="unknown remat_policy"):
+            _resolve_remat_policy("bogus")
+
     def test_scanned_mixtral_trains_with_ep(self):
         import dataclasses
 

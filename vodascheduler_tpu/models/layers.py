@@ -99,6 +99,10 @@ class Attention(nn.Module):
         if fn is None:
             fn = lambda q, k, v: reference_attention(q, k, v, causal=cfg.causal)
         out = fn(q, k, v)  # [B,S,H,D]
+        # Named so remat policies can save the kernel output and skip the
+        # flash-forward re-run in backward (scan_stack REMAT_POLICIES).
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "attn_out")
 
         out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
         return nn.DenseGeneral(features=x.shape[-1], use_bias=False,
@@ -156,7 +160,37 @@ class EncoderBlock(nn.Module):
         return x + h
 
 
+# name -> zero-arg factory returning a jax.checkpoint policy (factories,
+# not policy objects, so importing this module stays jax-config free).
+REMAT_POLICIES = {
+    # Full remat: save only layer boundaries, recompute everything.
+    None: lambda: None,
+    # Save every matmul output; backward recomputes only elementwise ops
+    # (norms/silu/rope). HBM: ~300 MB/layer at B=8 S=2048 D=1024 — buys
+    # back most of full remat's ~1/3 recompute FLOPs.
+    "dots": lambda: jax.checkpoint_policies.dots_saveable,
+    # Save just the attention-kernel output (checkpoint_name "attn_out"
+    # in Attention) — backward skips the flash fwd re-run; ~32 MB/layer.
+    "attn_out": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_out"),
+    # Both of the above: the right trade once per-chip activations shrink
+    # (multi-chip fsdp); OOMs the single v5e (doc/benchmarks.md).
+    "dots_attn": lambda: jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out")),
+}
+
+
+def _resolve_remat_policy(name):
+    """Map a config-level policy name to a jax.checkpoint policy fn."""
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; one of {list(REMAT_POLICIES)}")
+    return REMAT_POLICIES[name]()
+
+
 def scan_stack(body_cls, num_layers: int, remat: bool = False,
+               remat_policy: Optional[str] = None,
                name: str = "layers_scan", **body_kwargs):
     """nn.scan over a (carry, None) -> (carry, None) layer body module.
 
@@ -167,13 +201,17 @@ def scan_stack(body_cls, num_layers: int, remat: bool = False,
     default name unless you extend the rules). `remat=True` additionally
     recomputes each layer in the backward (HBM for activations drops to
     layer boundaries at ~1/3 extra FLOPs) — decoupled from scanning so
-    models that fit comfortably don't pay the recompute.
+    models that fit comfortably don't pay the recompute. `remat_policy`
+    softens full remat by saving selected intermediates (REMAT_POLICIES);
+    ignored when remat is False.
 
     Used by models/llama.py and models/mixtral.py; the invocation
     (variable_axes/split_rngs/metadata_params) lives here once because
     the sharding-rule contract depends on it.
     """
-    body = nn.remat(body_cls, prevent_cse=False) if remat else body_cls
+    body = (nn.remat(body_cls, prevent_cse=False,
+                     policy=_resolve_remat_policy(remat_policy))
+            if remat else body_cls)
     return nn.scan(body,
                    variable_axes={"params": 0},
                    split_rngs={"params": True},
@@ -213,7 +251,8 @@ def pipelined_lm_forward(cfg, block: nn.Module, num_stages: int,
             lambda p, h: block.apply({"params": p}, h),
             params["layers_scan"]["block"], x,
             num_stages=num_stages, num_microbatches=num_microbatches,
-            remat=cfg.remat_layers)
+            remat=cfg.remat_layers,
+            remat_policy=getattr(cfg, "remat_policy", None))
         x = norm.apply({"params": params["final_norm"]}, x)
         w = params["lm_head_kernel"]
         if targets is None:

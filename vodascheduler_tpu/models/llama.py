@@ -40,6 +40,10 @@ class LlamaConfig:
     # for the single-chip bench flagship so measured MFU prices no
     # recompute.
     remat_layers: bool = False
+    # Selective remat (models/layers.py REMAT_POLICIES): e.g. "dots_attn"
+    # saves matmul + attention-kernel outputs so backward recomputes only
+    # elementwise ops. None = full remat when remat_layers is on.
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -133,7 +137,9 @@ class Llama(nn.Module):
         if cfg.scan_layers:
             from vodascheduler_tpu.models.layers import scan_stack
             x, _ = scan_stack(_ScanBody, cfg.num_layers,
-                              remat=cfg.remat_layers, attn_cfg=attn_cfg,
+                              remat=cfg.remat_layers,
+                              remat_policy=cfg.remat_policy,
+                              attn_cfg=attn_cfg,
                               mlp_hidden=cfg.mlp_hidden,
                               attn_fn=self.attn_fn)(x, None)
         else:

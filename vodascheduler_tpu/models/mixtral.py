@@ -44,6 +44,7 @@ class MixtralConfig:
     capacity_factor: float = 1.25     # routed: slots per expert vs even load
     scan_layers: bool = False         # nn.scan over layers (see llama.py)
     remat_layers: bool = False        # per-layer remat, decoupled from scan
+    remat_policy: Optional[str] = None  # selective remat (layers.py REMAT_POLICIES)
 
     @property
     def head_dim(self) -> int:
@@ -153,7 +154,8 @@ class Mixtral(nn.Module):
         if cfg.scan_layers:
             from vodascheduler_tpu.models.layers import scan_stack
             x, _ = scan_stack(_ScanBody, cfg.num_layers,
-                              remat=cfg.remat_layers, cfg=cfg,
+                              remat=cfg.remat_layers,
+                              remat_policy=cfg.remat_policy, cfg=cfg,
                               attn_fn=self.attn_fn)(x, None)
         else:
             for i in range(cfg.num_layers):

@@ -42,14 +42,16 @@ def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
                   x: jax.Array,
                   num_stages: int,
                   num_microbatches: int,
-                  remat: bool = False) -> jax.Array:
+                  remat: bool = False,
+                  remat_policy: Any = None) -> jax.Array:
     """Run x through L layers pipelined over `num_stages`.
 
     layer_fn(layer_params, x) -> x applies ONE layer; `stacked_params`
     leaves are [L, ...] (the scan_stack layout, sharded over pp on axis
     0 by the rules). x is [B, ...] with B divisible by num_microbatches
     (and the microbatch size by the data axes). Returns [B, ...] after
-    all L layers.
+    all L layers. `remat_policy` is a policy name from
+    models/layers.py REMAT_POLICIES (same contract as scan_stack).
     """
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     P = num_stages
@@ -62,7 +64,9 @@ def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
     mb = B // M
 
     if remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        from vodascheduler_tpu.models.layers import _resolve_remat_policy
+        layer_fn = jax.checkpoint(layer_fn,
+                                  policy=_resolve_remat_policy(remat_policy))
 
     # [P, L/P, ...]: stage-major layer blocks. L is pp-sharded in P
     # equal pieces, so this reshape is device-local.
