@@ -15,7 +15,7 @@ def cpu_escape_hatch(monkeypatch):
 def test_model_point_and_attention_point():
     from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
     out = run_hardware_bench(model_points=(("llama_tiny", 4),),
-                             attention_points=((2, 128),))
+                             attention_points=((2, 128),), moe_batch=None)
     assert out["models"] and out["attention"]
     model = out["models"][0]
     assert model["model"] == "llama_tiny"
@@ -30,8 +30,24 @@ def test_model_point_and_attention_point():
 def test_point_errors_are_isolated():
     from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
     out = run_hardware_bench(model_points=(("no_such_model", 4),),
-                             attention_points=())
+                             attention_points=(), moe_batch=None)
     assert "error" in out["models"][0]
+
+
+def test_moe_dispatch_compare_hermetic():
+    """The gather/routed/dense comparison runs hermetically on a tiny
+    config and reports active-param MFU for the gather flagship."""
+    from vodascheduler_tpu.models import mixtral
+    from vodascheduler_tpu.runtime.hwbench import bench_moe_dispatch
+
+    out = bench_moe_dispatch(2, model_name="mixtral_tiny",
+                             base_cfg=mixtral.MIXTRAL_TINY)
+    assert out["gather"]["step_time_ms"] > 0
+    assert out["routed_step_ms"] > 0
+    assert out["dense_step_ms"] > 0
+    assert out["gather_speedup_vs_dense"] > 0
+    # MoE convention: active < total params (top_k=2 of 4 experts).
+    assert 0 < out["gather"]["num_params_active"] < out["gather"]["num_params"]
 
 
 def test_refuses_cpu_without_escape_hatch(monkeypatch):

@@ -63,6 +63,24 @@ def test_job_runs_to_completion(backend, tmp_path):
     assert latest_step(str(tmp_path / "job-a" / "ckpt")) == 6  # 2 epochs x 3
 
 
+def test_profile_hook_writes_trace(backend, tmp_path, monkeypatch):
+    """VODA_PROFILE=1: the supervisor captures one XLA trace chunk into
+    <workdir>/profile and still completes the job with correct CSV rows
+    (the profiled chunk is untimed, like warmup)."""
+    monkeypatch.setenv("VODA_PROFILE", "1")
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(_spec("job-prof", epochs=1, steps=4), num_workers=1)
+    assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                             for e in events)), \
+        open(tmp_path / "job-prof" / "supervisor.log").read()
+    profile_dir = tmp_path / "job-prof" / "profile"
+    assert profile_dir.is_dir() and any(profile_dir.rglob("*")), \
+        "no trace files captured"
+    rows = read_epoch_csv(os.path.join(backend.metrics_dir, "job-prof.csv"))
+    assert [int(r["epoch"]) for r in rows] == [0]
+
+
 def test_scale_restarts_with_checkpoint(backend, tmp_path):
     events = []
     backend.set_event_callback(events.append)

@@ -40,7 +40,7 @@ class MixtralConfig:
     top_k: int = 2
     rope_base: float = 1000000.0
     dtype: str = "bfloat16"
-    dispatch: str = "routed"          # "routed" | "dense"
+    dispatch: str = "routed"          # "routed" | "gather" | "dense"
     capacity_factor: float = 1.25     # routed: slots per expert vs even load
     scan_layers: bool = False         # nn.scan over layers (see llama.py)
     remat_layers: bool = False        # per-layer remat, decoupled from scan
@@ -52,6 +52,18 @@ class MixtralConfig:
 
 
 MIXTRAL_8X7B_LIKE = MixtralConfig(scan_layers=True, remat_layers=True)
+# ~390M-total / ~140M-active single-chip MoE: the hardware-bench MoE
+# flagship (bench.py), sized like LLAMA_350M is for the dense family.
+# The size budget prices the hwbench harness's non-donated state copy
+# (state appears twice during the scanned-step measurement), so fp32
+# AdamW state (~4.6 GB) x2 + routing transients fit one 16 GB v5e.
+# dispatch="gather": the single-chip dispatch — the einsum formulation's
+# one-hot matmuls exceed the expert FLOPs without an ep axis to shard
+# them over (ops/moe_dispatch.py, doc/benchmarks.md).
+MIXTRAL_SMALL = MixtralConfig(dim=640, num_layers=12, num_heads=10,
+                              num_kv_heads=5, mlp_hidden=1792,
+                              num_experts=8, top_k=2, dispatch="gather",
+                              scan_layers=True, remat_layers=True)
 MIXTRAL_TINY = MixtralConfig(vocab_size=256, dim=64, num_layers=2,
                              num_heads=4, num_kv_heads=2, mlp_hidden=128,
                              num_experts=4, top_k=2, rope_base=10000.0)
@@ -80,11 +92,15 @@ class MoEBlock(nn.Module):
         w_up = self.param("experts_up_kernel", init, (E, D, H))
         w_down = self.param("experts_down_kernel", init, (E, H, D))
 
-        if cfg.dispatch == "routed":
-            from vodascheduler_tpu.ops.moe_dispatch import routed_ffn
-            return routed_ffn(x, gate, w_gate, w_up, w_down,
-                              capacity_factor=cfg.capacity_factor,
-                              top_k=cfg.top_k)
+        if cfg.dispatch in ("routed", "gather"):
+            from vodascheduler_tpu.ops.moe_dispatch import (
+                gathered_ffn,
+                routed_ffn,
+            )
+            ffn = routed_ffn if cfg.dispatch == "routed" else gathered_ffn
+            return ffn(x, gate, w_gate, w_up, w_down,
+                       capacity_factor=cfg.capacity_factor,
+                       top_k=cfg.top_k)
 
         xb = x.astype(jnp.bfloat16)
         h = jnp.einsum("bsd,edh->besh", xb, w_gate.astype(jnp.bfloat16))

@@ -156,6 +156,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             module=nmt.Seq2SeqTransformer(nmt.NMT_TINY),
             make_batch=_nmt_batch(nmt.NMT_TINY.vocab_size, 32, 32),
             loss_fn=_lm_loss, rules=TRANSFORMER_RULES, seq_len=32),
+        "mixtral_small": lambda: ModelBundle(
+            name="mixtral_small",
+            module=mixtral.Mixtral(mixtral.MIXTRAL_SMALL),
+            make_batch=_lm_batch(mixtral.MIXTRAL_SMALL.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.39,
+            seq_len=2048, num_experts=8),
         "mixtral_tiny": lambda: ModelBundle(
             name="mixtral_tiny", module=mixtral.Mixtral(mixtral.MIXTRAL_TINY),
             make_batch=_lm_batch(mixtral.MIXTRAL_TINY.vocab_size, 64),

@@ -31,7 +31,7 @@ from __future__ import annotations
 import dataclasses
 import statistics
 import time
-from typing import Any, Callable, Dict, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +63,22 @@ def peak_flops_per_device(default: float = 197e12) -> float:
 
 def count_params(tree: Any) -> int:
     return sum(x.size for x in jax.tree.leaves(tree) if hasattr(x, "size"))
+
+
+def count_params_active(tree: Any, top_k: int, num_experts: int) -> int:
+    """Per-token *active* params for MoE trees: expert leaves (param path
+    contains 'experts_', the MoEBlock naming) count at top_k/E weight —
+    the standard MoE-MFU convention (analytic FLOPs price only routed
+    compute). Equals count_params for dense trees."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    total = expert = 0
+    for path, leaf in flat:
+        if not hasattr(leaf, "size"):
+            continue
+        total += leaf.size
+        if any("experts_" in str(key) for key in path):
+            expert += leaf.size
+    return int(total - expert + expert * top_k / num_experts)
 
 
 def transformer_step_flops(num_params: int, num_layers: int, d_model: int,
@@ -111,6 +127,7 @@ class StepBenchResult:
     mfu: float
     num_params: int
     device_kind: str
+    num_params_active: int = 0  # < num_params only for MoE models
 
     def as_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -134,6 +151,10 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
         "bert_tiny": (bert.BERT_TINY.num_layers, bert.BERT_TINY.dim),
         "mixtral_8x7b": (mixtral.MIXTRAL_8X7B_LIKE.num_layers,
                          mixtral.MIXTRAL_8X7B_LIKE.dim),
+        "mixtral_small": (mixtral.MIXTRAL_SMALL.num_layers,
+                          mixtral.MIXTRAL_SMALL.dim),
+        "mixtral_tiny": (mixtral.MIXTRAL_TINY.num_layers,
+                         mixtral.MIXTRAL_TINY.dim),
         "vit_l16": (vit.VIT_L16.num_layers, vit.VIT_L16.dim),
     }
     if model_name not in table:
@@ -143,7 +164,8 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
 
 def bench_model_step(model_name: str, global_batch_size: int,
                      k_small: int = 2, k_big: int = 10,
-                     num_chips: int = 1) -> StepBenchResult:
+                     num_chips: int = 1,
+                     bundle: Optional[Any] = None) -> StepBenchResult:
     """Time the full train step (fwd+bwd+optimizer) on hardware.
 
     K steps run inside one jitted scan over the raw step fn (state carries
@@ -151,11 +173,14 @@ def bench_model_step(model_name: str, global_batch_size: int,
     hoist); one fixed on-device batch is reused so the measurement is pure
     step time, matching the supervisor's CSV timing contract
     (runtime/supervisor.py excludes input pipeline the same way).
+    `bundle` overrides the registry lookup (bench_moe_dispatch passes
+    config variants); `model_name` still keys the FLOPs structure.
     """
     from vodascheduler_tpu.models.registry import get_model
     from vodascheduler_tpu.runtime.train import make_train_setup
 
-    bundle = get_model(model_name)
+    if bundle is None:
+        bundle = get_model(model_name)
     setup = make_train_setup(bundle, num_chips,
                              global_batch_size=global_batch_size)
     state0 = setup.init_fn(jax.random.PRNGKey(0))
@@ -185,7 +210,14 @@ def bench_model_step(model_name: str, global_batch_size: int,
     seq = bundle.seq_len or 1
     n_layers, d_model = _lm_structure(model_name)
     n_params = count_params(state0["params"])
-    flops = transformer_step_flops(n_params, n_layers, d_model,
+    # MoE: analytic FLOPs price only the routed (active) compute.
+    cfg = getattr(bundle.module, "cfg", None)
+    if bundle.num_experts and getattr(cfg, "top_k", 0):
+        n_active = count_params_active(state0["params"], cfg.top_k,
+                                       cfg.num_experts)
+    else:
+        n_active = n_params
+    flops = transformer_step_flops(n_active, n_layers, d_model,
                                    global_batch_size, seq)
     peak = peak_flops_per_device() * num_chips
     return StepBenchResult(
@@ -196,6 +228,7 @@ def bench_model_step(model_name: str, global_batch_size: int,
         achieved_tflops=flops / step_s / 1e12,
         mfu=flops / step_s / peak,
         num_params=n_params,
+        num_params_active=n_active,
         device_kind=jax.devices()[0].device_kind)
 
 
@@ -243,6 +276,51 @@ def bench_attention_point(batch: int, seq: int, heads: int = 16,
     return results
 
 
+def bench_moe_dispatch(global_batch_size: int = 8,
+                       model_name: str = "mixtral_small",
+                       base_cfg: Optional[Any] = None) -> Dict[str, Any]:
+    """MoE dispatch comparison, full train step: gather vs routed-einsum
+    vs dense on the same model (only MixtralConfig.dispatch differs).
+
+    The MoE analogue of the flash-vs-XLA comparison. Dense computes every
+    expert on every token (E/top_k more expert FLOPs); gather moves
+    routed tokens by scatter/gather (the single-chip dispatch); routed
+    is the GShard one-hot-einsum formulation whose dispatch matmuls only
+    amortize under ep sharding — measuring all three on one chip prices
+    each honestly. Per-dispatch isolation: one variant OOMing must not
+    void the others.
+    """
+    import dataclasses as _dc
+
+    from vodascheduler_tpu.models import mixtral
+    from vodascheduler_tpu.models.registry import get_model
+
+    if base_cfg is None:
+        base_cfg = mixtral.MIXTRAL_SMALL
+    out: Dict[str, Any] = {}
+    for dispatch in ("gather", "routed", "dense"):
+        try:
+            bundle = get_model(model_name)
+            bundle.module = mixtral.Mixtral(
+                _dc.replace(base_cfg, dispatch=dispatch))
+            res = bench_model_step(model_name, global_batch_size,
+                                   bundle=bundle)
+            if dispatch == "gather":
+                out["gather"] = res.as_dict()  # full MFU record
+            else:
+                out[f"{dispatch}_step_ms"] = round(res.step_time_ms, 2)
+        except Exception as e:  # noqa: BLE001
+            out[dispatch if dispatch == "gather"
+                else f"{dispatch}_step_ms"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    gather_ms = (out.get("gather") or {}).get("step_time_ms")
+    dense_ms = out.get("dense_step_ms")
+    if isinstance(gather_ms, (int, float)) and isinstance(dense_ms,
+                                                          (int, float)):
+        out["gather_speedup_vs_dense"] = round(dense_ms / gather_ms, 3)
+    return out
+
+
 DEFAULT_ATTENTION_POINTS: Sequence[Tuple[int, int]] = (
     (8, 1024), (4, 2048), (2, 4096), (1, 8192))
 
@@ -250,6 +328,7 @@ DEFAULT_ATTENTION_POINTS: Sequence[Tuple[int, int]] = (
 def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         ("llama_350m", 8),),
         attention_points: Sequence[Tuple[int, int]] = DEFAULT_ATTENTION_POINTS,
+        moe_batch: Optional[int] = 8,
         ) -> Dict[str, Any]:
     """The full hardware section for bench.py.
 
@@ -297,6 +376,11 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
             out["attention"].append({
                 "batch": bsz, "seq": seq,
                 "error": f"{type(e).__name__}: {e}"})
+    if moe_batch:
+        try:
+            out["moe"] = bench_moe_dispatch(moe_batch)
+        except Exception as e:  # noqa: BLE001
+            out["moe"] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
 

@@ -189,6 +189,17 @@ def run_job(workdir: str, num_chips: int,
     # what the resize earned. So one warmup step runs untimed, and epoch
     # time is extrapolated from the timed steps (the fake backend models
     # clean epoch times the same way, cluster/fake.py).
+    # On-demand profiling (VODA_PROFILE=1): process 0 captures an XLA
+    # trace of the first timed chunk after warmup into
+    # <workdir>/profile/ — viewable with xprof/tensorboard. The TPU
+    # profiler prices each op (MXU utilization, HBM traffic, infeed
+    # stalls), which the step-time CSV can't attribute. One chunk only:
+    # trace files grow with captured ops, not wall time, and the job
+    # must not pay collection overhead every epoch.
+    profile_pending = (os.environ.get("VODA_PROFILE") == "1"
+                       and jax.process_index() == 0)
+    profile_dir = os.path.join(workdir, "profile")
+
     warmup_pending = True
     warmup_step_time = 0.0
     while session.step < total_steps:
@@ -203,6 +214,8 @@ def run_job(workdir: str, num_chips: int,
             warmup_pending = False
         timed_steps = 0
         timed_time = 0.0
+        profiled_steps = 0
+        profiled_time = 0.0
         while session.step < epoch_end_step:
             if stop_requested["flag"]:
                 # Durable before exit (save itself drains any still-flying
@@ -211,14 +224,52 @@ def run_job(workdir: str, num_chips: int,
                 session.finish_saves()
                 return PREEMPTED_EXIT_CODE
             n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
+            if profile_pending:
+                # Profiler calls are best-effort (remote-TPU transports
+                # may not support device tracing; the job must train
+                # regardless) — but the training steps themselves are
+                # NOT: their errors propagate, and stop_trace runs in a
+                # finally so a failed chunk can't leave the profiler
+                # collecting for the rest of the job.
+                profile_pending = False
+                started = False
+                try:
+                    jax.profiler.start_trace(profile_dir)
+                    started = True
+                except Exception as e:  # noqa: BLE001
+                    print(f"supervisor: profiling failed ({e})",
+                          file=sys.stderr)
+                t0 = time.monotonic()
+                try:
+                    session.run_steps(n)
+                finally:
+                    if started:
+                        try:
+                            jax.profiler.stop_trace()
+                        except Exception as e:  # noqa: BLE001
+                            print(f"supervisor: stop_trace failed ({e})",
+                                  file=sys.stderr)
+                # The profiled chunk enters telemetry only as a last
+                # resort (collection overhead must not skew the epoch
+                # CSV) — but it is still post-compile, so it beats the
+                # warmup fallback when it's the only sample.
+                profiled_time += time.monotonic() - t0
+                profiled_steps += n
+                continue
             t0 = time.monotonic()
             session.run_steps(n)
             timed_time += time.monotonic() - t0
             timed_steps += n
-        # Single-step epochs may consist only of the warmup step; its
-        # compile-inclusive time is the only sample we have then.
-        step_time = (timed_time / timed_steps if timed_steps
-                     else warmup_step_time)
+        # Fallback order when an epoch has no cleanly-timed steps: the
+        # profiled chunk (post-compile, trace overhead included) beats
+        # the warmup step (compile-inclusive — the speedup-curve poison
+        # the warmup machinery exists to keep out of the CSV).
+        if timed_steps:
+            step_time = timed_time / timed_steps
+        elif profiled_steps:
+            step_time = profiled_time / profiled_steps
+        else:
+            step_time = warmup_step_time
         if logger is not None:
             logger.log_epoch(epoch_time_sec=step_time * steps_this_epoch,
                              step_time_sec=step_time,
