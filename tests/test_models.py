@@ -164,6 +164,32 @@ class TestRoutedMoE:
                                   - gather.astype(jnp.float32)))
             assert float(err) < 1e-2, (cap, float(err))
 
+    def test_tied_router_routes_exactly_top_k(self):
+        """Router ties (identical logits) must not diverge the two
+        dispatch formulations: top_k_gating keeps EXACTLY top_k experts
+        (index tie-break), so routed and gather agree even then."""
+        import jax.numpy as jnp
+
+        from vodascheduler_tpu.ops.moe_dispatch import top_k_gating
+        probs = jnp.full((3, 4), 0.25)  # all four experts tied
+        gate = top_k_gating(probs, 2)
+        assert int((gate > 0).sum(-1).max()) == 2
+        assert jnp.allclose(gate.sum(-1), 1.0)
+
+    def test_unknown_dispatch_raises(self):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from vodascheduler_tpu.models import mixtral
+        cfg = dataclasses.replace(mixtral.MIXTRAL_TINY, dispatch="gathered")
+        block = mixtral.MoEBlock(cfg)
+        x = jnp.zeros((1, 8, cfg.dim), jnp.bfloat16)
+        with _pytest.raises(ValueError, match="unknown MixtralConfig"):
+            block.init(jax.random.PRNGKey(0), x)
+
     def test_gather_trains(self):
         import dataclasses
 

@@ -254,3 +254,51 @@ def test_sharded_fallback_warns_once(caplog):
         fn(q, k, v)
     warnings = [r for r in caplog.records if "falling" in r.message]
     assert len(warnings) == 1
+
+
+class TestChunkedCE:
+    """chunked_softmax_ce (ops/chunked_ce.py): the fused LM loss must be
+    a drop-in for the textbook full-logits cross-entropy — same value,
+    same gradients — while never materializing [B, S, V] logits."""
+
+    def _inputs(self, B=2, S=16, D=8, V=64):
+        import optax
+        r = jax.random.PRNGKey(3)
+        r1, r2, r3 = jax.random.split(r, 3)
+        hidden = jax.random.normal(r1, (B, S, D), dtype=jnp.bfloat16)
+        head_w = jax.random.normal(r2, (D, V), dtype=jnp.float32) * 0.1
+        targets = jax.random.randint(r3, (B, S), 0, V, dtype=jnp.int32)
+
+        def reference(h, w):
+            logits = (h @ w.astype(h.dtype)).astype(jnp.float32)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        return hidden, head_w, targets, reference
+
+    def test_matches_unchunked_value_and_grads(self):
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        hidden, head_w, targets, reference = self._inputs()
+
+        loss_c, (dh_c, dw_c) = jax.value_and_grad(
+            lambda h, w: chunked_softmax_ce(h, w, targets, num_chunks=4),
+            argnums=(0, 1))(hidden, head_w)
+        loss_r, (dh_r, dw_r) = jax.value_and_grad(
+            reference, argnums=(0, 1))(hidden, head_w)
+
+        assert float(jnp.abs(loss_c - loss_r)) < 1e-5
+        # Grads flow through bf16 matmuls with different accumulation
+        # order (per-chunk vs one matmul): bf16-rounding tolerances.
+        np.testing.assert_allclose(np.asarray(dw_c, np.float32),
+                                   np.asarray(dw_r, np.float32),
+                                   atol=1e-3, rtol=5e-2)
+        np.testing.assert_allclose(np.asarray(dh_c, np.float32),
+                                   np.asarray(dh_r, np.float32),
+                                   atol=1e-2, rtol=5e-2)
+
+    def test_indivisible_chunks_clamp_to_divisor(self):
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        hidden, head_w, targets, reference = self._inputs(S=15)  # prime-ish
+        loss = chunked_softmax_ce(hidden, head_w, targets, num_chunks=8)
+        # 8 -> clamped to 5 (largest divisor of 15 <= 8); value still matches.
+        assert float(jnp.abs(loss - reference(hidden, head_w))) < 1e-5

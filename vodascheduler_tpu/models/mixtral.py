@@ -101,6 +101,12 @@ class MoEBlock(nn.Module):
             return ffn(x, gate, w_gate, w_up, w_down,
                        capacity_factor=cfg.capacity_factor,
                        top_k=cfg.top_k)
+        if cfg.dispatch != "dense":
+            # A typo ("gathered", "scatter", ...) must not silently train
+            # the dense E/top_k-x-FLOPs path.
+            raise ValueError(
+                f"unknown MixtralConfig.dispatch {cfg.dispatch!r}; "
+                "one of 'routed', 'gather', 'dense'")
 
         xb = x.astype(jnp.bfloat16)
         h = jnp.einsum("bsd,edh->besh", xb, w_gate.astype(jnp.bfloat16))

@@ -47,10 +47,16 @@ def chunked_softmax_ce(hidden: jax.Array, head_w: jax.Array,
     hs = hidden.reshape(B, c, S // c, D).swapaxes(0, 1)
     ts = targets.reshape(B, c, S // c).swapaxes(0, 1)
 
+    # Cast the head once, outside the scan and the checkpoint: inside the
+    # body every chunk would re-read the full fp32 [D, V] and re-write it
+    # bf16 — C fwd + C backward-recompute redundant casts of the largest
+    # single weight in the model.
+    head_b = head_w.astype(hidden.dtype)
+
     @jax.checkpoint
     def body(total, chunk):
         h, t = chunk
-        logits = (h @ head_w.astype(h.dtype)).astype(jnp.float32)
+        logits = (h @ head_b).astype(jnp.float32)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, t)
         return total + loss.sum(), None
 

@@ -34,10 +34,18 @@ import jax.numpy as jnp
 def top_k_gating(probs: jnp.ndarray, top_k: int,
                  eps: float = 1e-9) -> jnp.ndarray:
     """Top-k mask + renormalize: [..., E] probs -> [..., E] gates where
-    only each token's k largest survive, rescaled to sum to 1."""
-    top_vals, _ = jax.lax.top_k(probs, top_k)
-    threshold = top_vals[..., -1:]
-    gate = jnp.where(probs >= threshold, probs, 0.0)
+    EXACTLY each token's k largest survive (lax.top_k's index-order
+    tie-break), rescaled to sum to 1.
+
+    Index-based, not threshold-based: a `probs >= kth_value` mask keeps
+    MORE than k experts when the router ties (e.g. identical logits at
+    init), which would diverge from every consumer that takes exactly k
+    (gathered_ffn's lax.top_k, the capacity model's T·k/E sizing).
+    """
+    _, top_idx = jax.lax.top_k(probs, top_k)                  # [..., k]
+    mask = jax.nn.one_hot(top_idx, probs.shape[-1],
+                          dtype=probs.dtype).sum(axis=-2)     # [..., E]
+    gate = probs * mask
     return gate / jnp.maximum(gate.sum(-1, keepdims=True), eps)
 
 
@@ -148,8 +156,10 @@ def gathered_ffn(x: jnp.ndarray, gates: jnp.ndarray,
 
     pos, kept = _slot_positions(gates_f, capacity)
 
-    # Each token's top_k experts (gate desc). Ties are impossible for
-    # distinct softmax probs; top_k on the gate values matches `route`.
+    # Each token's top_k experts. top_k_gating produces EXACTLY top_k
+    # nonzero gates (index-based tie-break), so lax.top_k here recovers
+    # that same set — the einsum path dispatches every nonzero gate and
+    # both formulations see identical routing even on router ties.
     top_w, top_e = jax.lax.top_k(gates_f, top_k)                # [T,k]
     pos_k = jnp.take_along_axis(pos, top_e, axis=1)             # [T,k]
     kept_k = jnp.take_along_axis(kept, top_e, axis=1)           # [T,k]

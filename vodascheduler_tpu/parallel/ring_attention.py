@@ -32,9 +32,13 @@ NEG_INF = -1e30
 def _block_attn_step(q, k, v, m, l, acc, q_off, k_off, scale, causal):
     """One streamed block: update (m, l, acc) with this k/v block.
 
-    q: [B,H,Sq,D]  k,v: [B,H,Sk,D]  m,l: [B,H,Sq]  acc: [B,H,Sq,D]
+    q: [B,H,Sq,D]  k,v: [B,H,Sk,D] (model dtype — the einsums keep bf16
+    inputs with f32 accumulation so the MXU runs at native rate; softmax
+    statistics m/l and the accumulator stay f32 on the VPU)
+    m,l: [B,H,Sq]  acc: [B,H,Sq,D]
     """
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_off + jnp.arange(q.shape[2])
         k_pos = k_off + jnp.arange(k.shape[2])
@@ -48,7 +52,9 @@ def _block_attn_step(q, k, v, m, l, acc, q_off, k_off, scale, causal):
     p = jnp.where(scores <= NEG_INF, 0.0, p)
     corr = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - safe_new_m))
     l = l * corr + p.sum(axis=-1)
-    acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    acc = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
     return new_m, l, acc
 
 
@@ -84,14 +90,16 @@ def make_ring_attention(
         m = jnp.full((B, H, Sq), NEG_INF, dtype=jnp.float32)
         l = jnp.zeros((B, H, Sq), dtype=jnp.float32)
         acc = jnp.zeros((B, H, Sq, D), dtype=jnp.float32)
-        qf = q.astype(jnp.float32)
-        k_cur, v_cur = k.astype(jnp.float32), v.astype(jnp.float32)
+        # K/V circulate the ring in the model dtype (bf16): half the
+        # ppermute bytes on ICI, and the block einsums want bf16 MXU
+        # inputs anyway (_block_attn_step).
+        k_cur, v_cur = k, v
 
         q_off = idx * Sq
         for r in range(n_shards):
             src = (idx - r) % n_shards if n_shards > 1 else 0
             m, l, acc = _block_attn_step(
-                qf, k_cur, v_cur, m, l, acc,
+                q, k_cur, v_cur, m, l, acc,
                 q_off, src * Sk, scale, causal)
             if n_shards > 1 and r < n_shards - 1:
                 perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
