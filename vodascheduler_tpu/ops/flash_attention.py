@@ -306,12 +306,23 @@ def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    # The lse residual is blocked by the FORWARD's bq (its dim 2 counts
+    # fwd q-blocks). When the backward runs a different q block, re-block
+    # it with plain XLA ops — fwd blocks are contiguous rows, so dropping
+    # the sublane padding and reshaping regroups them exactly, in either
+    # direction (any bq dividing Sq); the kernels then read their usual
+    # (1, bq)-lane layout. (An in-kernel reshape across the block dim is
+    # not a Mosaic-supported layout cast.)
+    bq_f = lse.shape[4]
+    if bq != bq_f:
+        lse = lse[:, :, :, :1, :].reshape(B, H, Sq // bq, 1, bq)
+    lse_sub = lse.shape[3]
     num_q, num_k = Sq // bq, Sk // bk
     sm_scale = D ** -0.5
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
-    lse_spec = pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+    lse_spec = pl.BlockSpec((1, 1, 1, lse_sub, bq),
                             lambda b, h, i, j: (b, h, i, 0, 0))
 
     off_spec = pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
@@ -335,7 +346,7 @@ def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
     # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
     q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
     kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
-    lse_spec_t = pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+    lse_spec_t = pl.BlockSpec((1, 1, 1, lse_sub, bq),
                               lambda b, h, j, i: (b, h, i, 0, 0))
     off_spec_t = pl.BlockSpec((1, 1), lambda b, h, j, i: (0, 0),
                               memory_space=pltpu.SMEM)
@@ -363,35 +374,45 @@ def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_bhsd(q, k, v, q_off, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, q_off, causal, block_q, block_k, block_bwd,
+                interpret):
     o, _ = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
+def _flash_bhsd_fwd(q, k, v, q_off, causal, block_q, block_k, block_bwd,
+                    interpret):
     o, lse = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
     return o, (q, k, v, o, lse, q_off)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bhsd_bwd(causal, block_q, block_k, block_bwd, interpret, res, g):
     q, k, v, o, lse, q_off = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k,
-                      interpret)
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, q_off, causal, block_bwd,
+                      block_bwd, interpret)
     return dq, dk, dv, None  # int offset gets no cotangent
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_k: int = 1024, q_offset=None,
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
+                    block_k: int = 1024, block_bwd: int = 1024,
+                    q_offset=None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] arrays (model layout).
 
     Heads must already be GQA-expanded (models/layers.py repeats KV heads
     before calling `attn_fn`). Differentiable via the Pallas backward
     kernels. `interpret=None` auto-selects interpreter mode off-TPU.
+
+    Defaults are the r3 v5e sweep winner measured END TO END on the
+    flagship train step (doc/benchmarks.md): 1024-edge blocks for both
+    passes. `block_bwd` tunes the backward's square block edge
+    independently (the dq/dkv kernels tolerate different tilings than
+    the forward; the saved logsumexp is re-blocked to match, either
+    direction).
 
     `q_offset` (int or traced scalar) is q's global position within the
     K/V sequence — sequence-parallel shards hold a slice of the queries
@@ -412,8 +433,13 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     # plumbing, so they keep the kernel.
     bq = _pick_block(q.shape[1], block_q)
     bk = _pick_block(k.shape[1], block_k)
-    aligned = (bq % LSE_SUBLANES == 0
-               and (bk <= LANES or bk % LANES == 0))
+    # The backward picks its own blocks from the same lengths; an odd
+    # length can alias to an aligned fwd block but an unaligned bwd one
+    # (e.g. Sq=520: fwd bq descends to 8, bwd bq=520), so check both.
+    picks = [(bq, bk), (_pick_block(q.shape[1], block_bwd),
+                        _pick_block(k.shape[1], block_bwd))]
+    aligned = all(pq % LSE_SUBLANES == 0 and (pk <= LANES or pk % LANES == 0)
+                  for pq, pk in picks)
     if (min(bq, bk) < MIN_BLOCK or not aligned) and q_offset is None:
         _warn_once(
             f"tiny-block-{q.shape[1]}x{k.shape[1]}",
@@ -429,7 +455,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     qT = q.transpose(0, 2, 1, 3)  # [B,H,S,D]
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qT, kT, vT, off, causal, block_q, block_k, interpret)
+    out = _flash_bhsd(qT, kT, vT, off, causal, block_q, block_k, block_bwd,
+                      interpret)
     return out.transpose(0, 2, 1, 3)
 
 

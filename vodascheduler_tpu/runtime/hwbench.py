@@ -239,7 +239,11 @@ def bench_attention_point(batch: int, seq: int, heads: int = 16,
 
     The scan body perturbs q by (1 + loss*0) — numerically exactly q, but
     data-dependent on the carried loss so XLA cannot hoist the attention
-    out of the loop as loop-invariant.
+    out of the loop as loop-invariant. The carry also folds in one
+    element of each gradient (scaled by 1e-30): grads whose values never
+    reach the output are dead code XLA deletes, which silently turns a
+    "fwd+bwd" measurement into fwd-only — caught by an r3 trace of the
+    full model, where the backward kernels are very much alive.
     """
     from vodascheduler_tpu.ops.flash_attention import flash_attention
     from vodascheduler_tpu.parallel.ring_attention import reference_attention
@@ -261,8 +265,10 @@ def bench_attention_point(batch: int, seq: int, heads: int = 16,
             def run(q, k, v):
                 def body(carry, _):
                     q_dep = q * (1.0 + carry * 0.0).astype(q.dtype)
-                    loss, _grads = vg(q_dep, k, v)
-                    return loss, None
+                    loss, grads = vg(q_dep, k, v)
+                    g0 = sum(g.ravel()[0].astype(jnp.float32)
+                             for g in grads)
+                    return loss + 1e-30 * g0, None
                 final, _ = jax.lax.scan(body, jnp.float32(0.0), None,
                                         length=k_iters)
                 return final
