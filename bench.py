@@ -61,59 +61,114 @@ def run_replay():
     return harness.run()
 
 
+HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m_8k", 2]]
+# Attention points inherit the child's DEFAULT_ATTENTION_POINTS
+# (runtime/hwbench.py) — one canonical sweep definition, no drift.
+
+
+def parse_hw_stream(stdout: str) -> dict:
+    """Rebuild the hardware-section dict from hwbench --stream lines.
+
+    Tolerates a truncated final line (the child may be killed mid-write)
+    and non-JSON noise (jax warnings on stdout)."""
+    out = {"models": [], "attention": []}
+    for line in stdout.splitlines():
+        try:
+            item = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(item, dict):
+            continue
+        kind, data = item.get("kind"), item.get("data")
+        if kind == "meta":
+            out.update(data)
+        elif kind == "model":
+            out["models"].append(data)
+        elif kind == "attention":
+            out["attention"].append(data)
+        elif kind == "moe":
+            out["moe"] = data
+    return out
+
+
 def maybe_hardware():
     """Measured numbers from the real chip; None off-accelerator (or when
     VODA_BENCH_HW=0 skips it), an {"error": ...} marker if the
     accelerator is present but the bench fails (e.g. tunnel flake) — the
-    replay headline must still print. A SIGALRM watchdog
-    (VODA_BENCH_HW_TIMEOUT, default 1800s) turns a wedged remote-compile
-    into an error instead of hanging the whole bench."""
+    replay headline must still print.
+
+    The whole hardware section runs in a SUBPROCESS (hwbench --stream)
+    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 1800s): a
+    wedged remote compile blocks inside native code holding the GIL,
+    where no in-process signal can interrupt it (observed live in r3 —
+    a SIGALRM watchdog sailed straight past its deadline). Killing the
+    child from outside always works, and the streamed per-point JSON
+    lines mean every point completed before the wedge is kept. Popen +
+    a post-kill communicate() drain is load-bearing: subprocess.run()
+    on POSIX discards already-flushed child output on timeout."""
     if os.environ.get("VODA_BENCH_HW") == "0":
         return None
-    old_handler = None
+    import subprocess
+    import sys
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
-        # Watchdog is best-effort: SIGALRM only exists on unix main
-        # threads; anywhere else the bench just runs unguarded.
-        import signal
-
-        def _alarm(signum, frame):
-            raise TimeoutError("hardware bench exceeded its time budget")
-
-        # Backend init through a dead TPU tunnel HANGS inside native code
-        # holding the GIL — SIGALRM can't interrupt it — so probe the
-        # accelerator in a SUBPROCESS with a hard timeout before
-        # committing this process (and the driver's bench run) to it.
-        import subprocess
-        import sys
+        # A dead tunnel hangs backend INIT too — probe cheaply first so
+        # the full child (and its import costs) isn't spent learning it.
         probe = int(os.environ.get("VODA_BENCH_HW_PROBE_TIMEOUT", "120"))
         probe_res = subprocess.run(
             [sys.executable, "-c",
-             "import jax, numpy;"
+             # The config update makes JAX_PLATFORMS=cpu win over an
+             # eagerly-registered TPU plugin (hermetic tests set it; in
+             # production it is unset and the real backend is probed).
+             "import os, jax, numpy;\n"
+             "if os.environ.get('JAX_PLATFORMS', '') == 'cpu':\n"
+             "    jax.config.update('jax_platforms', 'cpu')\n"
              "print(jax.default_backend());"
              "float(numpy.asarray(jax.numpy.ones(()) + 1))"],
             capture_output=True, text=True, timeout=probe)
         if probe_res.returncode != 0:
             return {"error": f"accelerator probe failed: "
                              f"{probe_res.stderr.strip()[-300:]}"}
-        if probe_res.stdout.strip().splitlines()[-1] not in ("tpu", "gpu"):
+        backend = probe_res.stdout.strip().splitlines()[-1]
+        if backend not in ("tpu", "gpu") and not os.environ.get(
+                "VODA_HWBENCH_ON_CPU"):  # tests drive the full path on CPU
             return None
+
+        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
+        cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
+               "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]
+        # cwd pins the child's import root: the package is run from the
+        # source tree, and `python /path/to/bench.py` from elsewhere
+        # must not strand the child without `vodascheduler_tpu`.
+        # Binary pipes + errors="replace" decode: SIGKILL can cut the
+        # stream at any byte, and one undecodable tail byte must not
+        # void every salvaged point.
+        child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, cwd=repo_dir)
+        timed_out = False
         try:
-            timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
-            old_handler = signal.signal(signal.SIGALRM, _alarm)
-            signal.alarm(timeout)
-        except (AttributeError, ValueError):
-            old_handler = None
-        from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
-        return run_hardware_bench(
-            model_points=(("llama_350m", 8),),
-            attention_points=((8, 1024), (4, 2048), (2, 4096), (1, 8192)))
+            stdout_b, stderr_b = child.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            stdout_b, stderr_b = child.communicate()
+            timed_out = True
+        stdout = (stdout_b or b"").decode("utf-8", errors="replace")
+        stderr_tail = (stderr_b or b"").decode(
+            "utf-8", errors="replace").strip()[-300:]
+        failed = timed_out or child.returncode != 0
+
+        out = parse_hw_stream(stdout)
+        if timed_out:
+            out["error"] = (f"hardware bench exceeded {timeout}s and was "
+                            "killed; points above completed before the "
+                            "deadline")
+        elif failed:
+            out["error"] = f"hardware bench subprocess failed: {stderr_tail}"
+        if not out["models"] and not out["attention"] and "error" not in out:
+            out["error"] = "hardware bench produced no points"
+        return out
     except Exception as e:  # noqa: BLE001 - report, don't die
         return {"error": f"{type(e).__name__}: {e}"}
-    finally:
-        if old_handler is not None:
-            import signal
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old_handler)
 
 
 def main() -> None:

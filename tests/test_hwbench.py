@@ -88,3 +88,77 @@ def test_two_point_differencing_cancels_overhead():
         hwbench.time.perf_counter = real_counter
         hwbench._fetch = real_fetch
     assert abs(s - 0.25) < 1e-9
+
+
+def test_stream_main_emits_parseable_lines():
+    """hwbench --stream (the subprocess mode bench.py drives) emits one
+    JSON line per completed item; bench.parse_hw_stream rebuilds the
+    section dict from them — including from a truncated tail."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, VODA_HWBENCH_ON_CPU="1", JAX_PLATFORMS="cpu")
+    kwargs = json.dumps({"model_points": [["llama_tiny", 2]],
+                         "attention_points": [[1, 64]],
+                         "moe_batch": None})
+    res = subprocess.run(
+        [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
+         "--stream", kwargs],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr[-500:]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import parse_hw_stream
+    out = parse_hw_stream(res.stdout)
+    assert out["models"][0]["model"] == "llama_tiny"
+    assert out["attention"][0]["flash_ms"] > 0
+    assert "peak_bf16_tflops_per_chip" in out
+
+    # Kill-mid-write salvage: drop the last line's tail — earlier points
+    # must survive.
+    truncated = res.stdout[: res.stdout.rfind("{")]
+    partial = parse_hw_stream(truncated)
+    assert partial["models"][0]["model"] == "llama_tiny"
+
+
+def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
+    """The wedge scenario end-to-end: the hwbench child flushes points,
+    then hangs past the deadline; maybe_hardware must kill it and keep
+    every flushed point (Popen + post-kill drain — subprocess.run()
+    discards the pipe on POSIX timeouts)."""
+    import sys
+    import textwrap
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    # Stand in for the hwbench module: emit two points, then wedge.
+    fake_pkg = tmp_path / "vodascheduler_tpu" / "runtime"
+    fake_pkg.mkdir(parents=True)
+    (tmp_path / "vodascheduler_tpu" / "__init__.py").write_text("")
+    (fake_pkg / "__init__.py").write_text("")
+    (fake_pkg / "hwbench.py").write_text(textwrap.dedent("""
+        import json, sys, time
+        print(json.dumps({"kind": "meta", "data": {"backend": "fake"}}),
+              flush=True)
+        print(json.dumps({"kind": "model", "data": {"model": "m1",
+              "step_time_ms": 1.0}}), flush=True)
+        time.sleep(600)  # the wedge
+    """))
+    monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
+    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "5")
+    monkeypatch.setenv("VODA_BENCH_HW_PROBE_TIMEOUT", "120")
+    # Point the child's import root at the fake package tree.
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p, _real=os.path.dirname: str(tmp_path)
+                        if p == os.path.abspath(bench.__file__)
+                        else _real(p))
+    out = bench.maybe_hardware()
+    assert out is not None
+    assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
+    assert out["backend"] == "fake"
+    assert "exceeded" in out.get("error", ""), out

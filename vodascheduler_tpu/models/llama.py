@@ -72,6 +72,11 @@ LLAMA3_8B = LlamaConfig(scan_layers=True, remat_layers=True)
 LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
                          num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048,
                          scan_layers=True, remat_layers=True)
+# Long-context variant of the bench flagship (seq 8192, batch dropped to
+# keep tokens/step constant): the attention-dominated regime where the
+# flash kernel's O(S²) advantage over the XLA lowering is largest —
+# the measured long-context point (doc/benchmarks.md, SURVEY §5.7).
+LLAMA_350M_8K = dataclasses.replace(LLAMA_350M, max_seq_len=8192)
 # Tiny config for tests / compile checks
 LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, mlp_hidden=128, max_seq_len=128,

@@ -136,6 +136,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(llama.LLAMA_350M.vocab_size, 2048),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
             seq_len=2048),
+        "llama_350m_8k": lambda: ModelBundle(
+            name="llama_350m_8k",
+            module=llama.Llama(llama.LLAMA_350M_8K),
+            make_batch=_lm_batch(llama.LLAMA_350M_8K.vocab_size, 8192),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=8192),
         "llama_tiny": lambda: ModelBundle(
             name="llama_tiny", module=llama.Llama(llama.LLAMA_TINY),
             make_batch=_lm_batch(llama.LLAMA_TINY.vocab_size, 64),

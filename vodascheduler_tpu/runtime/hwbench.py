@@ -146,6 +146,8 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
     table = {
         "llama3_8b": (llama.LLAMA3_8B.num_layers, llama.LLAMA3_8B.dim),
         "llama_350m": (llama.LLAMA_350M.num_layers, llama.LLAMA_350M.dim),
+        "llama_350m_8k": (llama.LLAMA_350M_8K.num_layers,
+                          llama.LLAMA_350M_8K.dim),
         "llama_tiny": (llama.LLAMA_TINY.num_layers, llama.LLAMA_TINY.dim),
         "bert_base": (bert.BERT_BASE.num_layers, bert.BERT_BASE.dim),
         "bert_tiny": (bert.BERT_TINY.num_layers, bert.BERT_TINY.dim),
@@ -335,11 +337,15 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         ("llama_350m", 8),),
         attention_points: Sequence[Tuple[int, int]] = DEFAULT_ATTENTION_POINTS,
         moe_batch: Optional[int] = 8,
+        emit: Optional[Callable[[str, Any], None]] = None,
         ) -> Dict[str, Any]:
     """The full hardware section for bench.py.
 
     Never simulated: raises off-accelerator unless VODA_HWBENCH_ON_CPU=1
-    (tests use that escape hatch with tiny shapes).
+    (tests use that escape hatch with tiny shapes). `emit(kind, payload)`
+    is called after each completed item — the --stream mode bench.py's
+    subprocess isolation relies on (completed points survive even if a
+    later remote compile wedges and the process is killed).
     """
     import os
     backend = jax.default_backend()
@@ -348,6 +354,7 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         raise RuntimeError(
             f"hardware bench requires an accelerator (backend={backend}); "
             "set VODA_HWBENCH_ON_CPU=1 to smoke-test on CPU")
+    emit = emit or (lambda kind, payload: None)
     out: Dict[str, Any] = {
         "device_kind": jax.devices()[0].device_kind,
         "backend": backend,
@@ -355,6 +362,8 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         "models": [],
         "attention": [],
     }
+    emit("meta", {k: out[k] for k in ("device_kind", "backend",
+                                      "peak_bf16_tflops_per_chip")})
     # Per-point isolation: one failing shape/kernel must not void the
     # rest of the hardware section (this runs unattended at round end).
     for model_name, bsz in model_points:
@@ -375,6 +384,7 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
                     "error": f"{type(e2).__name__}: {e2}"})
             finally:
                 os.environ.pop("VODA_FLASH_ATTENTION", None)
+        emit("model", out["models"][-1])
     for bsz, seq in attention_points:
         try:
             out["attention"].append(bench_attention_point(bsz, seq))
@@ -382,14 +392,55 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
             out["attention"].append({
                 "batch": bsz, "seq": seq,
                 "error": f"{type(e).__name__}: {e}"})
+        emit("attention", out["attention"][-1])
     if moe_batch:
         try:
             out["moe"] = bench_moe_dispatch(moe_batch)
         except Exception as e:  # noqa: BLE001
             out["moe"] = {"error": f"{type(e).__name__}: {e}"}
+        emit("moe", out["moe"])
     return out
 
 
-if __name__ == "__main__":
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """`python -m vodascheduler_tpu.runtime.hwbench [--stream] [args...]`
+
+    --stream prints one JSON line per completed item ({"kind", "data"})
+    instead of one pretty dict at the end — bench.py runs this module as
+    a subprocess in stream mode so a wedged remote compile (which blocks
+    in native code where no signal can interrupt) costs only the
+    unfinished points: the parent kills the child at its deadline and
+    keeps every line already flushed. Extra args are a JSON object of
+    run_hardware_bench kwargs (model_points etc.).
+    """
     import json
-    print(json.dumps(run_hardware_bench(), indent=2))
+    import os
+    import sys
+
+    # Honor JAX_PLATFORMS=cpu even when a TPU plugin registered itself
+    # eagerly (the axon tunnel does): the config API call wins over the
+    # env var alone — without this, a hermetic child process silently
+    # targets (and can hang on) the real accelerator. Same workaround as
+    # __graft_entry__.py.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    args = list(sys.argv[1:] if argv is None else argv)
+    stream = "--stream" in args
+    if stream:
+        args.remove("--stream")
+    kwargs = json.loads(args[0]) if args else {}
+    if "model_points" in kwargs:
+        kwargs["model_points"] = [tuple(p) for p in kwargs["model_points"]]
+    if "attention_points" in kwargs:
+        kwargs["attention_points"] = [tuple(p)
+                                      for p in kwargs["attention_points"]]
+    if stream:
+        def emit(kind, payload):
+            print(json.dumps({"kind": kind, "data": payload}), flush=True)
+        run_hardware_bench(emit=emit, **kwargs)
+    else:
+        print(json.dumps(run_hardware_bench(**kwargs), indent=2))
+
+
+if __name__ == "__main__":
+    main()
