@@ -1,7 +1,7 @@
 """Seq2seq transformer (encoder-decoder) — the NMT model family.
 
-Reference counterpart: the Transformer-NMT elastic example
-(examples/py/tensorflow2/tensorflow2_keras_transformer_nmt_elastic.py),
+Reference counterpart: the Transformer-NMT example
+(examples/py/tensorflow2/neural_machine_translation_with_transformer.py),
 the reference's "big model" workload. TPU-first redesign of the
 architecture (not a Keras translation): pre-norm RMSNorm blocks, RoPE on
 self-attention, bfloat16 activations with fp32 norms/logits, and the same
