@@ -95,7 +95,7 @@ class TestNmt:
     def test_nmt_tiny_trains_sharded(self):
         """Seq2seq (encoder-decoder + cross-attention) trains under a
         dp x tp mesh — the reference's Transformer-NMT family
-        (tensorflow2_keras_transformer_nmt_elastic.py), TPU-native."""
+        (neural_machine_translation_with_transformer.py), TPU-native."""
         s = TrainSession(get_model("nmt_tiny"), num_chips=8,
                          global_batch_size=8, plan=MeshPlan(dp=4, tp=2))
         first = s.run_steps(1)

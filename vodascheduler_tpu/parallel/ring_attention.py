@@ -95,12 +95,17 @@ def make_ring_attention(
         # inputs anyway (_block_attn_step).
         k_cur, v_cur = k, v
 
+        # Each streamed block update is checkpointed: without it, autodiff
+        # saves every step's p matrix — n · B·H·(S/n)² fp32, which at the
+        # long contexts ring attention exists for is tens of GB and
+        # defeats the O(S/n · S/n) memory contract. With it, backward
+        # recomputes scores/p from the (much smaller) carried K/V blocks.
+        step = jax.checkpoint(_block_attn_step, static_argnums=(8, 9))
         q_off = idx * Sq
         for r in range(n_shards):
             src = (idx - r) % n_shards if n_shards > 1 else 0
-            m, l, acc = _block_attn_step(
-                q, k_cur, v_cur, m, l, acc,
-                q_off, src * Sk, scale, causal)
+            m, l, acc = step(q, k_cur, v_cur, m, l, acc,
+                             q_off, src * Sk, scale, causal)
             if n_shards > 1 and r < n_shards - 1:
                 perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
                 k_cur = jax.lax.ppermute(k_cur, seq_axis, perm)
