@@ -19,6 +19,31 @@ def _qkv(seed, B=2, S=128, H=2, D=64, dtype=jnp.float32):
     return [jax.random.normal(k, (B, S, H, D), dtype) for k in keys]
 
 
+def test_head_dim_128_matches_reference():
+    """head_dim 128 = one full lane register (the llama3_8b geometry);
+    fwd and bwd must match the reference at that width too — the suite
+    otherwise only pins D=16..64."""
+    q, k, v = _qkv(11, B=1, S=128, H=2, D=128)
+    w = jax.random.normal(jax.random.PRNGKey(12), q.shape)
+    # Small explicit blocks so the MULTI-block streaming path runs
+    # (defaults would clamp to one S-sized block and test nothing tiled).
+    kw = dict(causal=True, block_q=32, block_k=32, block_bwd=32,
+              interpret=True)
+    out = flash_attention(q, k, v, **kw)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    g = jax.grad(lambda *a: jnp.sum(flash_attention(*a, **kw) * w),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        reference_attention(*a, causal=True) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_forward_matches_reference(causal):
     q, k, v = _qkv(0)
