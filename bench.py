@@ -37,21 +37,13 @@ RESIZE_COOLDOWN_SECONDS = 60.0
 def run_replay():
     from vodascheduler_tpu.placement import PoolTopology
     from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
-    from vodascheduler_tpu.replay.simulator import PreemptionEvent
+    from vodascheduler_tpu.replay.simulator import config5_preemptions
 
     trace = philly_like_trace(num_jobs=64, seed=20260729)
     topology = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))  # 64
     # Spot preemption (BASELINE config 5): two hosts reclaimed mid-trace,
     # returned later — the fleet dips 8/64 chips for ~1.4 simulated hours.
-    names = [topology.host_name(c) for c in topology.host_coords()]
-    preemptions = [
-        PreemptionEvent(at_seconds=4000.0, host=names[3]),
-        PreemptionEvent(at_seconds=4600.0, host=names[7]),
-        PreemptionEvent(at_seconds=9000.0, host=names[3], add=True,
-                        chips=topology.chips_per_host),
-        PreemptionEvent(at_seconds=12000.0, host=names[7], add=True,
-                        chips=topology.chips_per_host),
-    ]
+    preemptions = config5_preemptions(topology)
     harness = ReplayHarness(trace, algorithm="ElasticTiresias",
                             topology=topology,
                             rate_limit_seconds=RATE_LIMIT_SECONDS,

@@ -110,18 +110,14 @@ def test_bench_scenario_meets_targets():
     from vodascheduler_tpu.placement import PoolTopology
     from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
 
+    from vodascheduler_tpu.replay.simulator import config5_preemptions
+
     trace = philly_like_trace(num_jobs=64, seed=20260729)
     topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
-    names = [topo.host_name(c) for c in topo.host_coords()]
-    pre = [PreemptionEvent(at_seconds=4000.0, host=names[3]),
-           PreemptionEvent(at_seconds=4600.0, host=names[7]),
-           PreemptionEvent(at_seconds=9000.0, host=names[3], add=True,
-                           chips=topo.chips_per_host),
-           PreemptionEvent(at_seconds=12000.0, host=names[7], add=True,
-                           chips=topo.chips_per_host)]
     h = ReplayHarness(trace, algorithm="ElasticTiresias", topology=topo,
                       rate_limit_seconds=20.0, scale_out_hysteresis=1.5,
-                      resize_cooldown_seconds=60.0, preemptions=pre)
+                      resize_cooldown_seconds=60.0,
+                      preemptions=config5_preemptions(topo))
     r = h.run()
     assert r.completed == 64
     assert r.failed == 0, r                       # preemption kills no job
@@ -130,3 +126,18 @@ def test_bench_scenario_meets_targets():
     assert r.steady_state_seconds > 0.5 * r.makespan_seconds, r
     assert r.restarts_total <= 280, r
     assert r.attainable_utilization >= 0.88, r
+
+
+def test_algorithm_compare_runs_all_registered():
+    """The per-algorithm comparison harness (replay/compare.py) replays
+    the same trace under every registered algorithm and reports
+    completed == num_jobs for the two families it samples here (full
+    8-way runs live in doc/benchmarks.md; this keeps the module wired)."""
+    from vodascheduler_tpu.replay.compare import as_rows, compare_algorithms
+
+    reports = compare_algorithms(num_jobs=8, seed=7,
+                                 algorithms=("FIFO", "ElasticTiresias"))
+    rows = as_rows(reports)
+    assert [r["algorithm"] for r in rows] == ["FIFO", "ElasticTiresias"]
+    assert all(r["completed"] == 8 and r["failed"] == 0 for r in rows)
+    assert all(r["avg_jct_s"] > 0 for r in rows)

@@ -66,6 +66,22 @@ class PreemptionEvent:
     chips: int = 0
 
 
+def config5_preemptions(topology) -> list:
+    """BASELINE config 5's spot-preemption schedule: two hosts reclaimed
+    mid-trace, returned later. The single definition shared by bench.py,
+    replay/compare.py, and the replay tests — tune it here and every
+    consumer moves together."""
+    names = [topology.host_name(c) for c in topology.host_coords()]
+    return [
+        PreemptionEvent(at_seconds=4000.0, host=names[3]),
+        PreemptionEvent(at_seconds=4600.0, host=names[7]),
+        PreemptionEvent(at_seconds=9000.0, host=names[3], add=True,
+                        chips=topology.chips_per_host),
+        PreemptionEvent(at_seconds=12000.0, host=names[7], add=True,
+                        chips=topology.chips_per_host),
+    ]
+
+
 class ReplayHarness:
     def __init__(
         self,
