@@ -51,3 +51,43 @@ class TestApisDoc:
         for route in ("/training", "/algorithm", "/ratelimit",
                       "/allocation", "/metrics"):
             assert route in doc and route in rest
+
+
+def test_helm_chart_values_references_resolve():
+    """deploy/helm/voda-tpu (reference parity: helm/voda-scheduler):
+    Chart/values parse, and every `.Values.<path>` referenced by a
+    template exists in values.yaml — the typo class a chart without CI
+    rendering would otherwise ship."""
+    import glob
+
+    import yaml
+
+    root = os.path.join(REPO, "deploy", "helm", "voda-tpu")
+    chart = yaml.safe_load(open(os.path.join(root, "Chart.yaml")))
+    assert chart["name"] == "voda-tpu" and chart["version"]
+    values = yaml.safe_load(open(os.path.join(root, "values.yaml")))
+
+    def resolve(path):
+        node = values
+        for key in path.split("."):
+            if isinstance(node, list):
+                node = node[0]
+            if not isinstance(node, dict) or key not in node:
+                return False
+            node = node[key]
+        return True
+
+    templates = glob.glob(os.path.join(root, "templates", "*.yaml"))
+    assert len(templates) >= 4
+    refs = set()
+    for t in templates:
+        src = open(t).read()
+        refs |= set(re.findall(r"\.Values\.([A-Za-z0-9_.]+)", src))
+        # Range-scoped pool fields resolve against the pools entry shape.
+        # Pattern tolerates any spacing/casing ({{.name}}, {{ .maxChips }});
+        # `$.Values` refs are excluded by the missing-$ lookbehind context.
+        for field in re.findall(r"{{-?\s*\.([A-Za-z0-9_]+)\s*-?}}", src):
+            assert field in values["pools"][0], field
+    assert refs, "no .Values references found"
+    for ref in sorted(refs):
+        assert resolve(ref), f".Values.{ref} missing from values.yaml"
