@@ -1,0 +1,27 @@
+"""vodascheduler_tpu — a TPU-native elastic-training scheduling framework.
+
+A brand-new framework with the capabilities of heyfey/vodascheduler,
+re-designed for TPU pods: a job-admission service and CLI, a rescheduling
+control loop with eight pluggable allocation algorithms, a speedup-curve
+metrics feedback loop, ICI-topology-aware placement with worker migration,
+and a JAX (pjit/GSPMD) elastic training runtime in which resize is
+checkpoint → reshard → restore rather than a live allreduce-ring rebuild.
+
+Layer map (mirrors SURVEY.md §1, re-imagined for TPU):
+
+    cli/          `voda-tpu` command line        (reference: cmd/)
+    service/      job admission REST API         (reference: pkg/service)
+    scheduler/    per-pool rescheduling loop     (reference: pkg/scheduler)
+    allocator/    resource-allocation service    (reference: pkg/allocator)
+    algorithms/   the 8 scheduling algorithms    (reference: pkg/algorithm)
+    placement/    ICI-aware placement manager    (reference: pkg/placement)
+    common/       job model, clock, store, bus   (reference: pkg/common)
+    metricscollector/  speedup-curve feedback    (reference: python/metrics_collector)
+    cluster/      TPU cluster backends (fake/local)   (reference: k8s + MPI-Operator)
+    runtime/      JAX elastic trainer + supervisor    (reference: Elastic Horovod scripts)
+    parallel/     meshes, shardings, ring attention   (new: TPU-first)
+    models/       flax model zoo for the baseline configs
+    replay/       Philly-style trace replay harness
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""ElasticSRJF: SRJF base + round-robin distribution of leftovers.
+
+Reference: pkg/algorithm/elastic_srjf.go:25-72.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from vodascheduler_tpu.algorithms.base import (
+    SchedulerAlgorithm,
+    allocate_minimums,
+    distribute_leftover,
+    validate_result,
+)
+from vodascheduler_tpu.algorithms.srjf import remaining_seconds
+from vodascheduler_tpu.common.job import TrainingJob
+from vodascheduler_tpu.common.types import ScheduleResult
+
+
+class ElasticSRJF(SchedulerAlgorithm):
+    name = "ElasticSRJF"
+    elastic = True
+
+    def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        result: ScheduleResult = {}
+        ordered = sorted(jobs, key=remaining_seconds)
+        free = allocate_minimums(ordered, result, total_chips)
+        distribute_leftover(ordered, result, free)
+        validate_result(total_chips, result, jobs)
+        return result
+
+    @property
+    def needs_job_info(self) -> bool:
+        return True

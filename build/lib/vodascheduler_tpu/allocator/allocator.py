@@ -1,0 +1,139 @@
+"""The resource allocator.
+
+Reference counterpart: pkg/allocator/allocator/resource_allocator.go —
+`allocateResource` (:76) builds the algorithm from the factory, fetches
+job_info docs from Mongo when `NeedJobInfo()` (:115, getJobsInfo), runs
+`Schedule`, and returns the {job: count} map.
+
+Info-attachment policy (getJobsInfo semantics + the admission service's
+category seeding, handlers.go:180-206): exact job doc if present, else the
+newest doc of the job's category (repeat workloads inherit learned curves),
+else the linear-speedup base prior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from vodascheduler_tpu.algorithms import new_algorithm
+from vodascheduler_tpu.algorithms.base import validate_result
+from vodascheduler_tpu.common.job import TrainingJob, base_job_info
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import ScheduleResult
+from vodascheduler_tpu.placement.topology import (
+    PoolTopology,
+    is_feasible_count,
+    next_feasible_above,
+    round_to_feasible,
+)
+
+
+@dataclasses.dataclass
+class AllocationRequest:
+    """Reference: AllocationRequest (pkg/allocator/allocator/types.go:5-10).
+
+    TPU delta: the optional `topology` turns chip counts into slice-shape
+    commitments — the allocator's grants are rounded to counts that admit
+    a contiguous sub-torus (SURVEY.md §7 "allocation unit" delta; the
+    reference's GPUs are fungible so utils.go:18-42 never needed this).
+    """
+
+    scheduler_id: str
+    num_chips: int
+    algorithm: str
+    ready_jobs: List[TrainingJob]
+    topology: Optional[PoolTopology] = None
+
+
+def enforce_feasibility(result: ScheduleResult, jobs: List[TrainingJob],
+                        total_chips: int,
+                        topology: PoolTopology) -> ScheduleResult:
+    """Round every grant to the slice-shape-feasible count *nearest* it.
+
+    Algorithms reason in fungible chip counts (their speedup curves are
+    keyed by count); this post-pass maps each grant onto the pool's torus
+    with minimal distortion: an infeasible grant moves down to the largest
+    feasible count below it, or — when capacity allows and the rounded
+    count would violate the job's min — up to the smallest feasible count
+    above it. A grant is never moved past its nearest feasible neighbors:
+    chips an algorithm deliberately left free (e.g. ElasticTiresias's
+    zero-marginal-gain stop) stay free, because every grant change is a
+    checkpoint-restart of the receiving job. Jobs whose min cannot be met
+    feasibly within spare capacity are zeroed (min-or-nothing, as in
+    allocate_minimums). Never exceeds capacity or a job's max.
+    """
+    bounds = {j.name: (j.config.min_num_chips, j.config.max_num_chips)
+              for j in jobs}
+    out: ScheduleResult = {}
+    for job, n in result.items():
+        lo, _hi = bounds.get(job, (0, n))
+        f = round_to_feasible(n, topology)
+        out[job] = f if f >= max(lo, 1) else 0
+    free = max(0, total_chips) - sum(out.values())
+
+    # Second pass, largest rounding loss first: move each distorted grant
+    # up to its ceiling — the smallest feasible count >= the original
+    # grant — when spare capacity covers the difference. This both rescues
+    # min-violating roundings (grant 6, min 5 -> 8) and recovers chips the
+    # rounding stranded (7 -> 4 becomes 7 -> 8 when free), while a grant
+    # that was already feasible is its own ceiling and never inflates.
+    by_loss = sorted(result.items(),
+                     key=lambda kv: kv[1] - out.get(kv[0], 0), reverse=True)
+    for job, n in by_loss:
+        if n <= 0 or out[job] == n:
+            continue
+        lo, hi = bounds.get(job, (0, n))
+        ceiling = n if is_feasible_count(n, topology) else \
+            next_feasible_above(n, topology)
+        if ceiling is None or ceiling > hi:
+            continue
+        cost = ceiling - out[job]
+        if 0 < cost <= free:
+            out[job] = ceiling
+            free -= cost
+    return out
+
+
+class ResourceAllocator:
+    def __init__(self, store: JobStore, registry: Optional[Registry] = None):
+        self.store = store
+        registry = registry or Registry()
+        # Reference metric names: pkg/allocator/allocator/metrics.go.
+        self.m_requests = registry.counter(
+            "voda_allocator_allocation_requests_total",
+            "Total allocation requests served", ("algorithm",))
+        self.m_algo_seconds = registry.summary(
+            "voda_allocator_algorithm_duration_seconds",
+            "Scheduling algorithm run time", ("algorithm",))
+        self.m_info_seconds = registry.summary(
+            "voda_allocator_jobinfo_fetch_duration_seconds",
+            "Job info fetch time", ("algorithm",))
+
+    def allocate(self, request: AllocationRequest) -> ScheduleResult:
+        algo = new_algorithm(request.algorithm, request.scheduler_id)
+        self.m_requests.inc(algorithm=algo.name)
+        if algo.needs_job_info:
+            t0 = time.monotonic()
+            self._attach_job_info(request.ready_jobs)
+            self.m_info_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
+        t0 = time.monotonic()
+        result = algo.schedule(request.ready_jobs, request.num_chips)
+        if request.topology is not None:
+            result = enforce_feasibility(result, request.ready_jobs,
+                                         request.num_chips, request.topology)
+            validate_result(request.num_chips, result, request.ready_jobs,
+                            topology=request.topology)
+        self.m_algo_seconds.observe(time.monotonic() - t0, algorithm=algo.name)
+        return result
+
+    def _attach_job_info(self, jobs: List[TrainingJob]) -> None:
+        for job in jobs:
+            info = self.store.get_job_info(job.name)
+            if info is None:
+                info = self.store.find_category_info(job.category)
+            if info is None:
+                info = base_job_info(job.name, job.category, job.pool)
+            job.info = info

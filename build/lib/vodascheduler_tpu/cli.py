@@ -1,0 +1,127 @@
+"""`voda` CLI: submit, delete, and inspect training jobs over REST.
+
+Reference counterpart: cmd/ (urfave/cli app, cmd/main.go:19-49 +
+cmd/cmd/cmd.go:17-101): `voda create -f job.yaml`, `voda delete <job>`,
+`voda get jobs`. The reference hardcodes the service IP at compile time
+(config.go); here `--server` / VODA_SERVER override localhost.
+
+Usage:
+  python -m vodascheduler_tpu.cli create -f job.yaml
+  python -m vodascheduler_tpu.cli delete <job-name>
+  python -m vodascheduler_tpu.cli get jobs
+  python -m vodascheduler_tpu.cli get status      # scheduler's table
+  python -m vodascheduler_tpu.cli algorithm <name>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from vodascheduler_tpu import config
+
+
+def _request(url: str, method: str = "GET", body: Optional[bytes] = None,
+             content_type: str = "application/json"):
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers={"Content-Type": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            data = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        raise SystemExit(f"error: {e.code} {detail.strip()}")
+    except urllib.error.URLError as e:
+        raise SystemExit(f"error: cannot reach {url}: {e.reason} "
+                         "(is the server running? python -m vodascheduler_tpu.service)")
+    try:
+        return json.loads(data)
+    except json.JSONDecodeError:
+        return data
+
+
+def _print_table(rows, columns) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="voda", description=__doc__)
+    parser.add_argument("--server",
+                        default=os.environ.get(
+                            "VODA_SERVER",
+                            f"http://{config.SERVICE_HOST}:{config.SERVICE_PORT}"),
+                        help="training-service base URL")
+    parser.add_argument("--scheduler-server",
+                        default=os.environ.get(
+                            "VODA_SCHEDULER_SERVER",
+                            f"http://{config.SERVICE_HOST}:{config.SCHEDULER_PORT}"),
+                        help="scheduler base URL (get status / algorithm / ratelimit)")
+    parser.add_argument("--pool", default=os.environ.get("VODA_POOL"),
+                        help="target pool on a multi-pool control plane "
+                             "(scheduler commands)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_create = sub.add_parser("create", help="submit a training job")
+    p_create.add_argument("-f", "--filename", required=True,
+                          help="job spec YAML/JSON")
+
+    p_delete = sub.add_parser("delete", help="delete a training job")
+    p_delete.add_argument("name")
+
+    p_get = sub.add_parser("get", help="list jobs / scheduler status")
+    p_get.add_argument("what", choices=["jobs", "status"])
+
+    p_algo = sub.add_parser("algorithm", help="switch scheduling algorithm")
+    p_algo.add_argument("name")
+
+    p_rate = sub.add_parser("ratelimit", help="set resched rate limit")
+    p_rate.add_argument("seconds", type=float)
+
+    args = parser.parse_args(argv)
+    from urllib.parse import quote as _q
+    pool_q = f"?pool={_q(args.pool, safe='')}" if args.pool else ""
+
+    if args.command == "create":
+        with open(args.filename, "rb") as f:
+            body = f.read()
+        out = _request(f"{args.server}/training", "POST", body,
+                       content_type="application/yaml")
+        print(f"job created: {out['name']}")
+    elif args.command == "delete":
+        from urllib.parse import quote
+        out = _request(f"{args.server}/training?name={quote(args.name, safe='')}",
+                       "DELETE")
+        print(f"job deleted: {out['deleted']}")
+    elif args.command == "get" and args.what == "jobs":
+        rows = _request(f"{args.server}/training")
+        _print_table(rows, ["name", "pool", "status", "priority"])
+    elif args.command == "get" and args.what == "status":
+        rows = _request(f"{args.scheduler_server}/training{pool_q}")
+        _print_table(rows, ["name", "status", "chips", "priority",
+                            "running_seconds", "waiting_seconds",
+                            "chip_seconds"])
+    elif args.command == "algorithm":
+        out = _request(f"{args.scheduler_server}/algorithm{pool_q}", "PUT",
+                       json.dumps({"algorithm": args.name}).encode())
+        print(f"algorithm set: {out['algorithm']}")
+    elif args.command == "ratelimit":
+        out = _request(f"{args.scheduler_server}/ratelimit{pool_q}", "PUT",
+                       json.dumps({"seconds": args.seconds}).encode())
+        print(f"rate limit set: {out['seconds']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

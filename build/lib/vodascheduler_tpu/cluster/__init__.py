@@ -1,0 +1,22 @@
+"""Cluster backends: how the scheduler actually runs jobs on TPU hosts.
+
+The reference delegates execution to Kubernetes + the Kubeflow MPI-Operator
+(create/scale/delete MPIJob CRDs and let the controller manage pods). This
+framework owns its execution substrate behind the `ClusterBackend`
+interface:
+
+- `fake.FakeClusterBackend`: hermetic simulated cluster driven by a
+  VirtualClock — the testing substrate the reference never finished
+  (SURVEY.md §4: fake clientsets in an empty test stub), and the engine of
+  trace replay.
+- `local.LocalBackend`: real JAX trainer processes (runtime/supervisor.py)
+  on the local machine's TPU chips.
+- `multihost.MultiHostBackend`: one supervisor process per host with a
+  backend-issued jax.distributed coordinator — the multi-host execution
+  substrate (hermetic multi-process CPU emulation of a TPU pod).
+"""
+
+from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent
+from vodascheduler_tpu.cluster.gke import GkeBackend, InClusterKube
+from vodascheduler_tpu.cluster.local import LocalBackend
+from vodascheduler_tpu.cluster.multihost import MultiHostBackend

@@ -1,0 +1,90 @@
+"""ClusterBackend: the interface between the scheduler and job execution.
+
+Reference counterpart: the scheduler's k8s surface — MPIJob create/update/
+delete (scheduler.go:495-612) plus the informer event stream (node and
+MPIJob watchers, scheduler.go:169-242). The backend absorbs both directions:
+the scheduler calls start/scale/stop, and the backend reports job and host
+events back through a callback.
+
+On TPU, "scale" is not an in-place ring rebuild: the backend's contract is
+that scale_job(job, n) checkpoint-restarts the job's worker processes at
+the new size (runtime/supervisor.py for the real one; the fake backend
+models the restart cost).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from vodascheduler_tpu.common.job import JobSpec
+
+
+class ClusterEventKind(str, enum.Enum):
+    JOB_COMPLETED = "job_completed"
+    JOB_FAILED = "job_failed"
+    HOST_ADDED = "host_added"
+    HOST_REMOVED = "host_removed"
+
+
+@dataclasses.dataclass
+class ClusterEvent:
+    kind: ClusterEventKind
+    name: str                 # job or host name
+    detail: str = ""
+    timestamp: float = 0.0
+
+
+@dataclasses.dataclass
+class JobHandle:
+    """Backend's view of a running job."""
+
+    name: str
+    num_workers: int
+    placements: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+class ClusterBackend(abc.ABC):
+    """What the scheduler needs from an execution substrate."""
+
+    @abc.abstractmethod
+    def list_hosts(self) -> Dict[str, int]:
+        """host name -> chip count for every live host in the pool."""
+
+    @abc.abstractmethod
+    def start_job(self, spec: JobSpec, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        """Launch the job's workers (reference: create MPIJob :495)."""
+
+    @abc.abstractmethod
+    def scale_job(self, name: str, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        """Resize a running job — checkpoint-restart at the new size
+        (reference: update MPIJob Worker.Replicas :542)."""
+
+    @abc.abstractmethod
+    def stop_job(self, name: str) -> None:
+        """Halt the job, preserving its checkpoint (reference: delete MPIJob
+        :576 — training state survives in the shared PVC)."""
+
+    @abc.abstractmethod
+    def migrate_workers(self, name: str,
+                        placements: List[Tuple[str, int]]) -> None:
+        """Re-place a running job's workers without changing its size
+        (reference: placement manager deleting moved pods :622)."""
+
+    @abc.abstractmethod
+    def running_jobs(self) -> Dict[str, JobHandle]:
+        """Live jobs as the backend sees them (crash-resume source;
+        reference: listing MPIJobs on restart, scheduler.go:1019)."""
+
+    def set_event_callback(self, cb: Callable[[ClusterEvent], None]) -> None:
+        """Register the scheduler's event sink (informer analog)."""
+        self._event_cb = cb
+
+    def emit(self, event: ClusterEvent) -> None:
+        cb = getattr(self, "_event_cb", None)
+        if cb is not None:
+            cb(event)

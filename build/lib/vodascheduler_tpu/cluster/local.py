@@ -1,0 +1,220 @@
+"""LocalBackend: real training processes under scheduler control.
+
+Reference counterpart: the MPI-Operator execution substrate — the scheduler
+edits MPIJob specs and the operator launches/kills worker pods
+(SURVEY.md §1 "execution substrate"). Here the framework owns its runtime
+(SURVEY.md §7: "no MPI-Operator dependency"): each job is a supervisor
+subprocess (runtime/supervisor.py) training a JAX GSPMD program.
+
+Resize/halt/migrate all take the same path — SIGTERM (supervisor
+checkpoints and exits with PREEMPTED_EXIT_CODE), then for resize a fresh
+process at the new chip count restores with resharding. That is the
+TPU-native shape of the reference's kill-pod-and-let-it-recover design
+(doc/design/placement-management.md:31-33).
+
+Hermetic by default off: pass hermetic_devices=N to give every job an
+N-device virtual CPU mesh (tests, machines without TPU); otherwise jobs
+see the real TPU chips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from vodascheduler_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterEvent,
+    ClusterEventKind,
+    JobHandle,
+)
+from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+
+class _Proc:
+    def __init__(self, popen: subprocess.Popen, num_chips: int):
+        self.popen = popen
+        self.num_chips = num_chips
+        self.expected_stop = False
+
+
+class LocalBackend(ClusterBackend):
+    def __init__(self, workdir: str, chips: Optional[int] = None,
+                 hermetic_devices: Optional[int] = None,
+                 metrics_dir: Optional[str] = None,
+                 host_name: str = "localhost",
+                 stop_grace_seconds: float = 120.0,
+                 poll_interval_seconds: float = 0.2,
+                 topology: Optional[object] = None):
+        self.workdir = os.path.abspath(workdir)
+        self.metrics_dir = metrics_dir or os.path.join(self.workdir, "metrics")
+        self.hermetic_devices = hermetic_devices
+        self.host_name = host_name
+        # Pool topology (placement.topology.PoolTopology) handed to every
+        # supervisor via VODA_TOPOLOGY so plan_mesh keeps tp intra-host on
+        # this pool's real host block (VERDICT r2 item 5).
+        self.topology = topology
+        self.stop_grace_seconds = stop_grace_seconds
+        self.poll_interval_seconds = poll_interval_seconds
+        if chips is None:
+            chips = hermetic_devices or self._detect_chips()
+        self.chips = chips
+        os.makedirs(self.workdir, exist_ok=True)
+        os.makedirs(self.metrics_dir, exist_ok=True)
+        self._procs: Dict[str, _Proc] = {}
+        self._specs: Dict[str, JobSpec] = {}
+        self._lock = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+
+    @staticmethod
+    def _detect_chips() -> int:
+        import jax
+        return len(jax.devices())
+
+    # ---- ClusterBackend interface ----------------------------------------
+
+    def list_hosts(self) -> Dict[str, int]:
+        return {self.host_name: self.chips}
+
+    def start_job(self, spec: JobSpec, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        with self._lock:
+            if spec.name in self._procs:
+                raise RuntimeError(f"job {spec.name!r} already running")
+            self._specs[spec.name] = spec
+            self._spawn_locked(spec, num_workers)
+        self._ensure_monitor()
+
+    def scale_job(self, name: str, num_workers: int,
+                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        """Checkpoint-restart at the new size (reference: edit
+        Worker.Replicas and let Horovod re-form, scheduler.go:542)."""
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown job {name!r}")
+        self._stop_proc(name)
+        with self._lock:
+            self._spawn_locked(spec, num_workers)
+        self._ensure_monitor()
+
+    def stop_job(self, name: str) -> None:
+        self._stop_proc(name)
+        with self._lock:
+            self._specs.pop(name, None)
+
+    def migrate_workers(self, name: str,
+                        placements: List[Tuple[str, int]]) -> None:
+        # Single-host: a re-placement is a same-size checkpoint-restart.
+        proc = self._procs.get(name)
+        if proc is not None:
+            self.scale_job(name, proc.num_chips, placements)
+
+    def running_jobs(self) -> Dict[str, JobHandle]:
+        with self._lock:
+            return {
+                name: JobHandle(name=name, num_workers=p.num_chips,
+                                placements=[(self.host_name, p.num_chips)])
+                for name, p in self._procs.items()
+            }
+
+    # ---- process management ----------------------------------------------
+
+    def _job_dir(self, name: str) -> str:
+        return os.path.join(self.workdir, name)
+
+    def _spawn_locked(self, spec: JobSpec, num_chips: int) -> None:
+        job_dir = self._job_dir(spec.name)
+        os.makedirs(job_dir, exist_ok=True)
+        with open(os.path.join(job_dir, "spec.json"), "w") as f:
+            json.dump(spec.to_dict(), f)
+        env = dict(os.environ)
+        if self.hermetic_devices:
+            # The virtual mesh must cover the job's chip count, whatever
+            # the configured floor is.
+            env["VODA_FORCE_CPU_DEVICES"] = str(
+                max(self.hermetic_devices, num_chips))
+        if self.topology is not None:
+            env["VODA_TOPOLOGY"] = str(self.topology)
+        cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.supervisor",
+               "--workdir", job_dir, "--num-chips", str(num_chips),
+               "--metrics-dir", self.metrics_dir]
+        log_path = os.path.join(job_dir, "supervisor.log")
+        log_f = open(log_path, "a")
+        popen = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f,
+                                 start_new_session=True)
+        log_f.close()
+        self._procs[spec.name] = _Proc(popen, num_chips)
+
+    def _stop_proc(self, name: str) -> None:
+        with self._lock:
+            proc = self._procs.get(name)
+            if proc is None:
+                return
+            proc.expected_stop = True
+        if proc.popen.poll() is None:
+            proc.popen.send_signal(signal.SIGTERM)
+            try:
+                proc.popen.wait(timeout=self.stop_grace_seconds)
+            except subprocess.TimeoutExpired:
+                proc.popen.kill()
+                proc.popen.wait()
+        with self._lock:
+            self._procs.pop(name, None)
+
+    def _ensure_monitor(self) -> None:
+        with self._lock:
+            if self._monitor is None or not self._monitor.is_alive():
+                self._monitor = threading.Thread(target=self._monitor_loop,
+                                                 daemon=True)
+                self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._closed.is_set():
+            exited: List[Tuple[str, int]] = []
+            with self._lock:
+                for name, proc in list(self._procs.items()):
+                    code = proc.popen.poll()
+                    if code is None or proc.expected_stop:
+                        continue
+                    self._procs.pop(name)
+                    exited.append((name, code))
+            for name, code in exited:
+                if code == 0:
+                    self._specs.pop(name, None)
+                    self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED,
+                                           name, timestamp=time.time()))
+                else:
+                    # Includes a PREEMPTED exit the backend did not request
+                    # (external SIGTERM): surface it rather than stranding
+                    # a job the scheduler still believes is running.
+                    self._specs.pop(name, None)
+                    detail = (f"preempted outside scheduler control "
+                              f"(exit code {code})"
+                              if code == PREEMPTED_EXIT_CODE
+                              else f"exit code {code}")
+                    self.emit(ClusterEvent(
+                        ClusterEventKind.JOB_FAILED, name,
+                        detail=detail, timestamp=time.time()))
+            with self._lock:
+                # Idle-exit decided under the same lock that registers new
+                # processes, so a job started after the poll above cannot be
+                # orphaned: either it is visible here (no exit), or it will
+                # find _monitor dead-and-cleared and start a fresh thread.
+                if not self._procs:
+                    self._monitor = None
+                    return
+            time.sleep(self.poll_interval_seconds)
+
+    def close(self) -> None:
+        """Stop all jobs (checkpoints preserved) and the monitor."""
+        self._closed.set()
+        for name in list(self._procs):
+            self._stop_proc(name)

@@ -1,0 +1,100 @@
+"""In-process event bus: per-pool job-lifecycle queues.
+
+Reference counterpart: pkg/common/rabbitmq/rabbitmq.go — one RabbitMQ queue
+per GPU type carrying `{verb, job_name}` messages from the admission service
+to that type's scheduler. In a single control-plane process a broker is pure
+overhead; a thread-safe topic→queue map preserves the decoupling (admission
+never calls the scheduler directly, and publish can be rolled back by a
+compensating delete, handlers.go:119-134) without the network hop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from typing import Callable, Dict, Optional
+
+from vodascheduler_tpu.common.types import EventVerb
+
+
+@dataclasses.dataclass(frozen=True)
+class JobEvent:
+    """Reference: rabbitmq.Msg{Verb, JobName} (rabbitmq.go:15-26)."""
+
+    verb: EventVerb
+    job_name: str
+
+
+class EventBus:
+    """Named queues (one per TPU pool), publish/subscribe.
+
+    Two consumption modes, matching how the reference consumes RabbitMQ:
+    a subscriber callback (the scheduler's readMsgs analog; delivery is
+    synchronous on the publisher's thread — the scheduler's own lock
+    serializes concurrent entry) or explicit polling via get(). Events
+    published before a topic has a subscriber queue up and are drained on
+    subscribe.
+    """
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, "queue.Queue[JobEvent]"] = {}
+        self._subscribers: Dict[str, Callable[[JobEvent], None]] = {}
+        # RLock: the backlog drain in subscribe() delivers while holding the
+        # lock so a concurrent publish cannot jump ahead of older queued
+        # events; reentrant so a subscriber may itself publish.
+        self._lock = threading.RLock()
+
+    def _queue(self, topic: str) -> "queue.Queue[JobEvent]":
+        with self._lock:
+            if topic not in self._queues:
+                self._queues[topic] = queue.Queue()
+            return self._queues[topic]
+
+    def subscribe(self, topic: str, callback: Callable[[JobEvent], None]) -> None:
+        """Register the topic's consumer and drain any events queued before
+        it existed (e.g. jobs admitted while the pool's scheduler was
+        down)."""
+        with self._lock:
+            self._subscribers[topic] = callback
+            q = self._queue(topic)
+            while True:
+                try:
+                    backlog = q.get_nowait()
+                except queue.Empty:
+                    break
+                self._deliver(callback, backlog)
+
+    def publish(self, topic: str, event: JobEvent) -> None:
+        """Hand off an event. Publication succeeds once the event is
+        delivered or queued; subscriber exceptions are contained here (the
+        consumer's failure is not the producer's rollback trigger —
+        admission's rollback fires only when hand-off itself fails)."""
+        with self._lock:
+            sub = self._subscribers.get(topic)
+            if sub is None:
+                self._queue(topic).put(event)
+                return
+        self._deliver(sub, event)
+
+    @staticmethod
+    def _deliver(sub: Callable[[JobEvent], None], event: JobEvent) -> None:
+        try:
+            sub(event)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "event subscriber failed handling %s", event)
+
+    def get(self, topic: str, timeout: Optional[float] = None) -> Optional[JobEvent]:
+        """Pop the next event, or None on timeout / immediately when
+        timeout=0 and the queue is empty."""
+        try:
+            if timeout == 0:
+                return self._queue(topic).get_nowait()
+            return self._queue(topic).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def pending(self, topic: str) -> int:
+        return self._queue(topic).qsize()

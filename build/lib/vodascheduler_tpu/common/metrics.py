@@ -1,0 +1,221 @@
+"""Minimal Prometheus-style metrics registry with text exposition.
+
+Reference counterpart: the prometheus/client_golang series registered across
+scheduler (13+4 placement), allocator (8), and service (7) — catalog in
+doc/prometheus-metrics-exposed.md. This registry provides the same three
+instrument kinds the reference uses (Counter, Gauge/GaugeFunc, Summary) and
+renders the standard text format for a `/metrics` endpoint, without a
+client-library dependency.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@contextlib.contextmanager
+def timed(summary: "Summary", **labels: str):
+    """Observe the wall-clock duration of a block into a Summary."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        summary.observe(time.monotonic() - t0, **labels)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            values = dict(self._values) or {(): 0.0} if not self.label_names else dict(self._values)
+        for key, v in values.items():
+            lines.append(f"{self.name}{_merge_labels(self.const_labels, self.label_names, key)} {v}")
+        return lines
+
+
+class Gauge:
+    """Settable gauge; pass `fn` for a GaugeFunc evaluated at scrape time
+    (the reference uses GaugeFuncs over its locked maps, metrics.go:99+).
+    With `label_names`, one series per label tuple (e.g. per TPU device)."""
+
+    def __init__(self, name: str, help_: str,
+                 fn: Optional[Callable[[], float]] = None,
+                 label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
+        self._fn = fn
+        self._value = 0.0
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, v: float, **labels: str) -> None:
+        if self.label_names:
+            key = tuple(labels.get(n, "") for n in self.label_names)
+            with self._lock:
+                self._values[key] = v
+        else:
+            self._value = v
+
+    def value(self, **labels: str) -> float:
+        if self.label_names:
+            key = tuple(labels.get(n, "") for n in self.label_names)
+            return self._values.get(key, 0.0)
+        return self._fn() if self._fn is not None else self._value
+
+    def clear(self) -> None:
+        """Drop all labeled series (for full-rebuild collectors)."""
+        with self._lock:
+            self._values.clear()
+
+    def set_all(self, values: Dict[Tuple[str, ...], float]) -> None:
+        """Atomically replace every labeled series (keys are label tuples
+        in label_names order) — a concurrent scrape sees either the old
+        or the new complete set, never a partially-cleared one."""
+        with self._lock:
+            self._values = dict(values)
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        if self.label_names:
+            with self._lock:
+                for key, v in self._values.items():
+                    lines.append(
+                        f"{self.name}{_merge_labels(self.const_labels, self.label_names, key)} {v}")
+        else:
+            lines.append(
+                f"{self.name}{_merge_labels(self.const_labels, (), ())} "
+                f"{self.value()}")
+        return lines
+
+
+class Summary:
+    """Count/sum summary (quantile-free, like an untimed reference Summary)."""
+
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = (),
+                 const_labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._count: Dict[Tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._sum[key] = self._sum.get(key, 0.0) + v
+            self._count[key] = self._count.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._count.get(key, 0)
+
+    def mean(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        c = self._count.get(key, 0)
+        return self._sum.get(key, 0.0) / c if c else 0.0
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        with self._lock:
+            for key in self._count:
+                labels = _merge_labels(self.const_labels, self.label_names, key)
+                lines.append(f"{self.name}_sum{labels} {self._sum[key]}")
+                lines.append(f"{self.name}_count{labels} {self._count[key]}")
+        return lines
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+def _merge_labels(const: Dict[str, str], names: Tuple[str, ...],
+                  values: Tuple[str, ...]) -> str:
+    """Const labels (e.g. pool="v5p") prepended to the variable labels —
+    how N pools share one registry without colliding series (the
+    reference runs one process per pool instead)."""
+    all_names = tuple(const.keys()) + names
+    all_values = tuple(const.values()) + values
+    return _fmt_labels(all_names, all_values)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List[object] = []
+
+    def register(self, metric):
+        self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str, labels: Tuple[str, ...] = (),
+                const_labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self.register(Counter(name, help_, labels,
+                                     const_labels=const_labels))
+
+    def gauge(self, name: str, help_: str,
+              fn: Optional[Callable[[], float]] = None,
+              labels: Tuple[str, ...] = (),
+              const_labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self.register(Gauge(name, help_, fn, label_names=labels,
+                                   const_labels=const_labels))
+
+    def summary(self, name: str, help_: str, labels: Tuple[str, ...] = (),
+                const_labels: Optional[Dict[str, str]] = None) -> Summary:
+        return self.register(Summary(name, help_, labels,
+                                     const_labels=const_labels))
+
+    def exposition(self) -> str:
+        # Multi-pool registrations repeat metric names (same name, a
+        # different pool const-label). The text format requires all of a
+        # family's lines as ONE group with a single HELP/TYPE header, so
+        # group collected lines by family name, in first-seen order.
+        headers: Dict[str, List[str]] = {}
+        samples: Dict[str, List[str]] = {}
+        order: List[str] = []
+        for m in self._metrics:
+            name = m.name
+            if name not in samples:
+                order.append(name)
+                headers[name] = []
+                samples[name] = []
+            for line in m.collect():
+                if line.startswith("# "):
+                    if not headers[name] or line not in headers[name]:
+                        if len(headers[name]) < 2:
+                            headers[name].append(line)
+                else:
+                    samples[name].append(line)
+        lines: List[str] = []
+        for name in order:
+            lines.extend(headers[name])
+            lines.extend(samples[name])
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,210 @@
+"""Persistent job store: metadata + learned job-info curves.
+
+Reference counterpart: pkg/common/mongo/mongo.go — MongoDB db `job_metadata`
+(TrainingJob docs, scheduler.go:49-51) and db `job_info` with one collection
+per job *category* holding speedup curves (resource_allocator.go:22,
+handlers.go:175-186).
+
+TPU-native redesign: a single-process framework doesn't need an external
+database for crash consistency — a JSON-file-backed store with atomic
+renames gives the same durability the scheduler's `constructStatusOnRestart`
+path needs (scheduler.go:1009-1072), and an in-memory store serves tests and
+trace replay. Both implement the same interface so the scheduler is agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from vodascheduler_tpu.common.job import JobInfo, JobSpec, TrainingJob, category_of
+from vodascheduler_tpu.common.types import JobKind, JobStatus
+
+
+class JobStore:
+    """In-memory job store. Base class for persistent variants."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, TrainingJob] = {}       # by job name
+        self._infos: Dict[str, Dict[str, JobInfo]] = {}  # category -> job name -> info
+
+    # -- job metadata (reference: job_metadata collection) -------------------
+
+    def insert_job(self, job: TrainingJob) -> None:
+        with self._lock:
+            self._jobs[job.name] = job
+            self._dirty()
+
+    def update_job(self, job: TrainingJob) -> None:
+        self.insert_job(job)
+
+    def get_job(self, name: str) -> Optional[TrainingJob]:
+        with self._lock:
+            return self._jobs.get(name)
+
+    def delete_job(self, name: str) -> None:
+        with self._lock:
+            self._jobs.pop(name, None)
+            self._dirty()
+
+    def list_jobs(self, pool: Optional[str] = None) -> List[TrainingJob]:
+        with self._lock:
+            jobs = list(self._jobs.values())
+        if pool is not None:
+            jobs = [j for j in jobs if j.pool == pool]
+        return jobs
+
+    # -- job info / speedup curves (reference: job_info db) ------------------
+
+    def upsert_job_info(self, info: JobInfo) -> None:
+        with self._lock:
+            self._infos.setdefault(info.category, {})[info.name] = info
+            self._dirty()
+
+    def get_job_info(self, name: str) -> Optional[JobInfo]:
+        with self._lock:
+            return self._infos.get(category_of(name), {}).get(name)
+
+    def find_category_info(self, category: str) -> Optional[JobInfo]:
+        """Any historical info doc in the category — used to seed a new job's
+        curves from past runs of the same workload (handlers.go:180-206)."""
+        with self._lock:
+            docs = self._infos.get(category)
+            if not docs:
+                return None
+            # newest job name sorts last (timestamp suffix)
+            return docs[sorted(docs.keys())[-1]]
+
+    def _dirty(self) -> None:  # persistence hook
+        pass
+
+    def flush(self) -> None:  # persistence hook
+        pass
+
+
+def _job_to_dict(job: TrainingJob) -> dict:
+    d = dataclasses.asdict(job)
+    d["kind"] = job.kind.value
+    d["status"] = job.status.value
+    d["spec"]["kind"] = job.spec.kind.value
+    # inf (MAX_TIME sentinels) would serialize as bare `Infinity`, which is
+    # not valid JSON; clamp to a representable sentinel instead.
+    for key in ("finish_time", "submit_time"):
+        d[key] = _clamp_inf(d[key])
+    m = d["metrics"]
+    for key in ("first_start_time", "last_update_time"):
+        m[key] = _clamp_inf(m[key])
+    return d
+
+
+_INF_SENTINEL = 1e308
+
+
+def _clamp_inf(v: float) -> float:
+    return _INF_SENTINEL if v == float("inf") else v
+
+
+def _job_from_dict(d: dict) -> TrainingJob:
+    from vodascheduler_tpu.common.job import JobConfig, JobMetrics
+
+    spec = JobSpec.from_dict(d["spec"])
+    info = None
+    if d.get("info") is not None:
+        info = _info_from_dict(d["info"])
+    return TrainingJob(
+        name=d["name"], category=d["category"], spec=spec, pool=d["pool"],
+        kind=JobKind(d["kind"]), user=d["user"], priority=d["priority"],
+        status=JobStatus(d["status"]), submit_time=d["submit_time"],
+        finish_time=d["finish_time"], config=JobConfig(**d["config"]),
+        metrics=JobMetrics(**d["metrics"]), info=info,
+    )
+
+
+def _info_to_dict(info: JobInfo) -> dict:
+    d = dataclasses.asdict(info)
+    # JSON keys are strings; mark int-keyed curve dicts for round-trip
+    for k in ("speedup", "efficiency", "epoch_seconds", "step_seconds"):
+        d[k] = {str(n): v for n, v in d[k].items()}
+    return d
+
+
+def _info_from_dict(d: dict) -> JobInfo:
+    d = dict(d)
+    for k in ("speedup", "efficiency", "epoch_seconds", "step_seconds"):
+        d[k] = {int(n): v for n, v in d.get(k, {}).items()}
+    return JobInfo(**d)
+
+
+class FileJobStore(JobStore):
+    """JSON-file-backed store with atomic writes; survives scheduler crashes
+    so `resume=True` can reconstruct state (SURVEY.md §3.6).
+
+    autoflush=True (default) rewrites the file on every mutation — maximum
+    durability, O(total jobs) per write. Trace replay and other bulk
+    writers pass autoflush=False and call flush() at their own batch
+    boundaries (the scheduler flushes after each resched pass)."""
+
+    def __init__(self, path: str, autoflush: bool = True):
+        super().__init__()
+        self._path = path
+        self._loading = False
+        self.autoflush = autoflush
+        self._pending = False
+        if os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        with open(self._path) as f:
+            raw = json.load(f)
+        self._loading = True
+        try:
+            for jd in raw.get("jobs", []):
+                job = _job_from_dict(jd)
+                self._jobs[job.name] = job
+            for idoc in raw.get("infos", []):
+                info = _info_from_dict(idoc)
+                self._infos.setdefault(info.category, {})[info.name] = info
+        finally:
+            self._loading = False
+
+    def _dirty(self) -> None:
+        if self._loading:
+            return
+        if not self.autoflush:
+            self._pending = True
+            return
+        self._write()
+
+    def flush(self) -> None:
+        if self._pending:
+            self._pending = False
+            self._write()
+
+    def _write(self) -> None:
+        raw = {
+            "jobs": [_job_to_dict(j) for j in self._jobs.values()],
+            "infos": [_info_to_dict(i) for docs in self._infos.values()
+                      for i in docs.values()],
+        }
+        payload = json.dumps(raw, allow_nan=False)
+        d = os.path.dirname(self._path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".store-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self._path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+
+# Public serialization aliases (REST allocator wire format, rest.py).
+job_to_dict = _job_to_dict
+job_from_dict = _job_from_dict

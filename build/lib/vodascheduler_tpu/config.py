@@ -1,0 +1,30 @@
+"""Framework-wide configuration constants.
+
+Reference counterpart: config/config.go:3-12 (Version, ports, entry point,
+taint key, namespace). The reference hardcodes a cluster-specific service
+IP at compile time; here everything is overridable via environment
+variables (VODA_*) so one build runs anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+VERSION = "0.1.0"
+
+# Service ports mirror the reference's (service.go:31, scheduler.go:256,
+# resource_allocator.go:41) so probes/scripts translate one-to-one.
+SERVICE_PORT = int(os.environ.get("VODA_SERVICE_PORT", "55587"))
+SCHEDULER_PORT = int(os.environ.get("VODA_SCHEDULER_PORT", "55588"))
+ALLOCATOR_PORT = int(os.environ.get("VODA_ALLOCATOR_PORT", "55589"))
+
+SERVICE_HOST = os.environ.get("VODA_SERVICE_HOST", "127.0.0.1")
+
+ENTRY_POINT = "/training"           # reference: config.go EntryPoint
+
+DEFAULT_POOL = os.environ.get("VODA_DEFAULT_POOL", "default")
+DEFAULT_ALGORITHM = os.environ.get("VODA_DEFAULT_ALGORITHM", "ElasticFIFO")
+
+# Root for job workdirs (checkpoints, metrics CSVs, supervisor logs) — the
+# role of the reference's shared PVCs.
+WORKDIR = os.environ.get("VODA_WORKDIR", os.path.expanduser("~/.voda"))

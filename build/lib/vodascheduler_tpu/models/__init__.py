@@ -1,0 +1,11 @@
+"""Model zoo for the baseline configs (BASELINE.md): ResNet-50, BERT-base,
+ViT-L, Llama-style decoder (flagship), and Mixtral-style MoE — plain flax
+modules, shardable onto any mesh by the parallel/ rules (no in-model
+annotations), in bfloat16 with fp32 accumulators where it matters.
+
+No reference counterpart: Voda schedules opaque user scripts
+(examples/py/, TF2 Keras + Elastic Horovod); this framework ships the
+workloads natively so scheduled jobs are real TPU training jobs.
+"""
+
+from vodascheduler_tpu.models.registry import ModelBundle, get_model, MODEL_REGISTRY

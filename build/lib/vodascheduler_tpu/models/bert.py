@@ -1,0 +1,53 @@
+"""BERT-base encoder for masked-LM training (BASELINE.md config 3)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, EncoderBlock
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_hidden: int = 3072
+    max_seq_len: int = 512
+    dtype: str = "bfloat16"
+
+
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
+                       mlp_hidden=128, max_seq_len=128)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        """tokens [B,S] -> MLM logits [B,S,vocab]."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
+                     param_dtype=jnp.float32, dtype=dtype)(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.dim, name="pos_embed",
+                       param_dtype=jnp.float32, dtype=dtype)(
+                           jnp.arange(S)[None, :].repeat(B, axis=0))
+        x = constrain_batch_activation(x + pos)
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_heads,
+                              head_dim=cfg.dim // cfg.num_heads,
+                              causal=False, rope_base=0.0)
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(attn_cfg, cfg.mlp_hidden, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(name="final_ln", dtype=jnp.float32)(x).astype(dtype)
+        return nn.Dense(cfg.vocab_size, name="lm_head", dtype=dtype,
+                        param_dtype=jnp.float32)(x)

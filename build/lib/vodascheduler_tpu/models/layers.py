@@ -1,0 +1,262 @@
+"""Shared transformer building blocks, TPU-first:
+
+- bfloat16 activations, fp32 norm/softmax accumulators (MXU-friendly)
+- static shapes everywhere; no data-dependent Python control flow
+- GQA attention that can swap in ring attention for sequence-parallel
+  long-context (parallel/ring_attention.py)
+- param layouts chosen so the sharding rules (parallel/sharding.py) map
+  heads/hidden onto `tp` and the complementary axis onto `fsdp`
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vodascheduler_tpu.parallel.ring_attention import reference_attention
+
+Dtype = Any
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm, fp32 accumulation (llama-family norm)."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding. x: [B, S, H, D] (D even)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+@dataclasses.dataclass
+class AttnConfig:
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    causal: bool = True
+    rope_base: float = 10000.0
+
+
+class Attention(nn.Module):
+    """Grouped-query attention; `attn_fn` lets the runtime swap in ring
+    attention when the mesh has an `sp` axis. Pass `context` for
+    cross-attention (keys/values projected from the encoder output)."""
+
+    cfg: AttnConfig
+    attn_fn: Optional[Callable] = None  # (q,k,v)->out, [B,S,H,D] layout
+
+    @nn.compact
+    def __call__(self, x, positions=None, context=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        kv_src = x if context is None else context
+        dense = lambda feats, name: nn.DenseGeneral(
+            features=feats, axis=-1, use_bias=False, name=name,
+            dtype=x.dtype, param_dtype=jnp.float32)
+        q = dense((cfg.num_heads, cfg.head_dim), "q_proj")(x)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k_proj")(kv_src)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v_proj")(kv_src)
+
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        if cfg.rope_base > 0:
+            q = rope(q, positions, cfg.rope_base)
+            if context is None:
+                k = rope(k, positions, cfg.rope_base)
+            else:  # rotate keys by the *encoder* sequence's positions
+                kv_pos = jnp.broadcast_to(
+                    jnp.arange(kv_src.shape[1])[None, :],
+                    (B, kv_src.shape[1]))
+                k = rope(k, kv_pos, cfg.rope_base)
+
+        groups = cfg.num_heads // cfg.num_kv_heads
+        if groups > 1:  # expand kv heads for GQA
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+
+        fn = self.attn_fn
+        if fn is None:
+            fn = lambda q, k, v: reference_attention(q, k, v, causal=cfg.causal)
+        out = fn(q, k, v)  # [B,S,H,D]
+        # Named so remat policies can save the kernel output and skip the
+        # flash-forward re-run in backward (scan_stack REMAT_POLICIES).
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "attn_out")
+
+        out = out.reshape(B, S, cfg.num_heads * cfg.head_dim)
+        return nn.DenseGeneral(features=x.shape[-1], use_bias=False,
+                               name="o_proj", dtype=x.dtype,
+                               param_dtype=jnp.float32)(out)
+
+
+class SwiGLU(nn.Module):
+    """Llama-family gated MLP."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        gate = nn.Dense(self.hidden, use_bias=False, name="gate_proj",
+                        dtype=x.dtype, param_dtype=jnp.float32)(x)
+        up = nn.Dense(self.hidden, use_bias=False, name="up_proj",
+                      dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return nn.Dense(d, use_bias=False, name="down_proj", dtype=x.dtype,
+                        param_dtype=jnp.float32)(nn.silu(gate) * up)
+
+
+class DecoderBlock(nn.Module):
+    """Pre-norm decoder block (llama-style)."""
+
+    attn_cfg: AttnConfig
+    mlp_hidden: int
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        x = x + Attention(self.attn_cfg, attn_fn=self.attn_fn,
+                          name="attn")(RMSNorm(name="attn_norm")(x), positions)
+        x = x + SwiGLU(self.mlp_hidden, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class EncoderBlock(nn.Module):
+    """Pre-norm bidirectional block (BERT/ViT-style): LayerNorm + GELU MLP."""
+
+    attn_cfg: AttnConfig
+    mlp_hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(x.dtype)
+        x = x + Attention(self.attn_cfg, name="attn")(h)
+        h = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(x.dtype)
+        h = nn.Dense(self.mlp_hidden, name="fc1", dtype=x.dtype,
+                     param_dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], name="fc2", dtype=x.dtype,
+                     param_dtype=jnp.float32)(h)
+        return x + h
+
+
+# name -> zero-arg factory returning a jax.checkpoint policy (factories,
+# not policy objects, so importing this module stays jax-config free).
+REMAT_POLICIES = {
+    # Full remat: save only layer boundaries, recompute everything.
+    None: lambda: None,
+    # Save every matmul output; backward recomputes only elementwise ops
+    # (norms/silu/rope). HBM: ~300 MB/layer at B=8 S=2048 D=1024 — buys
+    # back most of full remat's ~1/3 recompute FLOPs.
+    "dots": lambda: jax.checkpoint_policies.dots_saveable,
+    # Save just the attention-kernel output (checkpoint_name "attn_out"
+    # in Attention) — backward skips the flash fwd re-run; ~32 MB/layer.
+    "attn_out": lambda: jax.checkpoint_policies.save_only_these_names(
+        "attn_out"),
+    # Both of the above: the right trade once per-chip activations shrink
+    # (multi-chip fsdp); OOMs the single v5e (doc/benchmarks.md).
+    "dots_attn": lambda: jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out")),
+}
+
+
+def _resolve_remat_policy(name):
+    """Map a config-level policy name to a jax.checkpoint policy fn."""
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; one of {list(REMAT_POLICIES)}")
+    return REMAT_POLICIES[name]()
+
+
+def scan_stack(body_cls, num_layers: int, remat: bool = False,
+               remat_policy: Optional[str] = None,
+               name: str = "layers_scan", **body_kwargs):
+    """nn.scan over a (carry, None) -> (carry, None) layer body module.
+
+    The big-model compile-time shape: XLA compiles ONE layer body instead
+    of an L-times unrolled HLO. Params gain a leading layer axis under
+    `name` — parallel/sharding.py derives scanned-path rules keyed on the
+    "layers_scan" prefix that shift every spec right by one (keep the
+    default name unless you extend the rules). `remat=True` additionally
+    recomputes each layer in the backward (HBM for activations drops to
+    layer boundaries at ~1/3 extra FLOPs) — decoupled from scanning so
+    models that fit comfortably don't pay the recompute. `remat_policy`
+    softens full remat by saving selected intermediates (REMAT_POLICIES);
+    ignored when remat is False.
+
+    Used by models/llama.py and models/mixtral.py; the invocation
+    (variable_axes/split_rngs/metadata_params) lives here once because
+    the sharding-rule contract depends on it.
+    """
+    body = (nn.remat(body_cls, prevent_cse=False,
+                     policy=_resolve_remat_policy(remat_policy))
+            if remat else body_cls)
+    return nn.scan(body,
+                   variable_axes={"params": 0},
+                   split_rngs={"params": True},
+                   length=num_layers,
+                   metadata_params={nn.PARTITION_NAME: None})(
+        name=name, **body_kwargs)
+
+
+def pipelined_lm_forward(cfg, block: nn.Module, num_stages: int,
+                         num_microbatches: int):
+    """Shared pipelined decoder-LM forward/loss for scan_layers families.
+
+    Rebuilds the family's submodules (embed / `block` / final norm /
+    lm_head) and applies them to the matching param subtrees of the
+    scanned module's tree — init/checkpoint/sharding stay on the normal
+    module; only the dataflow changes, with the layer stack run through
+    parallel/pipeline.py. `cfg` needs vocab_size, dim, dtype and
+    remat_layers; `block` is one decoder layer taking [B, S, D].
+    Exposed per family as a `pipeline_loss_fn` class attribute the
+    runtime resolves (runtime/train.py) — train.py stays family-agnostic.
+    """
+    from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+    from vodascheduler_tpu.parallel.pipeline import spmd_pipeline
+    from vodascheduler_tpu.parallel.sharding import (
+        constrain_batch_activation,
+    )
+
+    dtype = jnp.dtype(cfg.dtype)
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
+                     dtype=dtype)
+    norm = RMSNorm()
+
+    def forward(params, tokens, targets=None):
+        x = embed.apply({"params": params["embed"]}, tokens)
+        x = constrain_batch_activation(x)
+        x = spmd_pipeline(
+            lambda p, h: block.apply({"params": p}, h),
+            params["layers_scan"]["block"], x,
+            num_stages=num_stages, num_microbatches=num_microbatches,
+            remat=cfg.remat_layers,
+            remat_policy=getattr(cfg, "remat_policy", None))
+        x = norm.apply({"params": params["final_norm"]}, x)
+        w = params["lm_head_kernel"]
+        if targets is None:
+            return x @ w.astype(dtype)
+        return chunked_softmax_ce(x, w, targets)
+
+    return forward

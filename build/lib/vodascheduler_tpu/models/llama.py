@@ -1,0 +1,162 @@
+"""Llama-style decoder-only LM — the flagship model family.
+
+Covers BASELINE.md config 4 (Llama-3-8B FSDP elastic). Architecture:
+RMSNorm pre-norm, RoPE, GQA, SwiGLU, untied LM head. Long-context variants
+swap ring attention in via `attn_fn` (the runtime builds it from the mesh's
+`sp` axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, DecoderBlock, RMSNorm
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_hidden: int = 14336
+    max_seq_len: int = 8192
+    rope_base: float = 500000.0
+    dtype: str = "bfloat16"
+    # Scan-over-layers: the idiomatic big-model TPU shape — XLA compiles
+    # ONE layer body instead of an L-times unrolled HLO (compile time and
+    # program size drop ~L-fold). Off for tiny test configs where
+    # unrolled compiles instantly and is easier to introspect.
+    scan_layers: bool = False
+    # Per-layer remat (independent of scanning): backward recomputes each
+    # layer from its boundary — activation HBM drops to O(L*S*D) at ~1/3
+    # extra FLOPs. On for models whose activations don't fit (8B); off
+    # for the single-chip bench flagship so measured MFU prices no
+    # recompute.
+    remat_layers: bool = False
+    # Selective remat (models/layers.py REMAT_POLICIES): e.g. "dots_attn"
+    # saves matmul + attention-kernel outputs so backward recomputes only
+    # elementwise ops. None = full remat when remat_layers is on.
+    remat_policy: Optional[str] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+    @property
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.dim * 2  # embed + head
+        per_layer = (self.dim * self.head_dim
+                     * (self.num_heads * 2 + self.num_kv_heads * 2)
+                     + 3 * self.dim * self.mlp_hidden + 2 * self.dim)
+        return embed + self.num_layers * per_layer + self.dim
+
+
+# Llama-3-8B (the baseline config's model)
+LLAMA3_8B = LlamaConfig(scan_layers=True, remat_layers=True)
+# ~350M single-chip config: same architecture scaled so full fp32
+# optimizer state (~12 bytes/param ≈ 4.2 GB) plus activations fits one
+# 16 GB v5e chip — the hardware-bench flagship (bench.py MFU section).
+# remat_layers is ON: without it the scanned stack saves every layer's
+# attention/MLP intermediates for backward (~0.5 GB/layer at B=8 S=2048;
+# 48 GB alone for the XLA path's f32 score matrices) and OOMs the chip —
+# measured, not estimated (r3 hardware run). MFU keeps the standard
+# convention: analytic FLOPs exclude the recompute, so the number prices
+# remat honestly.
+LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
+                         num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048,
+                         scan_layers=True, remat_layers=True)
+# Long-context variant of the bench flagship (seq 8192, batch dropped to
+# keep tokens/step constant): the attention-dominated regime where the
+# flash kernel's O(S²) advantage over the XLA lowering is largest —
+# the measured long-context point (doc/benchmarks.md, SURVEY §5.7).
+LLAMA_350M_8K = dataclasses.replace(LLAMA_350M, max_seq_len=8192)
+# Tiny config for tests / compile checks
+LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
+                         num_kv_heads=2, mlp_hidden=128, max_seq_len=128,
+                         rope_base=10000.0)
+# Tiny scanned variant (tests pin the scan path's training + sharding)
+LLAMA_TINY_SCAN = dataclasses.replace(LLAMA_TINY, scan_layers=True)
+
+
+class _ScanBody(nn.Module):
+    """One decoder layer in scan-carry form: (x, None) -> (x, None)."""
+
+    attn_cfg: "AttnConfig"
+    mlp_hidden: int
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        return DecoderBlock(self.attn_cfg, self.mlp_hidden,
+                            attn_fn=self.attn_fn, name="block")(x), None
+
+
+def pipeline_loss_fn(cfg: LlamaConfig, num_stages: int,
+                     num_microbatches: int) -> Callable:
+    """(params, tokens, targets|None) -> loss | logits, with the decoder
+    stack pipelined over the mesh's `pp` axis — the shared scan_layers
+    pipelined forward (models/layers.py pipelined_lm_forward) over this
+    family's DecoderBlock. Attention runs the XLA path (kernel injection
+    under the stage vmap is future work — the runtime skips flash
+    injection when plan.pp > 1)."""
+    from vodascheduler_tpu.models.layers import pipelined_lm_forward
+    attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                          num_kv_heads=cfg.num_kv_heads,
+                          head_dim=cfg.head_dim, causal=True,
+                          rope_base=cfg.rope_base)
+    return pipelined_lm_forward(cfg, DecoderBlock(attn_cfg, cfg.mlp_hidden),
+                                num_stages, num_microbatches)
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+    attn_fn: Optional[Callable] = None
+
+    # Decoder LM: the runtime may inject a causal kernel (flash / ring)
+    causal_attention = True
+    # Pipeline-capable (runtime/train.py resolves this when plan.pp > 1)
+    pipeline_loss_fn = staticmethod(pipeline_loss_fn)
+
+    @nn.compact
+    def __call__(self, tokens, targets=None):
+        """tokens [B, S] int32 -> logits [B, S, vocab], or — when `targets`
+        [B, S] is given — the mean token cross-entropy WITHOUT materializing
+        full-vocab logits (ops/chunked_ce.py): the lm_head matmul runs
+        per sequence chunk under remat, the framework's fused-loss path."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
+                     param_dtype=jnp.float32, dtype=dtype)(tokens)
+        x = constrain_batch_activation(x)
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_kv_heads,
+                              head_dim=cfg.head_dim, causal=True,
+                              rope_base=cfg.rope_base)
+        if cfg.scan_layers:
+            from vodascheduler_tpu.models.layers import scan_stack
+            x, _ = scan_stack(_ScanBody, cfg.num_layers,
+                              remat=cfg.remat_layers,
+                              remat_policy=cfg.remat_policy,
+                              attn_cfg=attn_cfg,
+                              mlp_hidden=cfg.mlp_hidden,
+                              attn_fn=self.attn_fn)(x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = DecoderBlock(attn_cfg, cfg.mlp_hidden,
+                                 attn_fn=self.attn_fn, name=f"layer_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        # Head weight as an explicit param (not nn.Dense) so the fused
+        # loss can chunk the matmul; the logits path is Dense-equivalent.
+        w = self.param("lm_head_kernel", nn.initializers.lecun_normal(),
+                       (cfg.dim, cfg.vocab_size), jnp.float32)
+        if targets is None:
+            return x @ w.astype(dtype)
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        return chunked_softmax_ce(x, w, targets)

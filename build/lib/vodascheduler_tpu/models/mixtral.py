@@ -1,0 +1,193 @@
+"""Mixtral-style sparse MoE decoder (BASELINE.md config 5's MoE family).
+
+TPU-first MoE, two dispatches behind one MoEBlock:
+
+- "routed" (default): capacity-bounded token routing in the GShard
+  one-hot-matmul formulation (ops/moe_dispatch.py) — each expert
+  computes only its routed tokens (~top_k/E of the FLOPs of dense),
+  all shapes static, and under an `ep`-sharded mesh the dispatch/
+  combine einsums lower to the all_to_all pair GSPMD derives from the
+  shardings. Over-capacity tokens drop (combine weight 0) and ride the
+  residual — the standard top-k MoE contract.
+- "dense": every expert computes every token, weighted by the gates —
+  E/top_k more FLOPs but zero routing machinery; the small-scale
+  fallback and the parity oracle the routed path is tested against
+  (tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, Attention, RMSNorm
+from vodascheduler_tpu.parallel.sharding import constrain_batch_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_hidden: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    rope_base: float = 1000000.0
+    dtype: str = "bfloat16"
+    dispatch: str = "routed"          # "routed" | "gather" | "dense"
+    capacity_factor: float = 1.25     # routed: slots per expert vs even load
+    scan_layers: bool = False         # nn.scan over layers (see llama.py)
+    remat_layers: bool = False        # per-layer remat, decoupled from scan
+    remat_policy: Optional[str] = None  # selective remat (layers.py REMAT_POLICIES)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+
+MIXTRAL_8X7B_LIKE = MixtralConfig(scan_layers=True, remat_layers=True)
+# ~390M-total / ~140M-active single-chip MoE: the hardware-bench MoE
+# flagship (bench.py), sized like LLAMA_350M is for the dense family.
+# The size budget prices the hwbench harness's non-donated state copy
+# (state appears twice during the scanned-step measurement), so fp32
+# AdamW state (~4.6 GB) x2 + routing transients fit one 16 GB v5e.
+# dispatch="gather": the single-chip dispatch — the einsum formulation's
+# one-hot matmuls exceed the expert FLOPs without an ep axis to shard
+# them over (ops/moe_dispatch.py, doc/benchmarks.md).
+MIXTRAL_SMALL = MixtralConfig(dim=640, num_layers=12, num_heads=10,
+                              num_kv_heads=5, mlp_hidden=1792,
+                              num_experts=8, top_k=2, dispatch="gather",
+                              scan_layers=True, remat_layers=True)
+MIXTRAL_TINY = MixtralConfig(vocab_size=256, dim=64, num_layers=2,
+                             num_heads=4, num_kv_heads=2, mlp_hidden=128,
+                             num_experts=4, top_k=2, rope_base=10000.0)
+
+
+class MoEBlock(nn.Module):
+    """Top-k routed SwiGLU experts, dense dispatch over an expert axis."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        logits = nn.Dense(cfg.num_experts, use_bias=False, name="router",
+                          dtype=jnp.float32, param_dtype=jnp.float32)(
+                              x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)             # [B,S,E]
+        from vodascheduler_tpu.ops.moe_dispatch import top_k_gating
+        gate = top_k_gating(probs, cfg.top_k)
+
+        # expert weights stacked on a leading E axis (shardable over ep)
+        E, H = cfg.num_experts, cfg.mlp_hidden
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate_kernel", init, (E, D, H))
+        w_up = self.param("experts_up_kernel", init, (E, D, H))
+        w_down = self.param("experts_down_kernel", init, (E, H, D))
+
+        if cfg.dispatch in ("routed", "gather"):
+            from vodascheduler_tpu.ops.moe_dispatch import (
+                gathered_ffn,
+                routed_ffn,
+            )
+            ffn = routed_ffn if cfg.dispatch == "routed" else gathered_ffn
+            return ffn(x, gate, w_gate, w_up, w_down,
+                       capacity_factor=cfg.capacity_factor,
+                       top_k=cfg.top_k)
+        if cfg.dispatch != "dense":
+            # A typo ("gathered", "scatter", ...) must not silently train
+            # the dense E/top_k-x-FLOPs path.
+            raise ValueError(
+                f"unknown MixtralConfig.dispatch {cfg.dispatch!r}; "
+                "one of 'routed', 'gather', 'dense'")
+
+        xb = x.astype(jnp.bfloat16)
+        h = jnp.einsum("bsd,edh->besh", xb, w_gate.astype(jnp.bfloat16))
+        u = jnp.einsum("bsd,edh->besh", xb, w_up.astype(jnp.bfloat16))
+        y = jnp.einsum("besh,ehd->besd", nn.silu(h) * u,
+                       w_down.astype(jnp.bfloat16))           # [B,E,S,D]
+        out = jnp.einsum("besd,bse->bsd", y.astype(jnp.float32),
+                         gate)
+        return out.astype(x.dtype)
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_kv_heads,
+                              head_dim=cfg.head_dim, causal=True,
+                              rope_base=cfg.rope_base)
+        x = x + Attention(attn_cfg, attn_fn=self.attn_fn,
+                          name="attn")(RMSNorm(name="attn_norm")(x))
+        x = x + MoEBlock(cfg, name="moe")(RMSNorm(name="moe_norm")(x))
+        return x
+
+
+class _ScanBody(nn.Module):
+    """One Mixtral layer in scan-carry form (llama.py pattern)."""
+
+    cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        return MixtralBlock(self.cfg, attn_fn=self.attn_fn,
+                            name="block")(x), None
+
+
+def pipeline_loss_fn(cfg: MixtralConfig, num_stages: int,
+                     num_microbatches: int) -> Callable:
+    """Pipelined Mixtral forward/loss: the shared scan_layers pipelined
+    forward over MixtralBlock — MoE layers pipelined over pp, experts
+    still sharded over ep inside each stage (the pp x ep composition)."""
+    from vodascheduler_tpu.models.layers import pipelined_lm_forward
+    return pipelined_lm_forward(cfg, MixtralBlock(cfg),
+                                num_stages, num_microbatches)
+
+
+class Mixtral(nn.Module):
+    cfg: MixtralConfig
+    attn_fn: Optional[Callable] = None
+
+    # Decoder LM: the runtime may inject a causal kernel (flash / ring)
+    causal_attention = True
+    # Pipeline-capable (runtime/train.py resolves this when plan.pp > 1)
+    pipeline_loss_fn = staticmethod(pipeline_loss_fn)
+
+    @nn.compact
+    def __call__(self, tokens, targets=None):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="embed",
+                     param_dtype=jnp.float32, dtype=dtype)(tokens)
+        x = constrain_batch_activation(x)
+        if cfg.scan_layers:
+            from vodascheduler_tpu.models.layers import scan_stack
+            x, _ = scan_stack(_ScanBody, cfg.num_layers,
+                              remat=cfg.remat_layers,
+                              remat_policy=cfg.remat_policy, cfg=cfg,
+                              attn_fn=self.attn_fn)(x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = MixtralBlock(cfg, attn_fn=self.attn_fn,
+                                 name=f"layer_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        # Fused-loss head, as in llama.py: chunked CE when targets given.
+        w = self.param("lm_head_kernel", nn.initializers.lecun_normal(),
+                       (cfg.dim, cfg.vocab_size), jnp.float32)
+        if targets is None:
+            return x @ w.astype(dtype)
+        from vodascheduler_tpu.ops.chunked_ce import chunked_softmax_ce
+        return chunked_softmax_ce(x, w, targets)

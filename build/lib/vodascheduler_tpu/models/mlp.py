@@ -1,0 +1,31 @@
+"""Tiny MLP classifier — the minimum end-to-end slice workload
+(SURVEY.md §7 stage 2; reference: the Keras MNIST elastic example)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MlpConfig:
+    input_dim: int = 784
+    hidden: int = 256
+    num_classes: int = 10
+    num_layers: int = 2
+
+
+MNIST_MLP = MlpConfig()
+
+
+class Mlp(nn.Module):
+    cfg: MlpConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        for i in range(self.cfg.num_layers):
+            x = nn.relu(nn.Dense(self.cfg.hidden, name=f"dense_{i}")(x))
+        return nn.Dense(self.cfg.num_classes, name="head")(x)

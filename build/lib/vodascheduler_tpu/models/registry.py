@@ -1,0 +1,197 @@
+"""Model registry: name -> everything the runtime needs to train it.
+
+A ModelBundle packages the flax module, a synthetic-batch maker (shape
+contract), the loss, the sharding rules, and a rough parameter scale (for
+plan_mesh). Synthetic data keeps the framework hermetic — the reference's
+examples likewise default to synthetic/auto-downloaded data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from vodascheduler_tpu.models import bert, llama, mixtral, mlp, nmt, resnet, vit
+from vodascheduler_tpu.parallel.sharding import (
+    CONV_RULES,
+    TRANSFORMER_RULES,
+    ShardingRules,
+)
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    name: str
+    module: Any                       # flax nn.Module instance
+    make_batch: Callable[[int, jax.Array], Any]   # (batch_size, rng) -> batch
+    loss_fn: Callable[[Any, Any, Any], jax.Array]  # (apply_fn, params, batch)
+    rules: ShardingRules
+    params_b: float = 0.0             # billions, for plan_mesh
+    seq_len: int = 0
+    num_experts: int = 0
+    has_batch_stats: bool = False     # BatchNorm models carry mutable state
+
+
+def _lm_batch(vocab: int, seq: int):
+    def make(batch_size: int, rng: jax.Array):
+        tokens = jax.random.randint(rng, (batch_size, seq + 1), 0, vocab,
+                                    dtype=jnp.int32)
+        return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+    return make
+
+
+def _lm_loss(apply_fn, params, batch):
+    logits = apply_fn(params, batch["inputs"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["targets"]).mean()
+
+
+def _lm_fused_loss(apply_fn, params, batch):
+    """Loss computed inside the model (chunked CE — ops/chunked_ce.py):
+    full-vocab logits never materialize. For modules whose __call__
+    accepts `targets` (llama, mixtral)."""
+    return apply_fn(params, batch["inputs"], targets=batch["targets"])
+
+
+def _mlm_loss(apply_fn, params, batch):
+    logits = apply_fn(params, batch["inputs"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["targets"]).mean()
+
+
+def _nmt_batch(vocab: int, src_seq: int, tgt_seq: int):
+    def make(batch_size: int, rng: jax.Array):
+        r1, r2 = jax.random.split(rng)
+        src = jax.random.randint(r1, (batch_size, src_seq), 0, vocab,
+                                 dtype=jnp.int32)
+        tgt = jax.random.randint(r2, (batch_size, tgt_seq + 1), 0, vocab,
+                                 dtype=jnp.int32)
+        return {"inputs": {"src": src, "tgt": tgt[:, :-1]},
+                "targets": tgt[:, 1:]}
+    return make
+
+
+def _image_batch(size: int, channels: int, classes: int):
+    def make(batch_size: int, rng: jax.Array):
+        r1, r2 = jax.random.split(rng)
+        return {
+            "images": jax.random.normal(r1, (batch_size, size, size, channels),
+                                        dtype=jnp.float32),
+            "labels": jax.random.randint(r2, (batch_size,), 0, classes,
+                                         dtype=jnp.int32),
+        }
+    return make
+
+
+def _cls_loss(apply_fn, params, batch):
+    logits = apply_fn(params, batch["images"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), batch["labels"]).mean()
+
+
+def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
+    return {
+        "mnist_mlp": lambda: ModelBundle(
+            name="mnist_mlp", module=mlp.Mlp(mlp.MNIST_MLP),
+            make_batch=_image_batch(28, 1, 10),
+            loss_fn=lambda a, p, b: _cls_loss(
+                lambda pp, x: a(pp, x.reshape(x.shape[0], -1)), p, b),
+            rules=CONV_RULES),
+        "resnet50": lambda: ModelBundle(
+            name="resnet50", module=resnet.ResNet(resnet.RESNET50),
+            make_batch=_image_batch(224, 3, 1000), loss_fn=_cls_loss,
+            rules=CONV_RULES, params_b=0.026, has_batch_stats=True),
+        "resnet_tiny": lambda: ModelBundle(
+            name="resnet_tiny", module=resnet.ResNet(resnet.RESNET_TINY),
+            make_batch=_image_batch(32, 3, 10), loss_fn=_cls_loss,
+            rules=CONV_RULES, has_batch_stats=True),
+        "bert_base": lambda: ModelBundle(
+            name="bert_base", module=bert.Bert(bert.BERT_BASE),
+            make_batch=_lm_batch(bert.BERT_BASE.vocab_size, 512),
+            loss_fn=_mlm_loss, rules=TRANSFORMER_RULES, params_b=0.11,
+            seq_len=512),
+        "bert_tiny": lambda: ModelBundle(
+            name="bert_tiny", module=bert.Bert(bert.BERT_TINY),
+            make_batch=_lm_batch(bert.BERT_TINY.vocab_size, 64),
+            loss_fn=_mlm_loss, rules=TRANSFORMER_RULES, seq_len=64),
+        "vit_l16": lambda: ModelBundle(
+            name="vit_l16", module=vit.ViT(vit.VIT_L16),
+            make_batch=_image_batch(224, 3, 1000), loss_fn=_cls_loss,
+            rules=TRANSFORMER_RULES, params_b=0.30),
+        "vit_tiny": lambda: ModelBundle(
+            name="vit_tiny", module=vit.ViT(vit.VIT_TINY),
+            make_batch=_image_batch(32, 3, 10), loss_fn=_cls_loss,
+            rules=TRANSFORMER_RULES),
+        "llama3_8b": lambda: ModelBundle(
+            name="llama3_8b", module=llama.Llama(llama.LLAMA3_8B),
+            make_batch=_lm_batch(llama.LLAMA3_8B.vocab_size, 4096),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=8.0,
+            seq_len=4096),
+        "llama_350m": lambda: ModelBundle(
+            name="llama_350m", module=llama.Llama(llama.LLAMA_350M),
+            make_batch=_lm_batch(llama.LLAMA_350M.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=2048),
+        "llama_350m_8k": lambda: ModelBundle(
+            name="llama_350m_8k",
+            module=llama.Llama(llama.LLAMA_350M_8K),
+            make_batch=_lm_batch(llama.LLAMA_350M_8K.vocab_size, 8192),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=8192),
+        "llama_tiny": lambda: ModelBundle(
+            name="llama_tiny", module=llama.Llama(llama.LLAMA_TINY),
+            make_batch=_lm_batch(llama.LLAMA_TINY.vocab_size, 64),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64),
+        "mixtral_8x7b": lambda: ModelBundle(
+            name="mixtral_8x7b", module=mixtral.Mixtral(mixtral.MIXTRAL_8X7B_LIKE),
+            make_batch=_lm_batch(mixtral.MIXTRAL_8X7B_LIKE.vocab_size, 4096),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=47.0,
+            seq_len=4096, num_experts=8),
+        "nmt_base": lambda: ModelBundle(
+            name="nmt_base",
+            module=nmt.Seq2SeqTransformer(nmt.NMT_BASE),
+            make_batch=_nmt_batch(nmt.NMT_BASE.vocab_size, 256, 256),
+            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, params_b=0.07,
+            seq_len=256),
+        "nmt_tiny": lambda: ModelBundle(
+            name="nmt_tiny",
+            module=nmt.Seq2SeqTransformer(nmt.NMT_TINY),
+            make_batch=_nmt_batch(nmt.NMT_TINY.vocab_size, 32, 32),
+            loss_fn=_lm_loss, rules=TRANSFORMER_RULES, seq_len=32),
+        "mixtral_small": lambda: ModelBundle(
+            name="mixtral_small",
+            module=mixtral.Mixtral(mixtral.MIXTRAL_SMALL),
+            make_batch=_lm_batch(mixtral.MIXTRAL_SMALL.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.39,
+            seq_len=2048, num_experts=8),
+        "mixtral_tiny": lambda: ModelBundle(
+            name="mixtral_tiny", module=mixtral.Mixtral(mixtral.MIXTRAL_TINY),
+            make_batch=_lm_batch(mixtral.MIXTRAL_TINY.vocab_size, 64),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64,
+            num_experts=4),
+    }
+
+
+MODEL_REGISTRY = tuple(sorted(_bundles()))
+
+# Trace/model-family aliases (replay traces use family names).
+_ALIASES = {
+    "bert": "bert_base",
+    "vitl": "vit_l16",
+    "llama8b": "llama3_8b",
+    "mixtral": "mixtral_8x7b",
+    "nmt": "nmt_base",
+    "transformer_nmt": "nmt_base",
+}
+
+
+def get_model(name: str) -> ModelBundle:
+    bundles = _bundles()
+    key = _ALIASES.get(name, name)
+    if key not in bundles:
+        raise ValueError(f"unknown model {name!r}; known: {MODEL_REGISTRY}")
+    return bundles[key]()

@@ -1,0 +1,76 @@
+"""ResNet-50 (BASELINE.md configs 1-3's vision workhorse).
+
+Standard bottleneck-v1.5 ResNet in flax; BatchNorm in fp32, convs in
+bfloat16 (MXU path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+
+
+RESNET50 = ResNetConfig()
+RESNET_TINY = ResNetConfig(stage_sizes=(1, 1, 1, 1), num_classes=10, width=16)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: Tuple[int, int]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.9, name=name,
+                                         dtype=jnp.float32)
+        conv = lambda f, k, s, name: nn.Conv(f, k, s, use_bias=False,
+                                             name=name, dtype=self.dtype,
+                                             param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1), (1, 1), "conv1")(x)
+        y = nn.relu(norm("bn1")(y).astype(self.dtype))
+        y = conv(self.features, (3, 3), self.strides, "conv2")(y)
+        y = nn.relu(norm("bn2")(y).astype(self.dtype))
+        y = conv(self.features * 4, (1, 1), (1, 1), "conv3")(y)
+        y = norm("bn3")(y).astype(self.dtype)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), self.strides,
+                            "conv_proj")(residual)
+            residual = norm("bn_proj")(residual).astype(self.dtype)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = images.astype(dtype)
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), use_bias=False, name="conv_init",
+                    dtype=dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         name="bn_init", dtype=jnp.float32)(x)
+        x = nn.relu(x.astype(dtype))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, num_blocks in enumerate(cfg.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(cfg.width * 2 ** i, strides, dtype,
+                               name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, name="head",
+                        param_dtype=jnp.float32)(x.astype(jnp.float32))

@@ -1,0 +1,56 @@
+"""ViT-L image classifier (BASELINE.md config 5's vision family)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from vodascheduler_tpu.models.layers import AttnConfig, EncoderBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    mlp_hidden: int = 4096
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+
+VIT_L16 = ViTConfig()
+VIT_TINY = ViTConfig(image_size=32, patch_size=8, dim=64, num_layers=2,
+                     num_heads=4, mlp_hidden=128, num_classes=10)
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images [B,H,W,C] -> logits [B,num_classes]."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Conv(cfg.dim, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size), name="patch_embed",
+                    dtype=dtype, param_dtype=jnp.float32)(images.astype(dtype))
+        B, h, w, d = x.shape
+        x = x.reshape(B, h * w, d)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, d))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, d)).astype(dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], d))
+        x = x + pos.astype(dtype)
+        attn_cfg = AttnConfig(num_heads=cfg.num_heads,
+                              num_kv_heads=cfg.num_heads,
+                              head_dim=cfg.dim // cfg.num_heads,
+                              causal=False, rope_base=0.0)
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(attn_cfg, cfg.mlp_hidden, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(name="final_ln", dtype=jnp.float32)(x[:, 0])
+        return nn.Dense(cfg.num_classes, name="head",
+                        param_dtype=jnp.float32)(x.astype(jnp.float32))

@@ -1,0 +1,108 @@
+"""Loader for the C++ resched-hot-path kernels (voda_native.cc).
+
+Builds `_voda_native.so` on demand with g++ (cached beside the source) and
+exposes ctypes wrappers. Every caller keeps a pure-Python fallback — the
+native path is a drop-in accelerator, never a requirement (SURVEY.md §2.9).
+
+Set VODA_NO_NATIVE=1 to force the Python fallbacks (used by parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "voda_native.cc")
+_SO = os.path.join(_HERE, "_voda_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native build failed (falling back to Python): %s", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _load_failed
+    if os.environ.get("VODA_NO_NATIVE"):  # kill-switch beats the cache
+        return None
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        needs_build = (not os.path.exists(_SO)
+                       or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.voda_hungarian_max.argtypes = [
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.voda_hungarian_max.restype = None
+            lib.voda_ffdl_dp.argtypes = [
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32)]
+            lib.voda_ffdl_dp.restype = None
+            _lib = lib
+        except OSError as e:
+            log.debug("native load failed: %s", e)
+            _load_failed = True
+    return _lib
+
+
+def hungarian_max(score: Sequence[Sequence[float]]) -> Optional[List[Tuple[int, int]]]:
+    """Native max-assignment; None if the kernel is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(score)
+    flat = (ctypes.c_double * (n * n))()
+    for i, row in enumerate(score):
+        for j, x in enumerate(row):
+            flat[i * n + j] = float(x)
+    out = (ctypes.c_int32 * n)()
+    lib.voda_hungarian_max(n, flat, out)
+    return [(i, int(out[i])) for i in range(n)]
+
+
+def ffdl_dp(K: int, lo: Sequence[int], hi: Sequence[int],
+            speedup_rows: Sequence[Sequence[float]]) -> Optional[List[int]]:
+    """Native FfDL DP; speedup_rows[j][g] for g in 0..K. None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    J = len(lo)
+    W = K + 1
+    c_lo = (ctypes.c_int32 * J)(*lo)
+    c_hi = (ctypes.c_int32 * J)(*hi)
+    flat = (ctypes.c_double * (J * W))()
+    for j, row in enumerate(speedup_rows):
+        for g in range(W):
+            flat[j * W + g] = float(row[g])
+    out = (ctypes.c_int32 * J)()
+    lib.voda_ffdl_dp(J, K, c_lo, c_hi, flat, out)
+    return [int(out[j]) for j in range(J)]

@@ -1,0 +1,119 @@
+// Native kernels for the rescheduling hot path.
+//
+// Reference context: the reference's only native-algorithm dependency is the
+// external Go munkres library (github.com/heyfey/munkres) used by the
+// placement manager (placement_manager.go:505-512). SURVEY.md §2.9 names the
+// resched hot-path kernels as the natural C++ candidates for this framework:
+// the Hungarian assignment (O(n^3) in hosts) and the FfDL DP knapsack
+// (O(jobs x chips^2)), both called on every rescheduling pass.
+//
+// Contracts mirror the pure-Python implementations exactly
+// (placement/hungarian.py, algorithms/ffdl_optimizer.py), which remain the
+// always-available fallbacks and test oracles.
+//
+// Build: g++ -O2 -shared -fPIC -o _voda_native.so voda_native.cc
+// (vodascheduler_tpu/native/__init__.py builds on demand).
+
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+constexpr double kInf = std::numeric_limits<double>::infinity();
+}
+
+extern "C" {
+
+// Maximum-score perfect assignment on an n x n matrix (row-major).
+// Writes row_to_col[i] = assigned column. Jonker-Volgenant style
+// shortest-augmenting-path with dual potentials on the negated
+// (minimization) form — the same algorithm as hungarian.py::_solve_min.
+void voda_hungarian_max(int32_t n, const double* score, int32_t* row_to_col) {
+  if (n <= 0) return;
+  // cost = -score (maximize -> minimize), 1-indexed internals.
+  std::vector<double> u(n + 1, 0.0), v(n + 1, 0.0);
+  std::vector<int32_t> p(n + 1, 0), way(n + 1, 0);
+
+  for (int32_t i = 1; i <= n; ++i) {
+    p[0] = i;
+    int32_t j0 = 0;
+    std::vector<double> minv(n + 1, kInf);
+    std::vector<bool> used(n + 1, false);
+    do {
+      used[j0] = true;
+      int32_t i0 = p[j0], j1 = -1;
+      double delta = kInf;
+      for (int32_t j = 1; j <= n; ++j) {
+        if (used[j]) continue;
+        double cur = -score[(i0 - 1) * n + (j - 1)] - u[i0] - v[j];
+        if (cur < minv[j]) {
+          minv[j] = cur;
+          way[j] = j0;
+        }
+        if (minv[j] < delta) {
+          delta = minv[j];
+          j1 = j;
+        }
+      }
+      for (int32_t j = 0; j <= n; ++j) {
+        if (used[j]) {
+          u[p[j]] += delta;
+          v[j] -= delta;
+        } else {
+          minv[j] -= delta;
+        }
+      }
+      j0 = j1;
+    } while (p[j0] != 0);
+    while (j0) {  // augment
+      int32_t j1 = way[j0];
+      p[j0] = p[j1];
+      j0 = j1;
+    }
+  }
+  for (int32_t j = 1; j <= n; ++j) {
+    if (p[j]) row_to_col[p[j] - 1] = j - 1;
+  }
+}
+
+// FfDL DP knapsack (ffdl_optimizer.py semantics, including the g=0 inherit
+// case). speedup is J x (K+1) row-major: speedup[j*(K+1)+g] = job j's
+// speedup at g chips. lo/hi are per-job chip bounds. Writes out_alloc[j].
+void voda_ffdl_dp(int32_t J, int32_t K, const int32_t* lo, const int32_t* hi,
+                  const double* speedup, int32_t* out_alloc) {
+  if (J <= 0 || K < 0) return;
+  const int32_t W = K + 1;
+  std::vector<double> P((J + 1) * W, 0.0);
+  std::vector<int32_t> SOL((J + 1) * W, 0);
+
+  for (int32_t j = 1; j <= J; ++j) {
+    const double* sp = speedup + (j - 1) * W;
+    const double* Pprev = P.data() + (j - 1) * W;
+    double* Pcur = P.data() + j * W;
+    int32_t* Scur = SOL.data() + j * W;
+    const int32_t jlo = lo[j - 1];
+    const int32_t jhi = hi[j - 1];
+    for (int32_t k = 0; k <= K; ++k) {
+      double best = Pprev[k];  // g = 0: job unscheduled, inherit
+      int32_t best_g = 0;
+      const int32_t gmax = jhi < k ? jhi : k;
+      for (int32_t g = jlo; g <= gmax; ++g) {
+        const double cand = sp[g] + Pprev[k - g];
+        if (cand > best) {
+          best = cand;
+          best_g = g;
+        }
+      }
+      Pcur[k] = best;
+      Scur[k] = best_g;
+    }
+  }
+
+  int32_t k = K;
+  for (int32_t j = J; j >= 1; --j) {  // backtrack
+    out_alloc[j - 1] = SOL[j * W + k];
+    k -= SOL[j * W + k];
+  }
+}
+
+}  // extern "C"

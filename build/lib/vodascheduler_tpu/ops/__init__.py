@@ -1,0 +1,17 @@
+"""Pallas TPU kernels for the hot ops of the training plane.
+
+The reference delegates its whole training plane to Horovod user scripts
+(SURVEY.md §2.3, examples/py/); the TPU-native framework owns it, and the
+attention inner loop is where the FLOPs and HBM traffic are — hence a
+hand-tiled flash-attention kernel here rather than relying on XLA's
+generic fusion of the O(S²) softmax path.
+"""
+
+from vodascheduler_tpu.ops.flash_attention import (
+    flash_attention,
+    make_flash_attention,
+    make_sp_flash_attention,
+)
+
+__all__ = ["flash_attention", "make_flash_attention",
+           "make_sp_flash_attention"]

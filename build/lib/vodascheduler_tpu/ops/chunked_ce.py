@@ -1,0 +1,64 @@
+"""Chunked softmax cross-entropy: LM loss without full-vocab logits.
+
+The textbook LM loss materializes logits [B, S, V] (and an fp32 copy for
+a stable softmax) — at V=32k, S=2k that is GBs of HBM for activations
+that exist only to be reduced away. Instead, scan the sequence in chunks:
+each chunk runs its own lm_head matmul + cross-entropy and contributes a
+scalar; `jax.checkpoint` on the body drops the chunk logits after the
+forward and recomputes them in the backward. Peak logits memory falls
+from O(S·V) to O(S/C·V) at the cost of one extra head matmul in the
+backward — the classic TPU HBM-for-FLOPs trade (the MXU is idle waiting
+on HBM otherwise).
+
+Reference parity: the reference's training plane delegates losses to
+user Horovod scripts (SURVEY.md §2.3); this op belongs to the TPU-native
+training plane that replaces them. Used by models/llama.py and
+models/mixtral.py when called with `targets`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def chunked_softmax_ce(hidden: jax.Array, head_w: jax.Array,
+                       targets: jax.Array, num_chunks: int = 8) -> jax.Array:
+    """Mean token cross-entropy of `hidden @ head_w` against `targets`.
+
+    hidden  [B, S, D] (bf16 activations)
+    head_w  [D, V]    (fp32 master weights; cast to hidden dtype for the
+                       MXU matmul like the eval-path Dense does)
+    targets [B, S]    int labels
+
+    `num_chunks` is clamped to a divisor of S (1 = unchunked fallback).
+    """
+    B, S, D = hidden.shape
+    c = min(num_chunks, S)
+    while S % c:
+        c -= 1
+    if c <= 1:
+        logits = (hidden @ head_w.astype(hidden.dtype)).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    # [C, B, S/C, ...] so scan's leading axis is the chunk index.
+    hs = hidden.reshape(B, c, S // c, D).swapaxes(0, 1)
+    ts = targets.reshape(B, c, S // c).swapaxes(0, 1)
+
+    # Cast the head once, outside the scan and the checkpoint: inside the
+    # body every chunk would re-read the full fp32 [D, V] and re-write it
+    # bf16 — C fwd + C backward-recompute redundant casts of the largest
+    # single weight in the model.
+    head_b = head_w.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def body(total, chunk):
+        h, t = chunk
+        logits = (h @ head_b).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, t)
+        return total + loss.sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, ts))
+    return total / (B * S)

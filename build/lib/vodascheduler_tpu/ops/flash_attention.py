@@ -1,0 +1,541 @@
+"""Flash attention as a Pallas TPU kernel, forward and backward.
+
+Streaming-softmax attention tiled for the MXU: scores/accumulators stay in
+VMEM in fp32, K/V blocks stream past each Q block on the innermost grid
+dimension, and the output is normalized once at flush time (one reciprocal
+per row instead of a rescale per block). The forward emits per-row
+logsumexp so the backward can recompute attention weights blockwise
+(FlashAttention-2 style) — no O(S²) materialization in either pass.
+
+Design notes (vs the generic XLA lowering of softmax attention):
+- all matmuls keep their inputs in the model dtype (bf16) with
+  `preferred_element_type=f32` → native-rate MXU with fp32 accumulation.
+  (Upcasting inputs to f32 first — the r2 version — forfeits the MXU's
+  bf16 throughput: measured 0.75x vs XLA on a v5e; bf16 inputs +
+  512x1024 blocks measure 6-8x FASTER than XLA at S=4096/8192, r3
+  hardware sweep in doc/benchmarks.md);
+- running max / denominator live in (block_q, 128) VMEM scratch (lane-
+  replicated, the native TPU vector layout for per-row scalars);
+- causal blocks strictly above the diagonal are predicated off with
+  `pl.when`, so ~half the work is skipped at block granularity;
+- backward splits into a dq kernel (streams K/V past each Q block) and a
+  dk/dv kernel (streams Q/dO past each K block), each recomputing p from
+  q·k and the saved logsumexp.
+
+Runs in interpreter mode off-TPU so the same code path is testable on the
+8-device CPU mesh (tests/test_ops.py).
+
+Reference parity: the reference's training plane is Horovod user scripts
+(SURVEY.md §2.3); this kernel belongs to the TPU-native training plane
+that replaces them (runtime/train.py wires it in as `attn_fn`).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+logger = logging.getLogger(__name__)
+
+NEG_INF = -1e30  # finite: avoids inf-inf NaNs in the running-max updates
+# Blocks thinner than this thrash the grid (an Sq*Sk sweep of near-scalar
+# kernel invocations); below it the XLA path wins, so fall back loudly.
+MIN_BLOCK = 8
+LANES = 128
+# The logsumexp persists to HBM as [B, H, num_q, LSE_SUBLANES, block_q]
+# (q-block values on lanes, one real sublane row padded to the minimum 8).
+# The last two dims of every block equal the full array dims, which Pallas
+# accepts for ANY block_q — including the bq<128 blocks _pick_block emits
+# for odd sequence lengths — where a [B, H, S] layout would violate the
+# 128-lane block-divisibility rule. A [B, H, S, 1] layout instead costs
+# 128x lane padding — at 24 layers of training residuals that padding
+# alone is GBs of HBM; this one is 16x smaller. The kernels transpose the
+# (rows, LANES) lane-replicated running stats to lane-major at flush time
+# (one 2-D VMEM transpose per q block).
+LSE_SUBLANES = 8
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    """Largest block <= preferred that divides seq (power-of-2 descent)."""
+    b = min(preferred, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+_warned = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    """Perf-cliff fallbacks are silent correctness-wise; log them once so
+    a production regression is diagnosable from the job log."""
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg)
+
+
+def _bcast_lanes(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(rows, LANES) lane-replicated scalars -> (rows, n)."""
+    if n == LANES:
+        return x
+    if n < LANES:
+        return x[:, :n]
+    reps, rem = divmod(n, LANES)
+    if rem:
+        raise NotImplementedError(f"width {n} not a multiple of {LANES}")
+    return jnp.tile(x, (1, reps))
+
+
+def _causal_mask(s, row_start, col_start):
+    """row_start/col_start are global sequence positions (row_start may be
+    a traced scalar — sequence-parallel shards pass their q offset)."""
+    rows = row_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = col_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                acc_ref, *, sm_scale, causal, block_q, block_k, num_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+
+    @pl.when(run)
+    def _compute():
+        # Matmul inputs stay in the model dtype (bf16): the MXU multiplies
+        # bf16 natively with f32 accumulation (preferred_element_type);
+        # upcasting first would push the dots onto the multi-pass f32
+        # MXU path at a fraction of the throughput. Softmax statistics
+        # stay f32 on the VPU.
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
+
+        m_prev, l_prev = m_ref[...], l_ref[...]          # [bq, LANES]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _bcast_lanes(m_next, block_k))   # [bq, bk]
+        corr = jnp.exp(m_prev - m_next)                  # [bq, LANES]
+        m_ref[...] = m_next
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1)[:, None]
+        v = v_ref[0, 0]
+        acc_ref[...] = (acc_ref[...] * _bcast_lanes(corr, acc_ref.shape[-1])
+                        + jax.lax.dot_general(
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+
+    @pl.when(j == num_k - 1)
+    def _flush():
+        l = l_ref[...]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0, 0] = (acc_ref[...]
+                       * _bcast_lanes(l_inv, acc_ref.shape[-1])
+                       ).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        # (bq, LANES) lane-replicated -> (1, bq) lane-major, sublane-padded.
+        lse_t = (m_ref[...] + jnp.log(safe_l)).T[:1]
+        lse_ref[0, 0, 0] = jnp.broadcast_to(
+            lse_t, (LSE_SUBLANES, lse_t.shape[1]))
+
+
+def _fwd(q, k, v, q_off, causal, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    num_q, num_k = Sq // bq, Sk // bk
+    sm_scale = D ** -0.5
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, num_k=num_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, LSE_SUBLANES, bq),
+                         lambda b, h, i, j: (b, h, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, num_q, LSE_SUBLANES, bq),
+                                 jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running max
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, D), jnp.float32),       # unnormalized output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off, q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+               dq_acc, delta_ref, *, sm_scale, causal, block_q, block_k,
+               num_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        delta_ref[...] = jnp.sum(do * o, axis=1)[:, None] * jnp.ones(
+            (1, LANES), jnp.float32)
+
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else j >= 0
+
+    @pl.when(run)
+    def _compute():
+        # bf16 dot inputs, f32 accumulation — see _fwd_kernel. ds is
+        # cast back to the model dtype for its MXU pass (FlashAttention
+        # TPU kernels do the same; gradient noise floor is far above
+        # bf16 rounding here).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
+        lse = lse_ref[0, 0, 0][:1].T                         # [bq, 1]
+        p = jnp.exp(s - lse)                                 # [bq, bk]
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - delta_ref[...][:, :1]) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qoff_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                block_q, block_k, num_q):
+    j, i = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, jnp.float32)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, jnp.float32)
+
+    q_off = qoff_ref[0, 0]
+    run = q_off + (i + 1) * block_q - 1 >= j * block_k if causal else i >= 0
+
+    @pl.when(run)
+    def _compute():
+        # bf16 dot inputs, f32 accumulation — see _fwd_kernel.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s *= sm_scale
+        if causal:
+            s = _causal_mask(s, q_off + i * block_q, j * block_k)
+        lse = lse_ref[0, 0, 0][:1].T                         # [bq, 1]
+        p = jnp.exp(s - lse)                                 # [bq, bk]
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0, 0], axis=1)[:, None]
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - delta) * sm_scale                    # [bq, bk]
+        # dk += ds^T q ; dv += p^T do   (contract over the bq rows)
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, g, q_off, causal, block_q, block_k, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    # The lse residual is blocked by the FORWARD's bq (its dim 2 counts
+    # fwd q-blocks). When the backward runs a different q block, re-block
+    # it with plain XLA ops — fwd blocks are contiguous rows, so dropping
+    # the sublane padding and reshaping regroups them exactly, in either
+    # direction (any bq dividing Sq); the kernels then read their usual
+    # (1, bq)-lane layout. (An in-kernel reshape across the block dim is
+    # not a Mosaic-supported layout cast.)
+    bq_f = lse.shape[4]
+    if bq != bq_f:
+        lse = lse[:, :, :, :1, :].reshape(B, H, Sq // bq, 1, bq)
+    lse_sub = lse.shape[3]
+    num_q, num_k = Sq // bq, Sk // bk
+    sm_scale = D ** -0.5
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    lse_spec = pl.BlockSpec((1, 1, 1, lse_sub, bq),
+                            lambda b, h, i, j: (b, h, i, 0, 0))
+
+    off_spec = pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                            memory_space=pltpu.SMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, num_k=num_k),
+        grid=(B, H, num_q, num_k),
+        in_specs=[off_spec, q_spec, kv_spec, kv_spec, q_spec, q_spec,
+                  lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                        pltpu.VMEM((bq, LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off, q, k, v, g, o, lse)
+
+    # dk/dv: swap the roles — outer over K blocks, stream Q/dO/O past them.
+    q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    lse_spec_t = pl.BlockSpec((1, 1, 1, lse_sub, bq),
+                              lambda b, h, j, i: (b, h, i, 0, 0))
+    off_spec_t = pl.BlockSpec((1, 1), lambda b, h, j, i: (0, 0),
+                              memory_space=pltpu.SMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, num_q=num_q),
+        grid=(B, H, num_k, num_q),
+        in_specs=[off_spec_t, q_spec_t, kv_spec_t, kv_spec_t, q_spec_t,
+                  q_spec_t, lse_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q_off, q, k, v, g, o, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, q_off, causal, block_q, block_k, block_bwd,
+                interpret):
+    o, _ = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, q_off, causal, block_q, block_k, block_bwd,
+                    interpret):
+    o, lse = _fwd(q, k, v, q_off, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse, q_off)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, block_bwd, interpret, res, g):
+    q, k, v, o, lse, q_off = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, q_off, causal, block_bwd,
+                      block_bwd, interpret)
+    return dq, dk, dv, None  # int offset gets no cotangent
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
+                    block_k: int = 1024, block_bwd: int = 1024,
+                    q_offset=None,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] arrays (model layout).
+
+    Heads must already be GQA-expanded (models/layers.py repeats KV heads
+    before calling `attn_fn`). Differentiable via the Pallas backward
+    kernels. `interpret=None` auto-selects interpreter mode off-TPU.
+
+    Defaults are the r3 v5e sweep winner measured END TO END on the
+    flagship train step (doc/benchmarks.md): 1024-edge blocks for both
+    passes. `block_bwd` tunes the backward's square block edge
+    independently (the dq/dkv kernels tolerate different tilings than
+    the forward; the saved logsumexp is re-blocked to match, either
+    direction).
+
+    `q_offset` (int or traced scalar) is q's global position within the
+    K/V sequence — sequence-parallel shards hold a slice of the queries
+    against the full keys, so causal masking needs the true row index.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    D = q.shape[-1]
+    if D > LANES and D % LANES:
+        raise NotImplementedError(
+            f"head_dim {D} > {LANES} must be a multiple of {LANES}")
+    # Odd-factor sequence lengths (e.g. S=257) admit only degenerate
+    # blocks: either near-1 (pathologically fine grid) or — now that the
+    # defaults exceed typical S — one full-sequence block off the MXU
+    # tiling (sublane 8 / lane 128), which _bcast_lanes cannot widen and
+    # Mosaic has no tested layout for. Both take the XLA path instead;
+    # sp-sharded calls (traced q_offset) can't, because it has no offset
+    # plumbing, so they keep the kernel.
+    bq = _pick_block(q.shape[1], block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    # The backward picks its own blocks from the same lengths; an odd
+    # length can alias to an aligned fwd block but an unaligned bwd one
+    # (e.g. Sq=520: fwd bq descends to 8, bwd bq=520), so check both.
+    picks = [(bq, bk), (_pick_block(q.shape[1], block_bwd),
+                        _pick_block(k.shape[1], block_bwd))]
+    aligned = all(pq % LSE_SUBLANES == 0 and (pk <= LANES or pk % LANES == 0)
+                  for pq, pk in picks)
+    if (min(bq, bk) < MIN_BLOCK or not aligned) and q_offset is None:
+        _warn_once(
+            f"tiny-block-{q.shape[1]}x{k.shape[1]}",
+            f"flash_attention: seq lengths {q.shape[1]}/{k.shape[1]} admit "
+            f"only {bq}x{bk} blocks (< {MIN_BLOCK} or off the 8x128 MXU "
+            "tiling); using the XLA attention path instead — pad sequences "
+            "to a power-of-two multiple to re-enable the Pallas kernel")
+        from vodascheduler_tpu.parallel.ring_attention import (
+            reference_attention)
+        return reference_attention(q, k, v, causal=causal)
+    off = jnp.asarray(0 if q_offset is None else q_offset,
+                      jnp.int32).reshape(1, 1)
+    qT = q.transpose(0, 2, 1, 3)  # [B,H,S,D]
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qT, kT, vT, off, causal, block_q, block_k, block_bwd,
+                      interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(mesh: Mesh,
+                         batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                         head_axis: str = "tp", causal: bool = True,
+                         interpret: Optional[bool] = None):
+    """Shard_map the kernel over a dp/fsdp x tp mesh as an `attn_fn`.
+
+    Batch shards over the data axes and heads over `tp`, matching the
+    activation shardings in parallel/sharding.py, so the kernel runs on
+    purely local blocks and GSPMD inserts no collectives around it. The
+    sequence axis stays local — a mesh with a real `sp` axis should use
+    ring attention (parallel/ring_attention.py) instead.
+
+    Shapes that don't divide the mesh axes (heads % tp, batch % dp·fsdp)
+    fall back to the plain XLA softmax path at trace time — shard_map
+    requires exact divisibility, and the elasticity contract ("the same
+    model reshapes onto any mesh") must not break on such plans.
+    """
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch, None, head, None)
+    batch_size = 1
+    for a in (batch or ()):
+        batch_size *= mesh.shape[a]
+    head_size = mesh.shape[head_axis] if head else 1
+
+    def local_fn(q, k, v):
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+
+    sharded = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)
+
+    def attn(q, k, v):
+        if q.shape[0] % batch_size or q.shape[2] % head_size:
+            _warn_once(
+                f"indivisible-{q.shape[0]}x{q.shape[2]}-{batch_size}x{head_size}",
+                f"make_flash_attention: batch {q.shape[0]} % {batch_size} "
+                f"or heads {q.shape[2]} % {head_size} nonzero — falling "
+                "back to the O(S^2) XLA attention path for this shape "
+                "(elasticity contract: correctness over speed); pick a "
+                "mesh plan dividing batch/heads to restore the kernel")
+            from vodascheduler_tpu.parallel.ring_attention import (
+                reference_attention)
+            return reference_attention(q, k, v, causal=causal)
+        return sharded(q, k, v)
+
+    return attn
+
+
+def make_sp_flash_attention(mesh: Mesh, seq_axis: str = "sp",
+                            batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+                            head_axis: str = "tp", causal: bool = True,
+                            interpret: Optional[bool] = None):
+    """Sequence-parallel flash attention: all-gathered K/V, sharded Q.
+
+    The compute-optimal long-context alternative to ring attention
+    (parallel/ring_attention.py): each sp shard holds its query slice,
+    all-gathers the full K/V once over the ICI ring, and runs the tiled
+    MXU kernel with its global `q_offset` for causal masking — backward
+    reverses the all-gather into a reduce-scatter automatically. Memory
+    is O(S) per device for K/V (vs ring's O(S/n)), so prefer ring when
+    the gathered K/V wouldn't fit HBM.
+    """
+    n_shards = mesh.shape.get(seq_axis, 1)
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch, seq_axis if n_shards > 1 else None, head, None)
+
+    def local_fn(q, k, v):
+        if n_shards > 1:
+            k = jax.lax.all_gather(k, seq_axis, axis=1, tiled=True)
+            v = jax.lax.all_gather(v, seq_axis, axis=1, tiled=True)
+            off = jax.lax.axis_index(seq_axis) * q.shape[1]
+        else:
+            off = 0
+        return flash_attention(q, k, v, causal=causal, q_offset=off,
+                               interpret=interpret)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

@@ -1,0 +1,20 @@
+"""TPU parallelism: meshes, sharding rules, and long-context attention.
+
+This layer has no reference counterpart — Voda delegates all parallelism to
+Elastic Horovod data parallelism (SURVEY.md §2.2). A TPU-native framework
+owns it: jobs train under GSPMD on an ICI mesh, so elastic resize is "build
+a new mesh, reshard the checkpoint, continue", and large models run TP/FSDP
+instead of being capped at data parallel.
+
+- mesh.py: device meshes from chip counts/slice shapes; dp/fsdp/tp/sp/ep
+  axis conventions
+- sharding.py: path-pattern param partitioning + batch sharding
+- ring_attention.py: sequence-parallel attention via shard_map + ppermute
+"""
+
+from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
+from vodascheduler_tpu.parallel.sharding import (
+    ShardingRules,
+    param_shardings,
+    batch_sharding,
+)

@@ -1,0 +1,142 @@
+"""Device meshes for elastic TPU jobs.
+
+Axis conventions (the "How to Scale Your Model" recipe: pick a mesh,
+annotate shardings, let XLA insert the collectives):
+
+- `dp`   pure data parallelism (gradient psum over ICI/DCN)
+- `fsdp` data parallelism with parameter/optimizer sharding (ZeRO-3 style:
+         params all-gathered per layer, grads reduce-scattered)
+- `tp`   tensor parallelism (activations all-reduced inside blocks)
+- `sp`   sequence/context parallelism for long-context attention
+         (ring attention over ppermute, ring_attention.py)
+- `ep`   expert parallelism for MoE (all_to_all token routing)
+- `pp`   pipeline parallelism over the scanned layer stack (GPipe-style
+         microbatch rotation via ppermute, parallel/pipeline.py)
+
+`plan_mesh` chooses axis sizes for a chip count + model scale, preferring
+tp within a host (fastest ICI hops), fsdp across the slice, dp outermost —
+the standard layout that keeps heavy collectives on short ICI paths.
+Elastic resize = plan_mesh at the new count + checkpoint reshard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+if TYPE_CHECKING:  # placement deps stay out of the import graph at runtime
+    from vodascheduler_tpu.placement.topology import PoolTopology, SliceShape
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Chosen axis sizes; product == chip count."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def num_chips(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                "sp": self.sp, "ep": self.ep, "pp": self.pp}
+
+    def active_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXES if getattr(self, a) > 1)
+
+
+def _largest_pow2_divisor(n: int, cap: int) -> int:
+    d = 1
+    while d * 2 <= cap and n % (d * 2) == 0:
+        d *= 2
+    return d
+
+
+def plan_mesh(num_chips: int,
+              model_params_b: float = 0.0,
+              seq_len: int = 0,
+              num_experts: int = 0,
+              max_tp: int = 4,
+              chips_per_host: int = 4,
+              topology: Optional["PoolTopology"] = None,
+              slice_shape: Optional["SliceShape"] = None) -> MeshPlan:
+    """Pick axis sizes for a chip count and model scale.
+
+    Heuristics (scaling-book defaults):
+    - models < ~1B params: pure dp — no sharding needed.
+    - bigger models: tp up to min(max_tp, chips_per_host) so TP collectives
+      stay intra-host; fsdp over the rest (param memory scales down).
+    - long sequences (>= 32k): give sp a factor (ring attention).
+    - MoE: ep divides the expert count.
+
+    `topology` (placement/topology.py PoolTopology) replaces the
+    chips_per_host default with the pool's real host block size, so the
+    "tp stays intra-host" property holds on v5e-style 1/8-chip hosts as
+    well as the v4/v5p 4-chip default. `slice_shape` is the granted
+    contiguous sub-torus for this job (the allocator's unit after
+    feasibility rounding); its chip count overrides `num_chips` so the
+    mesh always matches the grant exactly.
+    """
+    if slice_shape is not None:
+        num_chips = slice_shape.num_chips
+    if topology is not None:
+        chips_per_host = topology.chips_per_host
+    if num_chips <= 0:
+        raise ValueError("num_chips must be positive")
+    remaining = num_chips
+    tp = 1
+    if model_params_b >= 1.0:
+        tp = _largest_pow2_divisor(remaining, min(max_tp, chips_per_host))
+        remaining //= tp
+    sp = 1
+    if seq_len >= 32768 and remaining > 1:
+        sp = _largest_pow2_divisor(remaining, 4)
+        remaining //= sp
+    ep = 1
+    if num_experts > 1 and remaining > 1:
+        ep = _largest_pow2_divisor(remaining, min(num_experts, remaining))
+        remaining //= ep
+    fsdp = 1
+    if model_params_b >= 1.0:
+        fsdp = remaining
+        remaining = 1
+    dp = remaining
+    return MeshPlan(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
+
+
+def build_mesh(plan: MeshPlan,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Materialize the plan over devices (default: all local devices).
+
+    Axis order is (dp, pp, fsdp, sp, ep, tp) with tp innermost so
+    adjacent devices (shortest ICI hops) serve the highest-bandwidth
+    axis; pp sits outermost after dp — stage-to-stage traffic is one
+    point-to-point activation transfer per tick, the cheapest collective
+    in the program, so it tolerates the longest hops.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < plan.num_chips:
+        raise ValueError(
+            f"mesh plan needs {plan.num_chips} devices, have {len(devices)}")
+    # Host-major device order: the multi-host backend assigns process ids
+    # in the placement manager's host order (cluster/multihost.py), so
+    # sorting by (process_index, local id) makes tp — the innermost mesh
+    # axis — span consecutive chips of one host before crossing hosts.
+    devices.sort(key=lambda d: (getattr(d, "process_index", 0),
+                                getattr(d, "id", 0)))
+    devices = devices[:plan.num_chips]
+    shape = (plan.dp, plan.pp, plan.fsdp, plan.sp, plan.ep, plan.tp)
+    arr = np.array(devices, dtype=object).reshape(shape)
+    return Mesh(arr, axis_names=("dp", "pp", "fsdp", "sp", "ep", "tp"))

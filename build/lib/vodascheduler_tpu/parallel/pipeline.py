@@ -1,0 +1,102 @@
+"""SPMD pipeline parallelism: GPipe-style microbatching, XLA-native.
+
+The scanned layer stack (models/layers.py scan_stack) stores params as
+[L, ...] leaves; the sharding rules put that leading axis on `pp`, so a
+pipeline mesh gives each stage a contiguous block of L/P layers. This
+module runs the microbatch rotation WITHOUT shard_map or hand-written
+collectives — everything is plain GSPMD ops, chosen for how they lower:
+
+- the loop state is stage-stacked: `state[p]` is stage p's current
+  activation, an array [P, mb, S, D] sharded over `pp` on axis 0;
+- one tick = `jnp.roll(state, 1, axis=0)` (lowers to a single
+  CollectivePermute ring-shifting activations stage p -> p+1), feed the
+  next microbatch into stage 0's slot, then `jax.vmap` the per-stage
+  layer block over axis 0 — operands are sharded on the vmapped axis,
+  so GSPMD partitions the compute: each device runs only its stage;
+- ticks advance under `lax.scan` for M + P - 1 steps (the GPipe
+  schedule; the P-1 bubble ticks compute on zeros).
+
+This is the "collective-permute pipeline" formulation the public praxis
+LayerwiseShardablePipelined uses; no torch-style stage processes or
+send/recv threads exist because the whole schedule is one jitted
+program. Reference parity: SURVEY.md §2.2 lists PP as the one optional
+parallelism row; the reference has no pipeline support at all.
+
+Known simplification (documented, not hidden): the last stage's output
+is read back with a cross-stage broadcast every tick; a bandwidth-
+optimal version would accumulate outputs on the last stage and gather
+once. Fine at the activation sizes where pp is used (pp moves params,
+not activations, off-chip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
+                  stacked_params: Any,
+                  x: jax.Array,
+                  num_stages: int,
+                  num_microbatches: int,
+                  remat: bool = False,
+                  remat_policy: Any = None) -> jax.Array:
+    """Run x through L layers pipelined over `num_stages`.
+
+    layer_fn(layer_params, x) -> x applies ONE layer; `stacked_params`
+    leaves are [L, ...] (the scan_stack layout, sharded over pp on axis
+    0 by the rules). x is [B, ...] with B divisible by num_microbatches
+    (and the microbatch size by the data axes). Returns [B, ...] after
+    all L layers. `remat_policy` is a policy name from
+    models/layers.py REMAT_POLICIES (same contract as scan_stack).
+    """
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    P = num_stages
+    M = num_microbatches
+    if L % P:
+        raise ValueError(f"{L} layers do not split over {P} stages")
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+
+    if remat:
+        from vodascheduler_tpu.models.layers import _resolve_remat_policy
+        layer_fn = jax.checkpoint(layer_fn,
+                                  policy=_resolve_remat_policy(remat_policy))
+
+    # [P, L/P, ...]: stage-major layer blocks. L is pp-sharded in P
+    # equal pieces, so this reshape is device-local.
+    stage_params = jax.tree.map(
+        lambda leaf: leaf.reshape(P, L // P, *leaf.shape[1:]),
+        stacked_params)
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    def stage_fn(p_stage, xin):
+        out, _ = jax.lax.scan(lambda h, p: (layer_fn(p, h), None),
+                              xin, p_stage)
+        return out
+
+    state = jnp.zeros((P, mb) + x.shape[1:], dtype=x.dtype)
+    outputs = jnp.zeros_like(xs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        shifted = jnp.roll(state, shift=1, axis=0)       # CollectivePermute
+        shifted = shifted.at[0].set(
+            jnp.where(t < M, feed, jnp.zeros_like(feed)))
+        state = jax.vmap(stage_fn)(stage_params, shifted)
+        out_idx = t - (P - 1)
+        cand = jax.lax.dynamic_update_index_in_dim(
+            outputs, state[-1], jnp.clip(out_idx, 0, M - 1), 0)
+        outputs = jnp.where(out_idx >= 0, cand, outputs)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(M + P - 1))
+    return outputs.reshape(B, *x.shape[1:])

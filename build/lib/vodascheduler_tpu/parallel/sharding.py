@@ -1,0 +1,182 @@
+"""Sharding rules: map parameter paths and batches onto the mesh.
+
+Pattern-based partitioning (path regex -> PartitionSpec) rather than model
+annotations: models stay plain flax modules, and the same model reshapes
+onto any mesh — the property elastic resize depends on (a checkpoint saved
+on an 8-chip mesh restores onto 32 chips by re-deriving shardings from the
+same rules, orbax handles the data movement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """Ordered (path-regex, PartitionSpec) rules; first match wins.
+
+    Spec axis names refer to mesh axes; axes absent from the mesh (size 1)
+    are dropped automatically by jax. `default` applies when nothing
+    matches (fsdp-shard the largest axis or replicate).
+    """
+
+    rules: List[Tuple[str, P]]
+    default: P = dataclasses.field(default_factory=P)
+
+    def spec_for(self, path: str) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        return self.default
+
+
+# Per-layer transformer rules: TP shards attention heads and MLP hidden;
+# FSDP shards the other big axis of every matrix; MoE experts over ep.
+# The scanned variants below are DERIVED from this list — never add a
+# scanned rule by hand (a hand-copy that drifted would silently put
+# fsdp/tp on the stacked layer axis).
+_LAYER_RULES = [
+    (r"(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp")),
+    (r"o_proj.*kernel$", P("tp", "fsdp")),
+    (r"(up_proj|gate_proj|fc1).*kernel$", P("fsdp", "tp")),
+    (r"(down_proj|fc2).*kernel$", P("tp", "fsdp")),
+    (r"experts.*(up|gate).*kernel$", P("ep", "fsdp", "tp")),
+    (r"experts.*down.*kernel$", P("ep", "tp", "fsdp")),
+    (r"router.*kernel$", P("fsdp", None)),
+]
+
+# Transformer rules (llama/bert/vit/mixtral family). Scan-over-layers
+# params carry a leading layer axis ("layers_scan" in the path): same
+# specs shifted right by one, the layer axis assigned to `pp` — on a
+# pipeline mesh each stage holds its contiguous block of layers; on
+# pp=1 meshes _fit_spec drops the axis and the stack replicates across
+# nothing (plain scan). Generated from _LAYER_RULES so the two sets
+# cannot diverge. Ordered first (first match wins); norms/scales fall
+# through to the replicate rule either way.
+TRANSFORMER_RULES = ShardingRules(rules=(
+    [(r"layers_scan.*" + pattern, P("pp", *spec))
+     for pattern, spec in _LAYER_RULES]
+    + [
+        # token/position embeddings: vocab over fsdp, model dim over tp.
+        # (Not the transpose: dim-over-fsdp propagates into the gather
+        # output with a permuted device order GSPMD can only fix by
+        # involuntary full rematerialization of the [B,S,D] activation —
+        # see constrain_batch_activation. vocab-over-fsdp also
+        # reduce-scatters the embedding grad instead of replicating it.)
+        (r"embed.*embedding$", P("fsdp", "tp")),
+    ]
+    + _LAYER_RULES
+    + [
+        # final head
+        (r"lm_head.*kernel$", P("fsdp", "tp")),
+        # norms / biases / scales: replicate
+        (r"(norm|scale|bias|ln)", P()),
+    ]))
+
+# Conv/vision rules (resnet): fsdp over output channels of large convs.
+CONV_RULES = ShardingRules(rules=[
+    (r"conv.*kernel$", P(None, None, None, "fsdp")),
+    (r"dense.*kernel$", P("fsdp", "tp")),
+    (r"(bn|norm|scale|bias)", P()),
+])
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        parts.append(str(name if name is not None else k))
+    return "/".join(parts)
+
+
+def param_shardings(params: Any, mesh: Mesh,
+                    rules: ShardingRules) -> Any:
+    """NamedShardings for a param pytree by path rules. Specs referring to
+    mesh axes of size 1 (or axes that don't divide the dim) fall back to
+    replication on that axis."""
+
+    def one(path, leaf):
+        spec = rules.spec_for(_path_str(path))
+        spec = _fit_spec(spec, getattr(leaf, "shape", ()), mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Trim a spec to the array rank and drop axes that don't divide the
+    dimension (falls back to replication for that dim)."""
+    out = []
+    for i, dim in enumerate(shape):
+        axis = spec[i] if i < len(spec) else None
+        if axis is None:
+            out.append(None)
+            continue
+        size = mesh.shape.get(axis, 1)
+        if size <= 1 or dim % size != 0:
+            out.append(None)
+        else:
+            out.append(axis)
+    return P(*out)
+
+
+def batch_sharding(mesh: Mesh, seq_axis: Optional[str] = None) -> NamedSharding:
+    """Batch sharding: batch dim over all data-like axes (dp+fsdp), and
+    optionally the sequence dim over sp."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    batch_axes = data_axes if data_axes else None
+    if seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        return NamedSharding(mesh, P(batch_axes, seq_axis))
+    return NamedSharding(mesh, P(batch_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _ambient_mesh_active() -> bool:
+    """Whether a mesh context is active at trace time.
+
+    Covers both mesh-context mechanisms: the new sharding-in-types
+    context (`jax.sharding.use_mesh`, visible via get_abstract_mesh) and
+    the legacy `with Mesh(...)` context train.py uses, which only the
+    thread-resources env reflects inside a jit trace (get_mesh() is
+    outside-jit-only as of jax 0.9).
+    """
+    if not jax.sharding.get_abstract_mesh().empty:
+        return True
+    try:
+        from jax._src import mesh as _mesh_lib
+        return not _mesh_lib.thread_resources.env.physical_mesh.empty
+    except Exception:  # pragma: no cover - internal layout changed
+        # Can't tell: assume active so mis-sharding errors stay loud.
+        return True
+
+
+def constrain_batch_activation(x: jax.Array) -> jax.Array:
+    """Pin an activation's leading (batch) dim to the data axes.
+
+    Embedding tables are fsdp-sharded on the model dim, and without a
+    constraint GSPMD propagates that feature sharding into the gather
+    output; the backward pass then pays an involuntary full
+    rematerialization converting the batch-sharded cotangent back
+    (observed on dp×fsdp×tp meshes). Models call this right after the
+    embedding lookup. Uses the framework's fixed axis names (mesh.py
+    AXES), so it needs an active mesh context — the train step runs
+    under one (train.py) — and no-ops when there is none, keeping
+    modules usable standalone.
+    """
+    if not _ambient_mesh_active():
+        return x
+    # Mirror batch_sharding: batch over the data axes, seq over sp
+    # (sp=1 meshes make the seq axis a no-op; sp>1 meshes already
+    # shard the token batch this way, so divisibility holds).
+    return jax.lax.with_sharding_constraint(x, P(("dp", "fsdp"), "sp"))

@@ -1,0 +1,92 @@
+"""Hungarian (Kuhn–Munkres) assignment, max-score square variant.
+
+Reference counterpart: the external github.com/heyfey/munkres library the
+reference calls as `ComputeMunkresMax` (placement_manager.go:505-512) to
+relabel logical nodes onto physical ones, maximizing already-in-place
+workers.
+
+Implementation: the O(n³) shortest-augmenting-path algorithm with dual
+potentials on the cost (minimization) form; maximization negates the
+matrix. The C++ kernel (native/voda_native.cc) accelerates large pools;
+this pure Python version is the always-available fallback and test oracle.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+from vodascheduler_tpu import native
+
+
+def solve_max(score: Sequence[Sequence[float]]) -> List[Tuple[int, int]]:
+    """Maximum-score perfect assignment on a square matrix.
+
+    Returns [(row, col), ...] with each row and column used exactly once.
+    """
+    n = len(score)
+    if n == 0:
+        return []
+    for row in score:
+        if len(row) != n:
+            raise ValueError("score matrix must be square")
+    result = native.hungarian_max(score)
+    if result is not None:
+        return result
+    cost = [[-float(v) for v in row] for row in score]
+    cols = _solve_min(cost)
+    return [(r, c) for r, c in enumerate(cols)]
+
+
+def _solve_min(cost: List[List[float]]) -> List[int]:
+    """Jonker-Volgenant-style O(n³) min-cost assignment.
+
+    Returns col assigned to each row. 1-indexed internals per the classic
+    formulation (e-maxx), converted at the boundary.
+    """
+    n = len(cost)
+    INF = math.inf
+    u = [0.0] * (n + 1)   # row potentials
+    v = [0.0] * (n + 1)   # col potentials
+    p = [0] * (n + 1)     # p[col] = row matched to col (0 = none)
+    way = [0] * (n + 1)
+
+    for i in range(1, n + 1):
+        p[0] = i
+        j0 = 0
+        minv = [INF] * (n + 1)
+        used = [False] * (n + 1)
+        while True:
+            used[j0] = True
+            i0 = p[j0]
+            delta = INF
+            j1 = -1
+            for j in range(1, n + 1):
+                if used[j]:
+                    continue
+                cur = cost[i0 - 1][j - 1] - u[i0] - v[j]
+                if cur < minv[j]:
+                    minv[j] = cur
+                    way[j] = j0
+                if minv[j] < delta:
+                    delta = minv[j]
+                    j1 = j
+            for j in range(0, n + 1):
+                if used[j]:
+                    u[p[j]] += delta
+                    v[j] -= delta
+                else:
+                    minv[j] -= delta
+            j0 = j1
+            if p[j0] == 0:
+                break
+        while j0:  # augment along the path
+            j1 = way[j0]
+            p[j0] = p[j1]
+            j0 = j1
+
+    row_to_col = [0] * n
+    for j in range(1, n + 1):
+        if p[j]:
+            row_to_col[p[j] - 1] = j - 1
+    return row_to_col

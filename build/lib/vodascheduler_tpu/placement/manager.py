@@ -1,0 +1,418 @@
+"""The placement manager: chip→host binding with migration minimization.
+
+Reference counterpart: pkg/placement/placement_manager.go. The algorithm
+skeleton is preserved because it is sound for any accelerator pool:
+
+  1. release slots of shrunk/terminated jobs, tail-first (the release-order
+     contract: worker ranks are torn down from the highest index,
+     placement_manager.go:337-367)
+  2. re-pack all requests onto empty *logical* hosts with best-fit
+     consolidation (:415-487)
+  3. bind logical hosts onto physical ones with a Hungarian assignment
+     maximizing workers that stay put (:492-544)
+  4. rebuild per-job views and diff old vs new worker→host maps; changed
+     workers must migrate (:548-620)
+
+TPU-first deltas:
+  - hosts carry coordinates on the pool's ICI host grid (topology.py); both
+    best-fit and spill tie-break on torus contiguity with the job's
+    already-placed hosts, so multi-host jobs ride short ICI paths. The
+    reference's binary crossNode counter generalizes to a contiguity cost.
+  - "delete the pod" becomes a restart set handed to the job runtime: on
+    TPU any host-set change is a checkpoint-restart resize anyway, so
+    migration and elastic resize share one mechanism (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from vodascheduler_tpu.common.metrics import Registry, timed
+from vodascheduler_tpu.placement import hungarian
+from vodascheduler_tpu.placement.state import HostSlots, HostState, JobPlacement
+from vodascheduler_tpu.placement.topology import PoolTopology
+from vodascheduler_tpu.common.types import ScheduleResult
+
+
+@dataclasses.dataclass
+class PlacementDecision:
+    """Result of one placement pass."""
+
+    # job -> ordered (host, chips) assignment (order = release order)
+    placements: Dict[str, List[Tuple[str, int]]]
+    # job -> worker indexes that changed host and must restart
+    migrations: Dict[str, List[int]]
+    # jobs whose entire worker set moved (launcher restart in the reference,
+    # placement_manager.go:603-605)
+    full_restarts: List[str]
+    num_jobs_cross_host: int = 0
+    total_contiguity_cost: int = 0
+    workers_migrated: int = 0
+
+
+class PlacementManager:
+    """Owns host/job placement state for one TPU pool."""
+
+    def __init__(self, pool_id: str = "default",
+                 topology: Optional[PoolTopology] = None,
+                 registry=None):
+        self.pool_id = pool_id
+        self.topology = topology
+        self.host_states: Dict[str, HostState] = {}
+        self.job_placements: Dict[str, JobPlacement] = {}
+        # Reference series: pkg/placement/metrics.go:11-50 (algo duration
+        # summary + migrated/deleted/cross-node gauges of the last pass).
+        if registry is None:
+            registry = Registry()
+        pool_l = {"pool": pool_id}  # N pools, one registry, no collisions
+        self.m_algo_duration = registry.summary(
+            "voda_placement_algo_duration_seconds",
+            "Placement pass duration", ("mode",), const_labels=pool_l)
+        self.m_workers_migrated = registry.gauge(
+            "voda_placement_workers_migrated",
+            "Workers that changed host in the last placement pass",
+            const_labels=pool_l)
+        self.m_full_restarts = registry.gauge(
+            "voda_placement_full_restarts",
+            "Jobs whose entire worker set moved in the last pass "
+            "(reference: launchers deleted)", const_labels=pool_l)
+        self.m_jobs_cross_host = registry.gauge(
+            "voda_placement_jobs_cross_host",
+            "Jobs spanning more than one host after the last pass",
+            const_labels=pool_l)
+
+    # ---- host membership (reference: node informer handlers :174-304) ----
+
+    def add_host(self, name: str, num_chips: int,
+                 coord: Optional[Tuple[int, ...]] = None) -> None:
+        existing = self.host_states.get(name)
+        if existing is not None:
+            # Re-announced host (capacity update): adjust free slots by the
+            # delta, keep placed workers.
+            delta = num_chips - existing.total_slots
+            existing.total_slots = num_chips
+            existing.free_slots += delta
+            if coord is not None:
+                existing.coord = coord
+            return
+        self.host_states[name] = HostState(name=name, total_slots=num_chips,
+                                           coord=coord)
+
+    def remove_host(self, name: str) -> None:
+        """Reference deleteNode semantics (placement_manager.go:282-304):
+        jobs lose their workers on the host; their placement entries zero
+        out so the next place() migrates them."""
+        host = self.host_states.pop(name, None)
+        if host is None:
+            return
+        for job_name in list(host.job_num_workers):
+            placement = self.job_placements.get(job_name)
+            if placement is None:
+                continue
+            for hs in placement.host_slots:
+                if hs.host == name:
+                    placement.num_workers -= hs.num_slots
+                    hs.num_slots = 0
+
+    def add_hosts_from_topology(self, topology: PoolTopology) -> None:
+        self.topology = topology
+        for coord in topology.host_coords():
+            self.add_host(topology.host_name(coord), topology.chips_per_host,
+                          coord=coord)
+
+    @property
+    def total_chips(self) -> int:
+        return sum(h.total_slots for h in self.host_states.values())
+
+    # ---- the placement pass ----------------------------------------------
+
+    def place(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """Incremental placement (TPU-first redesign of the reference's
+        Place, :306-332).
+
+        The reference repacks every job from scratch each pass and then
+        Hungarian-relabels nodes to maximize stay-put workers (:492-544) —
+        acceptable when a moved worker is a cheap pod delete under Elastic
+        Horovod, but on TPU every moved worker is a checkpoint-restart of
+        its whole job. Here jobs that keep their size keep their hosts
+        outright; only growth deltas and new jobs are packed (anchored to
+        the job's existing hosts for ICI contiguity). Migrations then only
+        arise from host loss — or from an explicit defragment() pass, which
+        is where the reference's full repack + Hungarian machinery lives
+        on."""
+        with timed(self.m_algo_duration, mode="incremental"):
+            old_worker_hosts = {job: self._expand_workers(p)
+                                for job, p in self.job_placements.items()}
+
+            self._release_slots(job_requests)
+            cross, contiguity = self._place_incremental(job_requests)
+            decision = self._decision(old_worker_hosts, cross, contiguity)
+        self._observe(decision)
+        return decision
+
+    def defragment(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """Full repack + Hungarian stay-put relabeling (the reference's
+        Place semantics, :306-332). Consolidates fragmentation at the cost
+        of migrations; callers weigh that cost explicitly."""
+        with timed(self.m_algo_duration, mode="defragment"):
+            old_worker_hosts = {job: self._expand_workers(p)
+                                for job, p in self.job_placements.items()}
+
+            self._release_slots(job_requests)
+            # Empty logical hosts mirroring the physical fleet (:317-320).
+            logical = [HostState(name=f"TBD-{i}", total_slots=h.total_slots,
+                                 coord=h.coord)
+                       for i, h in enumerate(self._hosts_sorted())]
+            cross, contiguity = self._best_fit(job_requests, logical)
+            self._bind_hosts(logical)
+            self._update_job_placements()
+            decision = self._decision(old_worker_hosts, cross, contiguity)
+        self._observe(decision)
+        return decision
+
+    def _observe(self, decision: PlacementDecision) -> None:
+        self.m_workers_migrated.set(decision.workers_migrated)
+        self.m_full_restarts.set(len(decision.full_restarts))
+        self.m_jobs_cross_host.set(decision.num_jobs_cross_host)
+
+    def _decision(self, old_worker_hosts: Dict[str, List[str]],
+                  cross: int, contiguity: int) -> PlacementDecision:
+        migrations: Dict[str, List[int]] = {}
+        full_restarts: List[str] = []
+        migrated = 0
+        for job, placement in self.job_placements.items():
+            new_hosts = self._expand_workers(placement)
+            old_hosts = old_worker_hosts.get(job, [])
+            moved = [i for i in range(min(len(old_hosts), len(new_hosts)))
+                     if old_hosts[i] != new_hosts[i]]
+            if moved:
+                migrations[job] = moved
+                migrated += len(moved)
+                if len(moved) == len(new_hosts):
+                    full_restarts.append(job)
+
+        return PlacementDecision(
+            placements={job: [(hs.host, hs.num_slots) for hs in p.host_slots]
+                        for job, p in self.job_placements.items()},
+            migrations=migrations,
+            full_restarts=full_restarts,
+            num_jobs_cross_host=cross,
+            total_contiguity_cost=contiguity,
+            workers_migrated=migrated,
+        )
+
+    def _place_incremental(self, job_requests: ScheduleResult) -> Tuple[int, int]:
+        """Pack only growth deltas and new jobs into current free slots.
+        Returns (#jobs crossing hosts, total contiguity cost) over ALL
+        placed jobs."""
+        hosts = self._hosts_sorted()
+        # Biggest demand first, like _best_fit.
+        for job, requested in sorted(job_requests.items(),
+                                     key=lambda kv: kv[1], reverse=True):
+            placement = self.job_placements.setdefault(job, JobPlacement(name=job))
+            # prune dead-host / zeroed entries before packing the delta
+            placement.host_slots = [hs for hs in placement.host_slots
+                                    if hs.num_slots > 0 and hs.host in self.host_states]
+            delta = requested - placement.num_workers
+            if delta <= 0:
+                continue  # pinned: same size (or release already trimmed it)
+            my_hosts = [self.host_states[hs.host] for hs in placement.host_slots
+                        if hs.host in self.host_states and hs.num_slots > 0]
+            while delta > 0:
+                best = self._pick_host(hosts, delta, my_hosts)
+                if best is None:
+                    break  # tolerated inconsistency: place what fits
+                take = min(best.free_slots, delta)
+                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
+                best.free_slots -= take
+                delta -= take
+                placement.num_workers += take
+                # merge into an existing tail entry for the same host
+                if placement.host_slots and placement.host_slots[-1].host == best.name:
+                    placement.host_slots[-1].num_slots += take
+                else:
+                    placement.host_slots.append(HostSlots(best.name, take))
+                if best not in my_hosts:
+                    my_hosts.append(best)
+            if placement.num_workers == 0:
+                del self.job_placements[job]
+
+        # Stats over the whole fleet.
+        cross = 0
+        contiguity = 0
+        for placement in self.job_placements.values():
+            used = {hs.host for hs in placement.host_slots if hs.num_slots > 0}
+            if len(used) > 1:
+                cross += 1
+                if self.topology is not None:
+                    coords = [self.host_states[h].coord for h in used
+                              if h in self.host_states
+                              and self.host_states[h].coord is not None]
+                    contiguity += self.topology.contiguity_cost(coords)
+        return cross, contiguity
+
+    # ---- step 1: release (reference :337-411) ----------------------------
+
+    def _release_slots(self, job_requests: ScheduleResult) -> None:
+        for placement in list(self.job_placements.values()):
+            requested = job_requests.get(placement.name)
+            if requested is None:
+                # Terminated: release everything.
+                for hs in placement.host_slots:
+                    host = self.host_states.get(hs.host)
+                    if host is not None:
+                        host.free_slots += hs.num_slots
+                        host.job_num_workers.pop(placement.name, None)
+                placement.host_slots.clear()
+                placement.num_workers = 0
+                del self.job_placements[placement.name]
+            elif requested < placement.num_workers:
+                # Scaled down: trim from the tail — worker ranks die from
+                # the highest index first (release-order contract).
+                to_release = placement.num_workers - requested
+                while to_release > 0 and placement.host_slots:
+                    tail = placement.host_slots[-1]
+                    host = self.host_states.get(tail.host)
+                    take = min(tail.num_slots, to_release)
+                    tail.num_slots -= take
+                    to_release -= take
+                    placement.num_workers -= take
+                    if host is not None:
+                        host.free_slots += take
+                        host.job_num_workers[placement.name] -= take
+                        if host.job_num_workers[placement.name] <= 0:
+                            del host.job_num_workers[placement.name]
+                    if tail.num_slots == 0:
+                        placement.host_slots.pop()
+
+    # ---- step 2: best-fit packing (reference :415-487) -------------------
+
+    def _hosts_sorted(self) -> List[HostState]:
+        return sorted(self.host_states.values(), key=lambda h: h.name)
+
+    def _best_fit(self, job_requests: ScheduleResult,
+                  hosts: List[HostState]) -> Tuple[int, int]:
+        """Pack requests onto empty logical hosts. Returns (#jobs crossing
+        hosts, total contiguity cost)."""
+        requests = sorted(job_requests.items(), key=lambda kv: kv[1],
+                          reverse=True)
+        total_free = sum(h.total_slots for h in hosts)
+        cross_host = 0
+        total_contiguity = 0
+
+        for job, requested in requests:
+            remaining = requested
+            my_hosts: List[HostState] = []
+            while remaining > 0:
+                if total_free == 0:
+                    # Tolerated inconsistency with the scheduler's capacity
+                    # view (reference :433-454): place what fits, never
+                    # crash.
+                    break
+                best = self._pick_host(hosts, remaining, my_hosts)
+                if best is None:
+                    break
+                take = min(best.free_slots, remaining)
+                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
+                best.free_slots -= take
+                total_free -= take
+                remaining -= take
+                my_hosts.append(best)
+            if len(my_hosts) > 1:
+                cross_host += 1
+                if self.topology is not None:
+                    coords = [h.coord for h in my_hosts if h.coord is not None]
+                    total_contiguity += self.topology.contiguity_cost(coords)
+        return cross_host, total_contiguity
+
+    def _pick_host(self, hosts: List[HostState], requested: int,
+                   my_hosts: List[HostState]) -> Optional[HostState]:
+        """Best-fit with ICI tie-breaking.
+
+        Reference semantics (:456-480): prefer the host with the *fewest*
+        free slots still >= requested (consolidation); if none fits, spill
+        onto the host with the most free slots. TPU delta: among candidates
+        of equal free-slot count, prefer the one closest (torus distance)
+        to hosts the job already occupies.
+        """
+        fitting = [h for h in hosts if h.free_slots >= requested]
+        if fitting:
+            best_free = min(h.free_slots for h in fitting)
+            candidates = [h for h in fitting if h.free_slots == best_free]
+        else:
+            nonempty = [h for h in hosts if h.free_slots > 0]
+            if not nonempty:
+                return None
+            max_free = max(h.free_slots for h in nonempty)
+            candidates = [h for h in nonempty if h.free_slots == max_free]
+        if len(candidates) > 1 and self.topology is not None and my_hosts:
+            anchor = [h.coord for h in my_hosts if h.coord is not None]
+            if anchor:
+                candidates.sort(key=lambda h: sum(
+                    self.topology.host_distance(h.coord, a) for a in anchor)
+                    if h.coord is not None else 1 << 30)
+        return candidates[0]
+
+    # ---- step 3: Hungarian binding (reference :492-544) ------------------
+
+    def _bind_hosts(self, logical: List[HostState]) -> None:
+        physical = self._hosts_sorted()
+        n = len(physical)
+        if n == 0:
+            return
+        score = [[self._overlap(lg, ph) for ph in physical] for lg in logical]
+        for row, col in hungarian.solve_max(score):
+            logical[row].name = physical[col].name
+            logical[row].coord = physical[col].coord
+        self.host_states = {h.name: h for h in logical}
+
+    @staticmethod
+    def _overlap(position: HostState, candidate: HostState) -> float:
+        """Workers already in place if `position` is bound to `candidate`
+        (reference score, :534-544)."""
+        return float(sum(min(workers, candidate.job_num_workers.get(job, 0))
+                         for job, workers in position.job_num_workers.items()))
+
+    # ---- step 4: rebuild job views (reference :548-567) ------------------
+
+    def _update_job_placements(self) -> None:
+        new: Dict[str, JobPlacement] = {}
+        for host in self._hosts_sorted():
+            for job, workers in host.job_num_workers.items():
+                if workers <= 0:
+                    continue
+                placement = new.setdefault(job, JobPlacement(name=job))
+                placement.host_slots.append(HostSlots(host.name, workers))
+                placement.num_workers += workers
+        self.job_placements = new
+
+    # ---- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _expand_workers(placement: JobPlacement) -> List[str]:
+        """Worker index -> host, expanding host_slots in order. Index k of a
+        5-worker job placed [(A,3),(B,2)] lives on A,A,A,B,B."""
+        hosts: List[str] = []
+        for hs in placement.host_slots:
+            hosts.extend([hs.host] * hs.num_slots)
+        return hosts
+
+    # ---- crash resume (reference constructStatusOnRestart :640-680) ------
+
+    def restore(self, placements: Dict[str, List[Tuple[str, int]]]) -> None:
+        """Reconstruct state from externally persisted placements (the
+        backend's view of running workers — the TPU analog of reading pod
+        tolerations)."""
+        for job, host_slots in placements.items():
+            placement = JobPlacement(name=job)
+            for host_name, workers in host_slots:
+                host = self.host_states.get(host_name)
+                if host is None:
+                    continue
+                host.free_slots -= workers
+                host.job_num_workers[job] = host.job_num_workers.get(job, 0) + workers
+                placement.host_slots.append(HostSlots(host_name, workers))
+                placement.num_workers += workers
+            if placement.num_workers > 0:
+                self.job_placements[job] = placement

@@ -1,0 +1,216 @@
+"""TPU pool topology: hosts, chips, and ICI slice shapes.
+
+The reference models capacity as fungible GPUs per node
+(`nvidia.com/gpu` capacity, placement/utils.go:20-54). TPU capacity is not
+fungible the same way: chips sit on an ICI torus, hosts own fixed sub-blocks
+of it (e.g. a v4/v5p host = 2x2x1 = 4 chips), and a job's collective
+performance depends on whether its chips form a contiguous sub-torus.
+
+This module gives the framework a first-class topology vocabulary:
+
+- `SliceShape`: an axis-shape tuple (e.g. (2, 2, 1)) with chip count.
+- feasible_shapes(n, topology): the contiguous sub-torus shapes of n chips
+  available inside a given pool torus — what the allocator's chip counts
+  must map onto.
+- `PoolTopology`: the pool's torus dims, host block size, and host grid,
+  with distance/contiguity scoring used by the placement manager.
+
+Generalizes across TPU generations: v4/v5p are 3D tori with 4-chip hosts;
+v5e/v6e are 2D meshes with 1/4/8-chip hosts. The defaults model a v5p-like
+3D torus.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceShape:
+    """A contiguous sub-torus, e.g. (4, 4, 4) = 64 chips on a 3D torus."""
+
+    dims: Tuple[int, ...]
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    def __str__(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    @staticmethod
+    def parse(s: str) -> "SliceShape":
+        return SliceShape(tuple(int(d) for d in s.split("x")))
+
+
+def _divisor_shapes(n: int, max_dims: Sequence[int]) -> List[Tuple[int, ...]]:
+    """All factorizations of n into len(max_dims) factors with factor i
+    bounded by max_dims[i]."""
+    ndim = len(max_dims)
+    results = []
+
+    def rec(prefix: Tuple[int, ...], remaining: int) -> None:
+        axis = len(prefix)
+        if axis == ndim - 1:
+            if remaining <= max_dims[axis]:
+                results.append(prefix + (remaining,))
+            return
+        for d in range(1, min(remaining, max_dims[axis]) + 1):
+            if remaining % d == 0:
+                rec(prefix + (d,), remaining // d)
+
+    rec((), n)
+    return results
+
+
+def feasible_shapes(num_chips: int, torus_dims: Sequence[int]) -> List[SliceShape]:
+    """Contiguous sub-torus shapes for `num_chips` inside `torus_dims`,
+    best (most compact) first.
+
+    Compactness = lower surface-to-volume; compact slices keep collective
+    hops short on ICI. Power-of-two chip counts on power-of-two tori always
+    have a feasible shape; other counts may not (the allocation path rounds
+    chip counts to feasible ones via round_to_feasible)."""
+    shapes = [SliceShape(t) for t in _divisor_shapes(num_chips, torus_dims)]
+    # Sort by perimeter (sum of dims): the most cube-like first.
+    shapes.sort(key=lambda s: (sum(s.dims), max(s.dims)))
+    # Dedup up to permutation order preserved (a 2x1x1 and 1x2x1 both kept:
+    # orientation matters when packing a real torus).
+    return shapes
+
+
+def round_to_feasible(n: int, topology: "PoolTopology") -> int:
+    """Largest feasible chip count <= n on this pool.
+
+    Feasible = a contiguous sub-block of one host (sub-host jobs share a
+    host's chips like the reference's fractional-node GPU jobs), or a
+    whole-host-granular contiguous sub-torus (multi-host jobs own whole
+    hosts — the unit that runs one runtime process). This is the TPU
+    shape-feasibility check SURVEY.md §7 derives from `map[job]int`
+    becoming `map[job]sliceShape` (reference invariant enforcement:
+    pkg/algorithm/utils.go:18-42 has no such notion — GPUs are fungible).
+    """
+    for k in range(min(n, topology.total_chips), 0, -1):
+        if is_feasible_count(k, topology):
+            return k
+    return 0
+
+
+def next_feasible_above(n: int, topology: "PoolTopology") -> Optional[int]:
+    """Smallest feasible chip count > n, or None if the pool tops out."""
+    for k in range(n + 1, topology.total_chips + 1):
+        if is_feasible_count(k, topology):
+            return k
+    return None
+
+
+def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
+    """O(1)-ish direct check (one factorization enumeration, no scan) —
+    this sits on the allocation hot path via enforce_feasibility and
+    validate_result.
+
+    Multi-host slices must be a contiguous block of *whole hosts*, i.e. a
+    sub-grid of the host grid scaled by the host block — so the check
+    factorizes n / chips_per_host over the host grid, not n over the raw
+    torus (e.g. 36 chips on a (4,4,4)/(2,2,1) pool factor as 3x3x4 chips,
+    but no union of whole 2x2x1 hosts forms that box: infeasible).
+    """
+    if n == 0:
+        return True
+    if n < 0:
+        return False
+    cph = topology.chips_per_host
+    if n < cph:
+        return bool(_divisor_shapes(n, topology.host_block))
+    return n % cph == 0 and bool(_divisor_shapes(n // cph, topology.host_grid))
+
+
+@dataclasses.dataclass
+class PoolTopology:
+    """A TPU pool: a torus of chips partitioned into fixed host blocks.
+
+    The placement manager packs at host granularity (the unit that fails,
+    restarts, and runs one runtime process — like the reference's nodes) but
+    scores host subsets by ICI contiguity instead of flat counts.
+    """
+
+    torus_dims: Tuple[int, ...] = (4, 4, 4)     # pool-wide chip torus
+    host_block: Tuple[int, ...] = (2, 2, 1)     # chips per host, as a sub-block
+
+    def __post_init__(self) -> None:
+        if len(self.host_block) != len(self.torus_dims):
+            raise ValueError("host_block rank must match torus_dims rank")
+        for t, h in zip(self.torus_dims, self.host_block):
+            if t % h != 0:
+                raise ValueError(f"host block {self.host_block} does not tile torus {self.torus_dims}")
+
+    @property
+    def chips_per_host(self) -> int:
+        return math.prod(self.host_block)
+
+    @property
+    def num_hosts(self) -> int:
+        return math.prod(self.host_grid)
+
+    @property
+    def total_chips(self) -> int:
+        return math.prod(self.torus_dims)
+
+    @property
+    def host_grid(self) -> Tuple[int, ...]:
+        """Grid of hosts: torus dims divided by host block dims."""
+        return tuple(t // h for t, h in zip(self.torus_dims, self.host_block))
+
+    def host_coords(self) -> List[Tuple[int, ...]]:
+        """Coordinates of every host in the host grid, lexicographic."""
+        return list(itertools.product(*(range(d) for d in self.host_grid)))
+
+    def host_name(self, coord: Tuple[int, ...]) -> str:
+        return "host-" + "-".join(str(c) for c in coord)
+
+    def host_distance(self, a: Tuple[int, ...], b: Tuple[int, ...]) -> int:
+        """Torus (wraparound) L1 distance between two hosts — the ICI hop
+        count between their blocks along the host grid."""
+        dist = 0
+        for ai, bi, di in zip(a, b, self.host_grid):
+            d = abs(ai - bi)
+            dist += min(d, di - d)
+        return dist
+
+    def contiguity_cost(self, coords: Iterable[Tuple[int, ...]]) -> int:
+        """Sum of pairwise torus distances of a host set: 0 for a single
+        host, minimal for a compact contiguous block. The placement manager
+        minimizes this per job — the TPU analog of the reference's binary
+        crossNode counter (placement_manager.go:472-477)."""
+        coords = list(coords)
+        return sum(self.host_distance(a, b)
+                   for i, a in enumerate(coords) for b in coords[i + 1:])
+
+    def slice_for(self, num_chips: int) -> Optional[SliceShape]:
+        """Best contiguous shape for num_chips on this torus, if any."""
+        shapes = feasible_shapes(num_chips, self.torus_dims)
+        return shapes[0] if shapes else None
+
+    def __str__(self) -> str:
+        """Round-trippable "4x4x4/2x2x1" form — the VODA_TOPOLOGY env
+        value backends hand to supervisors (torus dims / host block)."""
+        return (f"{'x'.join(str(d) for d in self.torus_dims)}/"
+                f"{'x'.join(str(d) for d in self.host_block)}")
+
+    @staticmethod
+    def parse(s: str) -> "PoolTopology":
+        torus, _, block = s.partition("/")
+        return PoolTopology(
+            torus_dims=tuple(int(d) for d in torus.split("x")),
+            host_block=tuple(int(d) for d in block.split("x")))
+
+
+def default_pool(num_hosts: int, chips_per_host: int = 4) -> PoolTopology:
+    """Convenience: a 1D host ring with `chips_per_host`-chip hosts — the
+    degenerate topology matching the reference's flat node list, used by
+    tests and the fake backend when no real torus is modeled."""
+    return PoolTopology(torus_dims=(num_hosts * chips_per_host,),
+                        host_block=(chips_per_host,))

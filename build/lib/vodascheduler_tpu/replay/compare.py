@@ -1,0 +1,80 @@
+"""Per-algorithm replay comparison: MEASURED gains, not inherited claims.
+
+The reference README credits its algorithms with gains inherited from the
+papers it cites (Tiresias, EDL, FfDL, AFS — BASELINE.md "per-algorithm
+gains" row: "not reproduced in repo"). This module reproduces the
+comparison for THIS framework: every registered algorithm replays the
+same trace on the same simulated pool with the same knobs, through the
+production scheduler/allocator/placement/collector code path, and the
+table lands in doc/benchmarks.md.
+
+Run:  python -m vodascheduler_tpu.replay.compare [num_jobs] [seed]
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from vodascheduler_tpu.algorithms import ALGORITHM_NAMES
+from vodascheduler_tpu.placement import PoolTopology
+from vodascheduler_tpu.replay.simulator import (
+    PreemptionEvent,
+    ReplayHarness,
+    ReplayReport,
+    config5_preemptions,
+)
+from vodascheduler_tpu.replay.trace import philly_like_trace
+
+
+def compare_algorithms(
+    num_jobs: int = 64,
+    seed: int = 20260729,
+    algorithms: Optional[Sequence[str]] = None,
+    rate_limit_seconds: float = 20.0,
+    scale_out_hysteresis: float = 1.5,
+    resize_cooldown_seconds: float = 60.0,
+    preemptions: bool = False,
+) -> List[ReplayReport]:
+    """One ReplayReport per algorithm, same trace/pool/knobs for all.
+
+    Defaults mirror bench.py's headline configuration (minus spot
+    preemption, so rigid algorithms aren't additionally penalized by
+    capacity dips they cannot react to — pass preemptions=True for the
+    full config-5 scenario).
+    """
+    reports = []
+    for name in (algorithms or ALGORITHM_NAMES):
+        trace = philly_like_trace(num_jobs=num_jobs, seed=seed)
+        topology = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+        events: Sequence[PreemptionEvent] = (
+            config5_preemptions(topology) if preemptions else ())
+        harness = ReplayHarness(
+            trace, algorithm=name, topology=topology,
+            rate_limit_seconds=rate_limit_seconds,
+            scale_out_hysteresis=scale_out_hysteresis,
+            resize_cooldown_seconds=resize_cooldown_seconds,
+            preemptions=events)
+        reports.append(harness.run())
+    return reports
+
+
+def as_rows(reports: Sequence[ReplayReport]) -> List[Dict[str, object]]:
+    return [{
+        "algorithm": r.algorithm,
+        "avg_jct_s": round(r.avg_jct_seconds, 1),
+        "p95_jct_s": round(r.p95_jct_seconds, 1),
+        "steady_state_util": round(r.steady_state_utilization, 4),
+        "makespan_s": round(r.makespan_seconds, 1),
+        "completed": r.completed,
+        "failed": r.failed,
+        "restarts": r.restarts_total,
+    } for r in reports]
+
+
+if __name__ == "__main__":
+    import sys
+    num_jobs = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 20260729
+    for row in as_rows(compare_algorithms(num_jobs=num_jobs, seed=seed)):
+        print(json.dumps(row), flush=True)

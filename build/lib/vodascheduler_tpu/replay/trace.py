@@ -1,0 +1,132 @@
+"""Workload traces: synthetic Philly-like generation + JSON round-trip.
+
+The generator reproduces the well-known statistical shape of the Microsoft
+Philly cluster traces (Jeon et al., ATC'19) that the Tiresias and AFS
+papers evaluate against: heavy-tailed job durations (most jobs are short,
+a few are enormous), small-chip-count mode with occasional large jobs, and
+Poisson arrivals. Model categories map to the baseline configs' families
+(ResNet/BERT/ViT/Llama/Mixtral; BASELINE.md configs 3-5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import random
+from typing import Dict, List, Optional, Sequence
+
+from vodascheduler_tpu.cluster.fake import WorkloadProfile
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+
+
+@dataclasses.dataclass
+class TraceJob:
+    """One submission in a trace."""
+
+    submit_offset_seconds: float
+    model: str                 # category / model family
+    min_chips: int
+    max_chips: int
+    epochs: int
+    epoch_seconds_at_1: float  # ground-truth serial epoch time
+    speedup_exponent: float = 0.9
+    priority: int = 0
+    fail_at_epoch: Optional[int] = None
+    restart_overhead_seconds: Optional[float] = None
+
+    def job_spec(self, pool: str) -> JobSpec:
+        return JobSpec(
+            name=self.model, pool=pool, priority=self.priority,
+            model=self.model,
+            config=JobConfig(min_num_chips=self.min_chips,
+                             max_num_chips=self.max_chips,
+                             epochs=self.epochs))
+
+    def profile(self) -> WorkloadProfile:
+        return WorkloadProfile(epoch_seconds_at_1=self.epoch_seconds_at_1,
+                               speedup_exponent=self.speedup_exponent,
+                               fail_at_epoch=self.fail_at_epoch,
+                               restart_overhead_seconds=self.restart_overhead_seconds)
+
+
+# Model families with serial epoch times loosely shaped like the baseline
+# configs (BASELINE.md): vision models are epoch-dominated and modest-sized;
+# LLMs have huge serial work, wide elastic chip ranges (FSDP scales), and
+# near-linear speedup at these scales. chip_k = (min, max) exponent range of
+# the job's *maximum* chips (2^k), sampled uniformly.
+MODEL_FAMILIES: Dict[str, Dict[str, object]] = {
+    "resnet50": {"epoch_seconds": 240.0, "exponent": 0.92, "weight": 0.30,
+                 "chip_k": (1, 4), "epochs_base": 30, "restart_s": 10.0},
+    "bert":     {"epoch_seconds": 480.0, "exponent": 0.90, "weight": 0.25,
+                 "chip_k": (2, 4), "epochs_base": 20, "restart_s": 15.0},
+    "vitl":     {"epoch_seconds": 900.0, "exponent": 0.90, "weight": 0.20,
+                 "chip_k": (2, 5), "epochs_base": 15, "restart_s": 20.0},
+    "llama8b":  {"epoch_seconds": 3600.0, "exponent": 0.95, "weight": 0.15,
+                 "chip_k": (4, 6), "epochs_base": 8, "restart_s": 45.0},
+    "mixtral":  {"epoch_seconds": 5400.0, "exponent": 0.93, "weight": 0.10,
+                 "chip_k": (4, 6), "epochs_base": 6, "restart_s": 60.0},
+}
+
+
+def philly_like_trace(
+    num_jobs: int = 64,
+    seed: int = 20260729,
+    arrival_rate_per_hour: float = 48.0,
+    max_job_chips: int = 64,
+    failure_fraction: float = 0.0,
+) -> List[TraceJob]:
+    """Synthesize a Philly-shaped trace.
+
+    - arrivals: Poisson process (exponential inter-arrival)
+    - chip demand: family-dependent 2^k maxima with min = max/4 elastic
+      range (Philly mode is small jobs; LLM families claim large slices)
+    - duration: log-normal heavy tail on epoch count
+    """
+    rng = random.Random(seed)
+    names = list(MODEL_FAMILIES)
+    weights = [float(MODEL_FAMILIES[m]["weight"]) for m in names]
+
+    jobs: List[TraceJob] = []
+    t = 0.0
+    for _ in range(num_jobs):
+        t += rng.expovariate(arrival_rate_per_hour / 3600.0)
+        model = rng.choices(names, weights=weights)[0]
+        fam = MODEL_FAMILIES[model]
+
+        k_lo, k_hi = fam["chip_k"]  # type: ignore[misc]
+        k_hi = min(k_hi, int(math.log2(max_job_chips)))
+        k = rng.randint(k_lo, max(k_lo, k_hi))
+        max_chips = 2 ** k
+        min_chips = max(1, max_chips // 4)
+
+        # heavy-tailed epoch count around the family base
+        duration_scale = rng.lognormvariate(0.0, 0.8)
+        epochs = max(1, int(round(float(fam["epochs_base"]) * duration_scale)))
+
+        fail_at = None
+        if failure_fraction > 0 and rng.random() < failure_fraction:
+            fail_at = max(1, epochs // 2)
+
+        jobs.append(TraceJob(
+            submit_offset_seconds=t,
+            model=model,
+            min_chips=min_chips,
+            max_chips=max_chips,
+            epochs=epochs,
+            epoch_seconds_at_1=float(fam["epoch_seconds"]),
+            speedup_exponent=float(fam["exponent"]),
+            fail_at_epoch=fail_at,
+            restart_overhead_seconds=float(fam["restart_s"]),
+        ))
+    return jobs
+
+
+def save_trace(jobs: Sequence[TraceJob], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump([dataclasses.asdict(j) for j in jobs], f, indent=1)
+
+
+def load_trace(path: str) -> List[TraceJob]:
+    with open(path) as f:
+        return [TraceJob(**d) for d in json.load(f)]

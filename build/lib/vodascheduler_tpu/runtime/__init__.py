@@ -1,0 +1,16 @@
+"""The TPU job runtime: what actually trains under scheduler control.
+
+Reference counterpart: the Elastic-Horovod training scripts + MPI-Operator
+execution substrate (SURVEY.md §3.4). TPU-native redesign: a job is a JAX
+GSPMD program on a mesh; elastic resize is checkpoint -> new mesh ->
+resharded restore -> continue (SURVEY.md §7), driven by the supervisor.
+"""
+
+from vodascheduler_tpu.runtime.train import TrainSession, make_train_setup
+from vodascheduler_tpu.runtime.checkpoint import (
+    checkpoint_nbytes,
+    latest_step,
+    list_steps,
+    restore_checkpoint,
+    save_checkpoint,
+)

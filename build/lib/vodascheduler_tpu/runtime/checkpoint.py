@@ -1,0 +1,224 @@
+"""Sharded checkpoint with reshard-on-restore: the TPU elasticity primitive.
+
+Reference counterpart: SURVEY.md §5.4 — the reference's resume is
+application-level (Keras `ModelCheckpoint` h5 + epoch recovered from the
+metrics CSV, examples/py/tensorflow2/callbacks.py:58-66), and live resize
+needs no checkpoint because Elastic Horovod keeps state in memory across
+ring re-forms. On TPU a slice-topology change restarts the JAX processes,
+so resize IS checkpoint-restart: save the GSPMD-sharded state, rebuild the
+mesh at the new chip count, and restore with each array laid out for the
+*new* sharding (Orbax reads shards directly into the new layout — no
+host-side gather of the full state).
+
+This makes elastic resize and migration the same mechanism, exactly the
+design SURVEY.md §7 calls for ("resize = restart-with-reshard").
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+STEP_DIR_RE = re.compile(r"^step_(\d{10})$")
+
+
+def _is_coordinator() -> bool:
+    """In multi-process (multi-host) jobs only process 0 touches the
+    checkpoint directory structure; orbax's own shard writes stay
+    collective."""
+    return jax.process_index() == 0
+
+
+def _sync(tag: str) -> None:
+    """Cross-process barrier (no-op single-process): renames/prunes by the
+    coordinator must not race other processes' next save/restore."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def _ensure_global(x: jax.Array) -> jax.Array:
+    """Multi-process jobs: arrays living outside jit (the PRNG key) are
+    host-local (SingleDeviceSharding), which orbax cannot serialize in a
+    multi-host setting. Every process holds the same value (the key
+    evolves deterministically outside jit), so re-placing it as a fully
+    replicated global array over all devices is value-preserving."""
+    if jax.process_count() <= 1:
+        return x
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None and not sharding.is_fully_addressable:
+        return x  # already a global array
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()), ("all",))
+    return jax.device_put(np.asarray(x), NamedSharding(mesh, PartitionSpec()))
+
+
+def _step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"step_{step:010d}")
+
+
+def list_steps(ckpt_dir: str) -> list:
+    """All checkpointed steps in ascending order."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = STEP_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(ckpt_dir, name)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = list_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+class AsyncCheckpointSaver:
+    """Checkpoint saver that overlaps disk I/O with training.
+
+    Orbax's save() contract: the device→host copy happens synchronously
+    (so jit donation of the state on the next step is safe), then shard
+    writing proceeds in a background thread. One save is in flight at a
+    time; retention pruning of older steps is deferred until the write
+    that supersedes them has committed. `wait()` (or `close()`) must run
+    before process exit — the supervisor calls it before its preemption
+    save and before reporting completion.
+    """
+
+    def __init__(self) -> None:
+        self._ckptr: Optional[ocp.StandardCheckpointer] = None
+        self._pending_retention: Optional[Tuple[str, int]] = None
+
+    def _checkpointer(self) -> ocp.StandardCheckpointer:
+        if self._ckptr is None:
+            self._ckptr = ocp.StandardCheckpointer()
+        return self._ckptr
+
+    def save(self, ckpt_dir: str, state: Any, rng: jax.Array,
+             keep_last: int = 2, wait: bool = False) -> int:
+        """Save `{state, rng}` under ckpt_dir/step_<n>; returns the step.
+
+        Crash-safety: orbax commits each save via tmp-dir rename, and the
+        tmp names never match STEP_DIR_RE, so restore never sees a
+        half-written checkpoint (the crash-consistency the reference gets
+        from Mongo + k8s idempotency, SURVEY.md §7 hard part (d)).
+        """
+        ckptr = self._checkpointer()
+        ckptr.wait_until_finished()  # one in flight; previous is committed
+        self._finish_retention()
+        rng = _ensure_global(rng)
+        step = int(state["step"])
+        path = _step_dir(ckpt_dir, step)
+        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+        if os.path.exists(path):
+            # Re-save of an existing step (e.g. preemption save right after
+            # restore): write beside it, then swap, so the old checkpoint
+            # survives a crash mid-save. The suffixed names never match
+            # STEP_DIR_RE, so a half-finished swap is invisible to restore.
+            tmp, old = path + ".new", path + ".old"
+            if _is_coordinator():
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.rmtree(old, ignore_errors=True)
+            _sync("ckpt:preclean")
+            ckptr.save(tmp, {"state": state, "rng": rng})
+            ckptr.wait_until_finished()
+            if _is_coordinator():
+                os.rename(path, old)
+                os.rename(tmp, path)
+                shutil.rmtree(old)
+                self._prune(ckpt_dir, keep_last)
+            _sync("ckpt:swap")
+        else:
+            ckptr.save(path, {"state": state, "rng": rng})
+            self._pending_retention = (ckpt_dir, keep_last)
+            if wait:
+                self.wait()
+        return step
+
+    def _prune(self, ckpt_dir: str, keep_last: int) -> None:
+        if not _is_coordinator():
+            return
+        steps = list_steps(ckpt_dir)
+        for old in steps[:-keep_last] if keep_last > 0 else []:
+            shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+
+    def _finish_retention(self) -> None:
+        if self._pending_retention is not None:
+            ckpt_dir, keep_last = self._pending_retention
+            self._pending_retention = None
+            self._prune(ckpt_dir, keep_last)
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) has committed."""
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+        self._finish_retention()
+
+    def close(self) -> None:
+        self.wait()
+        if self._ckptr is not None:
+            self._ckptr.close()
+            self._ckptr = None
+
+    def __enter__(self) -> "AsyncCheckpointSaver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, rng: jax.Array,
+                    keep_last: int = 2) -> int:
+    """Synchronous one-shot save (see AsyncCheckpointSaver for the
+    overlapped path the supervisor uses)."""
+    with AsyncCheckpointSaver() as saver:
+        return saver.save(ckpt_dir, state, rng, keep_last=keep_last,
+                          wait=True)
+
+
+def _abstract_target(setup, rng_like: jax.Array) -> Any:
+    """Shape/dtype/sharding skeleton for restore: state laid out for the
+    (possibly different) mesh in `setup`, rng replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    state_abs = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        setup.eval_shape_state, setup.state_shardings)
+    rng_abs = jax.ShapeDtypeStruct(
+        rng_like.shape, rng_like.dtype,
+        sharding=NamedSharding(setup.mesh, PartitionSpec()))
+    return {"state": state_abs, "rng": rng_abs}
+
+
+def restore_checkpoint(ckpt_dir: str, setup,
+                       step: Optional[int] = None) -> Tuple[Any, jax.Array]:
+    """Restore (state, rng), resharding every array onto `setup`'s mesh.
+
+    `setup` may be built for a different chip count than the checkpoint
+    was saved from — that is the whole point.
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    path = _step_dir(ckpt_dir, step)
+    rng_like = jax.random.PRNGKey(0)
+    target = _abstract_target(setup, rng_like)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, target)
+    return restored["state"], restored["rng"]
+
+
+def checkpoint_nbytes(state: Any) -> int:
+    """Total checkpoint payload size — drives restart-cost modeling."""
+    return sum(int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(state))

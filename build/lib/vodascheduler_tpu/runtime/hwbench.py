@@ -1,0 +1,446 @@
+"""Hardware benchmarks: measured step time / MFU on the real TPU chip.
+
+This is the perf half the reference never published (its README and
+doc/prometheus-metrics-exposed.md describe utilization metrics but no
+model numbers): wall-clock step time, tokens/sec and achieved MFU for
+registry models, and a flash-attention-vs-XLA kernel comparison — all
+measured on whatever accelerator `jax.devices()` exposes, never simulated.
+
+Timing methodology — two-point scan differencing: the remote-TPU
+transport (and any async dispatch layer) adds per-call latency that a
+naive `block_until_ready` loop measures as step time. Instead, K steps
+run inside ONE jitted `lax.scan`, the result is fetched to host (a
+device->host copy cannot complete before the computation), and the
+per-step time is (t(K_big) - t(K_small)) / (K_big - K_small): fixed
+dispatch/fetch overhead appears in both and cancels exactly. This is
+also the production loop shape — TPU training loops scan/fuse steps
+rather than dispatching one kernel per step.
+
+MFU convention: analytic model FLOPs (PaLM appendix B):
+  6 * params * tokens  +  12 * L * d_model * B * S^2
+(the attention term counts the full S^2 score matrix, causal or not —
+the standard convention, so numbers are comparable to published MFU
+figures). Peak chip FLOP/s comes from the device kind; bf16 peak.
+
+These functions are imported by bench.py (the driver's entry point) and
+runnable standalone:  python -m vodascheduler_tpu.runtime.hwbench
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by device kind (vendor-published numbers).
+# v2/v3 device_kind strings report per-core; JAX exposes one device per
+# core there, so per-device peaks are halved chip peaks.
+PEAK_FLOPS: Dict[str, float] = {
+    "TPU v2": 22.5e12,          # per core (45 TF/chip, 2 cores)
+    "TPU v3": 61.5e12,          # per core (123 TF/chip)
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,      # v5e
+    "TPU v5": 459e12,           # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,      # v6e (Trillium)
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_device(default: float = 197e12) -> float:
+    kind = jax.devices()[0].device_kind
+    matches = [n for n in PEAK_FLOPS if kind.startswith(n)]
+    if matches:
+        # Longest-prefix match: "TPU v5 lite" must not hit "TPU v5".
+        return PEAK_FLOPS[max(matches, key=len)]
+    return default
+
+
+def count_params(tree: Any) -> int:
+    return sum(x.size for x in jax.tree.leaves(tree) if hasattr(x, "size"))
+
+
+def count_params_active(tree: Any, top_k: int, num_experts: int) -> int:
+    """Per-token *active* params for MoE trees: expert leaves (param path
+    contains 'experts_', the MoEBlock naming) count at top_k/E weight —
+    the standard MoE-MFU convention (analytic FLOPs price only routed
+    compute). Equals count_params for dense trees."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    total = expert = 0
+    for path, leaf in flat:
+        if not hasattr(leaf, "size"):
+            continue
+        total += leaf.size
+        if any("experts_" in str(key) for key in path):
+            expert += leaf.size
+    return int(total - expert + expert * top_k / num_experts)
+
+
+def transformer_step_flops(num_params: int, num_layers: int, d_model: int,
+                           batch: int, seq: int) -> float:
+    """Fwd+bwd FLOPs for one LM/encoder step (PaLM appendix-B convention)."""
+    tokens = batch * seq
+    return (6.0 * num_params * tokens
+            + 12.0 * num_layers * d_model * batch * seq ** 2)
+
+
+def _fetch(x) -> float:
+    """Force execution by copying a scalar to host."""
+    return float(np.asarray(x))
+
+
+def time_per_iteration(make_scanned: Callable[[int], Callable[[], Any]],
+                       k_small: int = 2, k_big: int = 10,
+                       reps: int = 3) -> float:
+    """Median per-iteration seconds via two-point scan differencing.
+
+    `make_scanned(k)` returns a zero-arg callable running k iterations on
+    device and returning a scalar; its first call may compile.
+    """
+    medians = {}
+    for k in (k_small, k_big):
+        fn = make_scanned(k)
+        _fetch(fn())  # compile + warm
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _fetch(fn())
+            samples.append(time.perf_counter() - t0)
+        medians[k] = statistics.median(samples)
+    return max((medians[k_big] - medians[k_small]) / (k_big - k_small), 1e-9)
+
+
+@dataclasses.dataclass
+class StepBenchResult:
+    model: str
+    batch: int
+    seq: int
+    step_time_ms: float
+    tokens_per_sec: float
+    model_tflops_per_step: float
+    achieved_tflops: float
+    mfu: float
+    num_params: int
+    device_kind: str
+    num_params_active: int = 0  # < num_params only for MoE models
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k in ("step_time_ms", "tokens_per_sec", "model_tflops_per_step",
+                  "achieved_tflops"):
+            d[k] = round(d[k], 2)
+        d["mfu"] = round(d["mfu"], 4)
+        return d
+
+
+# Model-structure metadata for the analytic FLOPs formula; registry
+# bundles don't expose layer/dim counts uniformly, configs do.
+def _lm_structure(model_name: str) -> Tuple[int, int]:
+    """(num_layers, d_model) for analytic attention FLOPs."""
+    from vodascheduler_tpu.models import bert, llama, mixtral, vit
+    table = {
+        "llama3_8b": (llama.LLAMA3_8B.num_layers, llama.LLAMA3_8B.dim),
+        "llama_350m": (llama.LLAMA_350M.num_layers, llama.LLAMA_350M.dim),
+        "llama_350m_8k": (llama.LLAMA_350M_8K.num_layers,
+                          llama.LLAMA_350M_8K.dim),
+        "llama_tiny": (llama.LLAMA_TINY.num_layers, llama.LLAMA_TINY.dim),
+        "bert_base": (bert.BERT_BASE.num_layers, bert.BERT_BASE.dim),
+        "bert_tiny": (bert.BERT_TINY.num_layers, bert.BERT_TINY.dim),
+        "mixtral_8x7b": (mixtral.MIXTRAL_8X7B_LIKE.num_layers,
+                         mixtral.MIXTRAL_8X7B_LIKE.dim),
+        "mixtral_small": (mixtral.MIXTRAL_SMALL.num_layers,
+                          mixtral.MIXTRAL_SMALL.dim),
+        "mixtral_tiny": (mixtral.MIXTRAL_TINY.num_layers,
+                         mixtral.MIXTRAL_TINY.dim),
+        "vit_l16": (vit.VIT_L16.num_layers, vit.VIT_L16.dim),
+    }
+    if model_name not in table:
+        raise ValueError(f"no FLOPs structure for {model_name}")
+    return table[model_name]
+
+
+def bench_model_step(model_name: str, global_batch_size: int,
+                     k_small: int = 2, k_big: int = 10,
+                     num_chips: int = 1,
+                     bundle: Optional[Any] = None) -> StepBenchResult:
+    """Time the full train step (fwd+bwd+optimizer) on hardware.
+
+    K steps run inside one jitted scan over the raw step fn (state carries
+    across iterations — a genuine training trajectory, nothing for XLA to
+    hoist); one fixed on-device batch is reused so the measurement is pure
+    step time, matching the supervisor's CSV timing contract
+    (runtime/supervisor.py excludes input pipeline the same way).
+    `bundle` overrides the registry lookup (bench_moe_dispatch passes
+    config variants); `model_name` still keys the FLOPs structure.
+    """
+    from vodascheduler_tpu.models.registry import get_model
+    from vodascheduler_tpu.runtime.train import make_train_setup
+
+    if bundle is None:
+        bundle = get_model(model_name)
+    setup = make_train_setup(bundle, num_chips,
+                             global_batch_size=global_batch_size)
+    state0 = setup.init_fn(jax.random.PRNGKey(0))
+    batch = setup.make_batch(global_batch_size, jax.random.PRNGKey(1))
+
+    def make_scanned(k: int):
+        def run_k(state, batch):
+            def body(st, _):
+                st, loss = setup.train_step_raw(st, batch)
+                return st, loss
+            _, losses = jax.lax.scan(body, state, None, length=k)
+            return losses[-1]
+
+        fn = jax.jit(run_k, in_shardings=(setup.state_shardings,
+                                          setup.batch_shardings))
+
+        def call():
+            # Trace/compile (first call) must run under the mesh context,
+            # exactly like train.py's _under_mesh: bare-PartitionSpec
+            # activation constraints no-op otherwise and the measured
+            # program would differ from the production one.
+            with setup.mesh:
+                return fn(state0, batch)
+        return call
+
+    step_s = time_per_iteration(make_scanned)
+    seq = bundle.seq_len or 1
+    n_layers, d_model = _lm_structure(model_name)
+    n_params = count_params(state0["params"])
+    # MoE: analytic FLOPs price only the routed (active) compute.
+    cfg = getattr(bundle.module, "cfg", None)
+    if bundle.num_experts and getattr(cfg, "top_k", 0):
+        n_active = count_params_active(state0["params"], cfg.top_k,
+                                       cfg.num_experts)
+    else:
+        n_active = n_params
+    flops = transformer_step_flops(n_active, n_layers, d_model,
+                                   global_batch_size, seq)
+    peak = peak_flops_per_device() * num_chips
+    return StepBenchResult(
+        model=model_name, batch=global_batch_size, seq=seq,
+        step_time_ms=step_s * 1e3,
+        tokens_per_sec=global_batch_size * seq / step_s,
+        model_tflops_per_step=flops / 1e12,
+        achieved_tflops=flops / step_s / 1e12,
+        mfu=flops / step_s / peak,
+        num_params=n_params,
+        num_params_active=n_active,
+        device_kind=jax.devices()[0].device_kind)
+
+
+def bench_attention_point(batch: int, seq: int, heads: int = 16,
+                          head_dim: int = 64, causal: bool = True
+                          ) -> Dict[str, Any]:
+    """Flash (Pallas) vs XLA-softmax attention, fwd+bwd, one shape point.
+
+    The scan body perturbs q by (1 + loss*0) — numerically exactly q, but
+    data-dependent on the carried loss so XLA cannot hoist the attention
+    out of the loop as loop-invariant. The carry also folds in one
+    element of each gradient (scaled by 1e-30): grads whose values never
+    reach the output are dead code XLA deletes, which silently turns a
+    "fwd+bwd" measurement into fwd-only — caught by an r3 trace of the
+    full model, where the backward kernels are very much alive.
+    """
+    from vodascheduler_tpu.ops.flash_attention import flash_attention
+    from vodascheduler_tpu.parallel.ring_attention import reference_attention
+
+    qkv = [jax.random.normal(jax.random.PRNGKey(i),
+                             (batch, seq, heads, head_dim),
+                             dtype=jnp.bfloat16) for i in range(3)]
+
+    results: Dict[str, Any] = {"batch": batch, "seq": seq, "heads": heads,
+                               "head_dim": head_dim, "causal": causal}
+    for name, attn in (("flash", flash_attention),
+                       ("xla", reference_attention)):
+        def loss_fn(q, k, v, attn=attn):
+            return attn(q, k, v, causal=causal).astype(jnp.float32).sum()
+
+        vg = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))
+
+        def make_scanned(k_iters: int, vg=vg):
+            def run(q, k, v):
+                def body(carry, _):
+                    q_dep = q * (1.0 + carry * 0.0).astype(q.dtype)
+                    loss, grads = vg(q_dep, k, v)
+                    g0 = sum(g.ravel()[0].astype(jnp.float32)
+                             for g in grads)
+                    return loss + 1e-30 * g0, None
+                final, _ = jax.lax.scan(body, jnp.float32(0.0), None,
+                                        length=k_iters)
+                return final
+            fn = jax.jit(run)
+            return lambda: fn(*qkv)
+
+        it_s = time_per_iteration(make_scanned, k_small=2, k_big=8)
+        results[f"{name}_ms"] = round(it_s * 1e3, 3)
+    results["flash_speedup"] = round(results["xla_ms"] / results["flash_ms"],
+                                     3)
+    return results
+
+
+def bench_moe_dispatch(global_batch_size: int = 8,
+                       model_name: str = "mixtral_small",
+                       base_cfg: Optional[Any] = None) -> Dict[str, Any]:
+    """MoE dispatch comparison, full train step: gather vs routed-einsum
+    vs dense on the same model (only MixtralConfig.dispatch differs).
+
+    The MoE analogue of the flash-vs-XLA comparison. Dense computes every
+    expert on every token (E/top_k more expert FLOPs); gather moves
+    routed tokens by scatter/gather (the single-chip dispatch); routed
+    is the GShard one-hot-einsum formulation whose dispatch matmuls only
+    amortize under ep sharding — measuring all three on one chip prices
+    each honestly. Per-dispatch isolation: one variant OOMing must not
+    void the others.
+    """
+    import dataclasses as _dc
+
+    from vodascheduler_tpu.models import mixtral
+    from vodascheduler_tpu.models.registry import get_model
+
+    if base_cfg is None:
+        base_cfg = mixtral.MIXTRAL_SMALL
+    out: Dict[str, Any] = {}
+    for dispatch in ("gather", "routed", "dense"):
+        try:
+            bundle = get_model(model_name)
+            bundle.module = mixtral.Mixtral(
+                _dc.replace(base_cfg, dispatch=dispatch))
+            res = bench_model_step(model_name, global_batch_size,
+                                   bundle=bundle)
+            if dispatch == "gather":
+                out["gather"] = res.as_dict()  # full MFU record
+            else:
+                out[f"{dispatch}_step_ms"] = round(res.step_time_ms, 2)
+        except Exception as e:  # noqa: BLE001
+            out[dispatch if dispatch == "gather"
+                else f"{dispatch}_step_ms"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    gather_ms = (out.get("gather") or {}).get("step_time_ms")
+    dense_ms = out.get("dense_step_ms")
+    if isinstance(gather_ms, (int, float)) and isinstance(dense_ms,
+                                                          (int, float)):
+        out["gather_speedup_vs_dense"] = round(dense_ms / gather_ms, 3)
+    return out
+
+
+DEFAULT_ATTENTION_POINTS: Sequence[Tuple[int, int]] = (
+    (8, 1024), (4, 2048), (2, 4096), (1, 8192))
+
+
+def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
+        ("llama_350m", 8),),
+        attention_points: Sequence[Tuple[int, int]] = DEFAULT_ATTENTION_POINTS,
+        moe_batch: Optional[int] = 8,
+        emit: Optional[Callable[[str, Any], None]] = None,
+        ) -> Dict[str, Any]:
+    """The full hardware section for bench.py.
+
+    Never simulated: raises off-accelerator unless VODA_HWBENCH_ON_CPU=1
+    (tests use that escape hatch with tiny shapes). `emit(kind, payload)`
+    is called after each completed item — the --stream mode bench.py's
+    subprocess isolation relies on (completed points survive even if a
+    later remote compile wedges and the process is killed).
+    """
+    import os
+    backend = jax.default_backend()
+    if backend not in ("tpu", "gpu") and not os.environ.get(
+            "VODA_HWBENCH_ON_CPU"):
+        raise RuntimeError(
+            f"hardware bench requires an accelerator (backend={backend}); "
+            "set VODA_HWBENCH_ON_CPU=1 to smoke-test on CPU")
+    emit = emit or (lambda kind, payload: None)
+    out: Dict[str, Any] = {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": backend,
+        "peak_bf16_tflops_per_chip": peak_flops_per_device() / 1e12,
+        "models": [],
+        "attention": [],
+    }
+    emit("meta", {k: out[k] for k in ("device_kind", "backend",
+                                      "peak_bf16_tflops_per_chip")})
+    # Per-point isolation: one failing shape/kernel must not void the
+    # rest of the hardware section (this runs unattended at round end).
+    for model_name, bsz in model_points:
+        try:
+            out["models"].append(bench_model_step(model_name, bsz).as_dict())
+        except Exception as e:  # noqa: BLE001
+            # Retry on the XLA attention path: a Pallas-kernel failure
+            # should still yield a measured MFU number.
+            os.environ["VODA_FLASH_ATTENTION"] = "0"
+            try:
+                res = bench_model_step(model_name, bsz).as_dict()
+                res["note"] = (f"flash path failed "
+                               f"({type(e).__name__}: {e}); XLA attention")
+                out["models"].append(res)
+            except Exception as e2:  # noqa: BLE001
+                out["models"].append({
+                    "model": model_name, "batch": bsz,
+                    "error": f"{type(e2).__name__}: {e2}"})
+            finally:
+                os.environ.pop("VODA_FLASH_ATTENTION", None)
+        emit("model", out["models"][-1])
+    for bsz, seq in attention_points:
+        try:
+            out["attention"].append(bench_attention_point(bsz, seq))
+        except Exception as e:  # noqa: BLE001
+            out["attention"].append({
+                "batch": bsz, "seq": seq,
+                "error": f"{type(e).__name__}: {e}"})
+        emit("attention", out["attention"][-1])
+    if moe_batch:
+        try:
+            out["moe"] = bench_moe_dispatch(moe_batch)
+        except Exception as e:  # noqa: BLE001
+            out["moe"] = {"error": f"{type(e).__name__}: {e}"}
+        emit("moe", out["moe"])
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """`python -m vodascheduler_tpu.runtime.hwbench [--stream] [args...]`
+
+    --stream prints one JSON line per completed item ({"kind", "data"})
+    instead of one pretty dict at the end — bench.py runs this module as
+    a subprocess in stream mode so a wedged remote compile (which blocks
+    in native code where no signal can interrupt) costs only the
+    unfinished points: the parent kills the child at its deadline and
+    keeps every line already flushed. Extra args are a JSON object of
+    run_hardware_bench kwargs (model_points etc.).
+    """
+    import json
+    import os
+    import sys
+
+    # Honor JAX_PLATFORMS=cpu even when a TPU plugin registered itself
+    # eagerly (the axon tunnel does): the config API call wins over the
+    # env var alone — without this, a hermetic child process silently
+    # targets (and can hang on) the real accelerator. Same workaround as
+    # __graft_entry__.py.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    args = list(sys.argv[1:] if argv is None else argv)
+    stream = "--stream" in args
+    if stream:
+        args.remove("--stream")
+    kwargs = json.loads(args[0]) if args else {}
+    if "model_points" in kwargs:
+        kwargs["model_points"] = [tuple(p) for p in kwargs["model_points"]]
+    if "attention_points" in kwargs:
+        kwargs["attention_points"] = [tuple(p)
+                                      for p in kwargs["attention_points"]]
+    if stream:
+        def emit(kind, payload):
+            print(json.dumps({"kind": kind, "data": payload}), flush=True)
+        run_hardware_bench(emit=emit, **kwargs)
+    else:
+        print(json.dumps(run_hardware_bench(**kwargs), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,296 @@
+"""Per-job worker supervisor: the process that actually trains.
+
+Reference counterpart: the Elastic-Horovod worker launched by `horovodrun`
+inside an MPIJob (SURVEY.md §3.4 — examples/py/tensorflow2/
+tensorflow2_keras_mnist_elastic.py:75-195). TPU-native redesign:
+
+- One supervisor process per job (per host in multi-host mode); the GSPMD
+  mesh inside it replaces the Horovod ring. There is no in-place ring
+  re-form: a resize means the backend stops this process (SIGTERM ->
+  checkpoint -> exit) and starts a new one at the new chip count, which
+  restores with resharding (runtime/checkpoint.py).
+- Resume epoch comes from the training step in the checkpoint, not a CSV
+  replay (the reference recovers the epoch from its metrics CSV,
+  callbacks.py:58-66 — a workaround for h5 checkpoints carrying no step).
+- Per-epoch telemetry rows go to `<metrics_dir>/<job>.csv` with the
+  reference's columns (callbacks.py:104-154) for the metrics collector.
+
+Exit codes: 0 = training complete; PREEMPTED_EXIT_CODE = checkpointed and
+exited on request (resize/halt/migration); anything else = failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+# Chunk size between stop-flag checks: small enough that SIGTERM turns into
+# a checkpoint promptly, big enough to amortize dispatch overhead.
+STEPS_PER_CHUNK = 10
+
+
+def _configure_devices() -> None:
+    """Hermetic mode: VODA_FORCE_CPU_DEVICES=N gives this process an
+    N-device virtual CPU mesh (tests / machines without TPU). On real TPU
+    hardware leave it unset."""
+    n = os.environ.get("VODA_FORCE_CPU_DEVICES")
+    if n:
+        # Replace any inherited device-count flag: the backend's requested
+        # mesh size wins over whatever the parent shell exported.
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host: the backend issues a coordinator address (the TPU-native
+    replacement for the MPI hostfile + discovery script, SURVEY.md §2.3)."""
+    coord = os.environ.get("VODA_COORDINATOR_ADDRESS")
+    if coord:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["VODA_NUM_PROCESSES"]),
+            process_id=int(os.environ["VODA_PROCESS_ID"]))
+
+
+def load_bundle(spec):
+    """Resolve the job's ModelBundle: a user script, or the registry.
+
+    `spec.extra["script"]` names a Python file defining `get_model(spec)`
+    (or argless `get_model()`) returning a ModelBundle — the TPU-native
+    counterpart of the reference's user-supplied Horovod training scripts
+    (examples/py/*): users bring their own model/data/loss, the framework
+    owns the elastic run loop around it.
+    """
+    script = spec.extra.get("script", "")
+    if not script:
+        from vodascheduler_tpu.models import get_model
+        return get_model(spec.model)
+
+    import importlib.util
+    import inspect
+
+    path = _resolve_script(script)
+    mod_name = "voda_user_script_" + os.path.splitext(os.path.basename(path))[0]
+    spec_obj = importlib.util.spec_from_file_location(mod_name, path)
+    if spec_obj is None or spec_obj.loader is None:
+        raise FileNotFoundError(f"user script not loadable: {path}")
+    module = importlib.util.module_from_spec(spec_obj)
+    sys.modules[mod_name] = module
+    spec_obj.loader.exec_module(module)
+    get = getattr(module, "get_model", None)
+    if get is None:
+        raise AttributeError(f"user script {path} must define get_model()")
+    if inspect.signature(get).parameters:
+        return get(spec)
+    return get()
+
+
+def _resolve_script(script: str) -> str:
+    """A relative script path is tried against the supervisor's cwd, then
+    the repo root (parent of the installed package) — so shipped example
+    specs work regardless of where the server was started."""
+    if os.path.isabs(script):
+        return script
+    candidates = [os.path.abspath(script)]
+    import vodascheduler_tpu
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.abspath(vodascheduler_tpu.__file__)))
+    candidates.append(os.path.join(pkg_parent, script))
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    raise FileNotFoundError(
+        f"user script {script!r} not found (tried: {candidates})")
+
+
+def run_job(workdir: str, num_chips: int,
+            metrics_dir: Optional[str] = None) -> int:
+    """Train the job described by `<workdir>/spec.json` at num_chips until
+    its epoch budget completes, checkpointing every epoch."""
+    _configure_devices()
+    _maybe_init_distributed()
+
+    import jax
+    from vodascheduler_tpu.common.job import JobSpec
+    from vodascheduler_tpu.metricscollector.csv_logger import EpochCsvLogger
+    from vodascheduler_tpu.runtime import latest_step
+    from vodascheduler_tpu.runtime.train import TrainSession
+
+    with open(os.path.join(workdir, "spec.json")) as f:
+        spec = JobSpec.from_dict(json.load(f))
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    metrics_dir = metrics_dir or os.path.join(workdir, "metrics")
+    bundle = load_bundle(spec)
+
+    stop_requested = {"flag": False}
+
+    def on_sigterm(signum, frame):
+        stop_requested["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    signal.signal(signal.SIGINT, on_sigterm)
+
+    devices = jax.devices()[:num_chips]
+    if len(devices) < num_chips:
+        print(f"supervisor: need {num_chips} devices, have {len(devices)}",
+              file=sys.stderr)
+        return 2
+
+    # Pool topology from the backend (VODA_TOPOLOGY="4x4x4/2x2x1"): mesh
+    # planning then respects the pool's real host block (tp intra-host)
+    # and the allocator's feasibility-rounded slice shape for this grant.
+    topology = None
+    topo_env = os.environ.get("VODA_TOPOLOGY")
+    if topo_env:
+        from vodascheduler_tpu.placement.topology import PoolTopology
+        topology = PoolTopology.parse(topo_env)
+
+    if latest_step(ckpt_dir) is not None:
+        session = TrainSession.resume(
+            bundle, num_chips, ckpt_dir, devices=devices,
+            global_batch_size=spec.global_batch_size, topology=topology)
+    else:
+        session = TrainSession(bundle, num_chips, devices=devices,
+                               global_batch_size=spec.global_batch_size,
+                               topology=topology)
+
+    steps_per_epoch = max(1, spec.steps_per_epoch)
+    total_steps = spec.config.epochs * steps_per_epoch
+    # Multi-host: every process trains (the collectives are global), but
+    # only process 0 owns the job's telemetry CSV — one row per epoch per
+    # job, whatever the process count (the reference's CSV has one writer
+    # per job too: the rank-0 Keras callback, callbacks.py:104-154).
+    logger = None
+    if jax.process_index() == 0:
+        logger = EpochCsvLogger(metrics_dir, spec.name,
+                                total_epochs=spec.config.epochs,
+                                global_batch_size=spec.global_batch_size)
+        # Trust the checkpoint for position; the CSV may lag a crash.
+        logger.next_epoch = session.step // steps_per_epoch
+
+    # The first step after every (re)build compiles the resharded XLA
+    # program (20-40s on TPU). It must not enter the telemetry: the
+    # collector's speedup curves are per-chip-count epoch-time means, and
+    # a compile-poisoned first epoch feeds a negative marginal gain into
+    # every info-based algorithm right after a resize — the opposite of
+    # what the resize earned. So one warmup step runs untimed, and epoch
+    # time is extrapolated from the timed steps (the fake backend models
+    # clean epoch times the same way, cluster/fake.py).
+    # On-demand profiling (VODA_PROFILE=1): process 0 captures an XLA
+    # trace of the first timed chunk after warmup into
+    # <workdir>/profile/ — viewable with xprof/tensorboard. The TPU
+    # profiler prices each op (MXU utilization, HBM traffic, infeed
+    # stalls), which the step-time CSV can't attribute. One chunk only:
+    # trace files grow with captured ops, not wall time, and the job
+    # must not pay collection overhead every epoch.
+    profile_pending = (os.environ.get("VODA_PROFILE") == "1"
+                       and jax.process_index() == 0)
+    profile_dir = os.path.join(workdir, "profile")
+
+    warmup_pending = True
+    warmup_step_time = 0.0
+    while session.step < total_steps:
+        epoch_end_step = min(total_steps,
+                             (session.step // steps_per_epoch + 1)
+                             * steps_per_epoch)
+        steps_this_epoch = epoch_end_step - session.step
+        if warmup_pending:
+            t0 = time.monotonic()
+            session.run_steps(1)
+            warmup_step_time = time.monotonic() - t0
+            warmup_pending = False
+        timed_steps = 0
+        timed_time = 0.0
+        profiled_steps = 0
+        profiled_time = 0.0
+        while session.step < epoch_end_step:
+            if stop_requested["flag"]:
+                # Durable before exit (save itself drains any still-flying
+                # per-epoch write first, then waits for this one).
+                session.save(ckpt_dir, wait=True)
+                session.finish_saves()
+                return PREEMPTED_EXIT_CODE
+            n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
+            if profile_pending:
+                # Profiler calls are best-effort (remote-TPU transports
+                # may not support device tracing; the job must train
+                # regardless) — but the training steps themselves are
+                # NOT: their errors propagate, and stop_trace runs in a
+                # finally so a failed chunk can't leave the profiler
+                # collecting for the rest of the job.
+                profile_pending = False
+                started = False
+                try:
+                    jax.profiler.start_trace(profile_dir)
+                    started = True
+                except Exception as e:  # noqa: BLE001
+                    print(f"supervisor: profiling failed ({e})",
+                          file=sys.stderr)
+                t0 = time.monotonic()
+                try:
+                    session.run_steps(n)
+                finally:
+                    if started:
+                        try:
+                            jax.profiler.stop_trace()
+                        except Exception as e:  # noqa: BLE001
+                            print(f"supervisor: stop_trace failed ({e})",
+                                  file=sys.stderr)
+                # The profiled chunk enters telemetry only as a last
+                # resort (collection overhead must not skew the epoch
+                # CSV) — but it is still post-compile, so it beats the
+                # warmup fallback when it's the only sample.
+                profiled_time += time.monotonic() - t0
+                profiled_steps += n
+                continue
+            t0 = time.monotonic()
+            session.run_steps(n)
+            timed_time += time.monotonic() - t0
+            timed_steps += n
+        # Fallback order when an epoch has no cleanly-timed steps: the
+        # profiled chunk (post-compile, trace overhead included) beats
+        # the warmup step (compile-inclusive — the speedup-curve poison
+        # the warmup machinery exists to keep out of the CSV).
+        if timed_steps:
+            step_time = timed_time / timed_steps
+        elif profiled_steps:
+            step_time = profiled_time / profiled_steps
+        else:
+            step_time = warmup_step_time
+        if logger is not None:
+            logger.log_epoch(epoch_time_sec=step_time * steps_this_epoch,
+                             step_time_sec=step_time,
+                             workers=num_chips,
+                             start_time=str(time.time()))
+        # Async: the next epoch's compute overlaps this save's shard
+        # writes (the device->host copy is synchronous inside save).
+        session.save(ckpt_dir, wait=False)
+
+    session.finish_saves()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--num-chips", type=int, required=True)
+    parser.add_argument("--metrics-dir", default=None)
+    args = parser.parse_args(argv)
+    return run_job(args.workdir, args.num_chips, metrics_dir=args.metrics_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

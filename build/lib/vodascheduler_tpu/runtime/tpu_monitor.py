@@ -1,0 +1,82 @@
+"""TPU chip telemetry exporter.
+
+Reference counterpart: Voda delegates GPU hardware monitoring to the
+author's separate nvidia_smi_exporter (README.md:94, SURVEY.md §5.5). The
+TPU-native equivalent lives in-process: libtpu reports per-device memory
+through jax (`device.memory_stats()`), and this monitor publishes it as
+labeled Prometheus gauges on the control plane's existing /metrics
+endpoints — no sidecar exporter to deploy.
+
+Driving: the monitor has no timer of its own — a driver calls
+`collect_once()` on its schedule (the service daemon's periodic list, or
+VirtualClock timers in tests).
+
+Ownership caveat: on a real TPU host libtpu grants the chips to ONE
+process. The control plane colocated with training supervisors must NOT
+initialize the backend itself, so VodaApp enables the periodic collection
+only in hermetic (CPU-mesh) mode or under VODA_TPU_MONITOR=1 (for
+deployments where the control plane runs off-host from the workers).
+
+Off-TPU (CPU test platform) `memory_stats()` returns nothing useful; the
+monitor then exports only the device-count gauge, so the same wiring runs
+hermetically.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from vodascheduler_tpu.common.metrics import Registry
+
+log = logging.getLogger(__name__)
+
+# libtpu/XLA memory_stats keys -> metric series
+_STAT_SERIES = (
+    ("bytes_in_use", "voda_tpu_memory_bytes_in_use"),
+    ("bytes_limit", "voda_tpu_memory_bytes_limit"),
+    ("peak_bytes_in_use", "voda_tpu_memory_peak_bytes_in_use"),
+    ("largest_free_block_bytes",
+     "voda_tpu_memory_largest_free_block_bytes"),
+)
+
+
+class TpuMonitor:
+    """Polls local device memory stats into labeled gauges."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        self.m_devices = registry.gauge(
+            "voda_tpu_devices",
+            "Number of local accelerator devices visible to the runtime")
+        self.m_mem = {
+            series: registry.gauge(
+                series,
+                f"Per-device memory stat {key} as reported by the runtime",
+                labels=("device", "platform"))
+            for key, series in _STAT_SERIES
+        }
+
+    def collect_once(self) -> None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # no backend available at all
+            log.exception("device discovery failed")
+            devices = []
+        self.m_devices.set(float(len(devices)))
+        # Full rebuild, swapped in atomically per series: devices that
+        # vanished stop exporting, and a concurrent scrape never sees a
+        # half-cleared label set.
+        new_values = {series: {} for _, series in _STAT_SERIES}
+        for d in devices:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            for key, series in _STAT_SERIES:
+                if key in stats:
+                    new_values[series][(str(d.id), d.platform)] = \
+                        float(stats[key])
+        for series, values in new_values.items():
+            self.m_mem[series].set_all(values)

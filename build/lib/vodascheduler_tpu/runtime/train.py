@@ -1,0 +1,327 @@
+"""GSPMD training core: sharded state init + jitted train step.
+
+The scaling-book recipe, executed: plan a mesh for the chip count
+(parallel/mesh.py), derive every array's sharding from path rules
+(parallel/sharding.py — the same rules shard params, Adam moments, and
+batches), jit one train step with those shardings and let XLA insert the
+collectives (psum/reduce-scatter/all-gather ride the mesh axes). No
+pmap, no manual collectives in the loss path; ring attention (shard_map)
+slots in only when the mesh has a real `sp` axis.
+
+Elasticity contract: everything here is a pure function of (bundle,
+num_chips) — resizing a job rebuilds TrainSession at the new count and
+restores the checkpoint with resharding (checkpoint.py), exactly the
+restart-with-reshard design SURVEY.md §7 calls for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from vodascheduler_tpu.models.registry import ModelBundle
+from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
+from vodascheduler_tpu.parallel.ring_attention import make_ring_attention
+from vodascheduler_tpu.parallel.sharding import (
+    batch_sharding,
+    param_shardings,
+)
+
+
+def _flash_attention_enabled() -> bool:
+    """Default: Pallas flash attention on TPU, XLA path elsewhere.
+    VODA_FLASH_ATTENTION=1 forces it on (interpreter mode off-TPU, for
+    tests); =0 forces the XLA path."""
+    flag = os.environ.get("VODA_FLASH_ATTENTION", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+@dataclasses.dataclass
+class TrainSetup:
+    """Everything needed to run sharded steps for (bundle, mesh)."""
+
+    mesh: Any
+    plan: MeshPlan
+    state_shardings: Any
+    batch_shardings: Any
+    init_fn: Callable[[jax.Array], Any]          # rng -> sharded state
+    train_step: Callable[[Any, Any], Tuple[Any, jax.Array]]
+    make_batch: Callable[[int, jax.Array], Any]  # sharded synthetic batch
+    eval_shape_state: Any
+    # Un-jitted step, for callers that fuse their own loop around it
+    # (hwbench scans K steps inside one jit to amortize dispatch overhead).
+    train_step_raw: Optional[Callable[[Any, Any],
+                                      Tuple[Any, jax.Array]]] = None
+
+
+def make_train_setup(bundle: ModelBundle, num_chips: int,
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     learning_rate: float = 1e-3,
+                     plan: Optional[MeshPlan] = None,
+                     global_batch_size: int = 8,
+                     topology: Optional[Any] = None) -> TrainSetup:
+    devices = list(devices if devices is not None else jax.devices())[:num_chips]
+    if plan is None:
+        # The pool topology (PoolTopology via the backend's VODA_TOPOLOGY
+        # env) reshapes planning for the pool's real host block — tp stays
+        # intra-host on v5e-style 1/8-chip hosts as well as the 4-chip
+        # default — and the granted slice shape (the allocator's
+        # feasibility-rounded unit) pins the chip count exactly.
+        slice_shape = (topology.slice_for(num_chips)
+                       if topology is not None else None)
+        plan = plan_mesh(num_chips, model_params_b=bundle.params_b,
+                         seq_len=bundle.seq_len,
+                         num_experts=bundle.num_experts,
+                         topology=topology, slice_shape=slice_shape)
+    mesh = build_mesh(plan, devices)
+    module = bundle.module
+
+    # Pipeline parallelism: plan.pp > 1 swaps the forward dataflow for
+    # the spmd pipeline (parallel/pipeline.py) over the scanned layer
+    # stack — params/init/shardings are unchanged (the rules already put
+    # the stacked layer axis on pp); only the loss path differs.
+    pp_forward = None
+    if plan.pp > 1:
+        # Family-agnostic dispatch: pipeline-capable modules expose a
+        # `pipeline_loss_fn(cfg, num_stages, num_micro)` class attribute
+        # (llama.py / mixtral.py) and must be in scan_layers form (the
+        # stacked layer axis is what shards over pp).
+        _pp_loss = getattr(type(module), "pipeline_loss_fn", None)
+        if _pp_loss is None or not getattr(module.cfg, "scan_layers", False):
+            raise ValueError(
+                "pp > 1 requires a pipeline-capable model in scan_layers "
+                f"form (got {type(module).__name__}, scan_layers="
+                f"{getattr(module.cfg, 'scan_layers', False)})")
+        if plan.sp > 1:
+            raise ValueError("pp x sp composition is not supported yet")
+        data = plan.dp * plan.fsdp
+
+        def _valid(m: int) -> bool:
+            return (global_batch_size % m == 0
+                    and (global_batch_size // m) % data == 0)
+
+        # Prefer 4x/2x the stage count (smaller bubble), else ANY valid
+        # microbatch count >= pp (e.g. batch 10 over pp=4 runs at M=5).
+        preferred = (4 * plan.pp, 2 * plan.pp, plan.pp)
+        fallback = sorted(m for m in range(plan.pp, global_batch_size + 1)
+                          if _valid(m))
+        num_micro = next((m for m in preferred if _valid(m)),
+                         fallback[0] if fallback else None)
+        if num_micro is None:
+            raise ValueError(
+                f"global batch {global_batch_size} admits no microbatch "
+                f"count >= pp={plan.pp} with microbatches divisible by "
+                f"{data} data shards")
+        pp_forward = _pp_loss(module.cfg, plan.pp, num_micro)
+
+    # Attention kernel selection: long-context meshes (real sp axis) get
+    # ring attention; otherwise, on TPU, the Pallas flash kernel replaces
+    # the O(S²) XLA softmax path (ops/flash_attention.py). Both shard via
+    # shard_map with the same batch/head specs the GSPMD rules use.
+    # Pipelined plans keep the XLA path (kernel injection under the
+    # stage vmap is future work).
+    attn_fn = None
+    if hasattr(module, "attn_fn") and pp_forward is None:
+        # Modules exposing attn_fn declare their masking with the
+        # `causal_attention` class attribute — the injected kernel replaces
+        # the layer's own cfg.causal, so it must match.
+        causal = getattr(type(module), "causal_attention", None)
+        if causal is None:
+            raise TypeError(
+                f"{type(module).__name__} exposes attn_fn but not "
+                "`causal_attention`; declare it so kernel injection can't "
+                "silently change masking")
+        if plan.sp > 1:
+            # Ring (default) streams K/V blocks at O(S/n) memory; the
+            # flash variant all-gathers K/V once and runs the MXU-tiled
+            # kernel with per-shard q offsets — faster when the gathered
+            # K/V fits HBM. VODA_SP_ATTENTION=flash opts in.
+            if os.environ.get("VODA_SP_ATTENTION") == "flash":
+                from vodascheduler_tpu.ops import make_sp_flash_attention
+                attn_fn = make_sp_flash_attention(
+                    mesh, causal=causal,
+                    interpret=(None if jax.default_backend() == "tpu"
+                               else True))
+            else:
+                attn_fn = make_ring_attention(mesh, causal=causal)
+        elif _flash_attention_enabled():
+            from vodascheduler_tpu.ops import make_flash_attention
+            attn_fn = make_flash_attention(mesh, causal=causal)
+        if attn_fn is not None:
+            module = type(module)(module.cfg, attn_fn=attn_fn)  # type: ignore
+
+    optimizer = optax.adamw(learning_rate)
+    sample_rng = jax.random.PRNGKey(0)
+    sample_batch = jax.eval_shape(
+        functools.partial(bundle.make_batch, global_batch_size), sample_rng)
+    model_input_key = "images" if "images" in sample_batch else "inputs"
+
+    # Non-trainable collections (BatchNorm running stats) ride in the state
+    # pytree untouched by the optimizer; BatchNorm models run on their
+    # init-time stats in synthetic-benchmark mode (see resnet.py).
+    if bundle.has_batch_stats:
+        def apply_fn_extra(params, extra, x, **kw):
+            return module.apply({"params": params, **extra}, x, train=False,
+                                **kw)
+    else:
+        def apply_fn_extra(params, extra, x, **kw):
+            return module.apply({"params": params}, x, **kw)
+
+    def init_state(rng) -> Dict[str, Any]:
+        batch = bundle.make_batch(global_batch_size, rng)
+        variables = module.init(rng, batch[model_input_key])
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
+        return {"params": params, "extra": extra,
+                "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), dtype=jnp.int32)}
+
+    def train_step(state, batch):
+        def loss_fn(params):
+            if pp_forward is not None:
+                return pp_forward(params, batch["inputs"],
+                                  targets=batch["targets"])
+            return bundle.loss_fn(
+                lambda p, x, **kw: apply_fn_extra(p, state["extra"], x, **kw),
+                params, batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "extra": state["extra"],
+                "opt_state": opt_state,
+                "step": state["step"] + 1}, loss
+
+    # Shardings: the same path rules cover params AND the optimizer moments
+    # (their tree paths embed the param path), scalars replicate.
+    state_shapes = jax.eval_shape(init_state, sample_rng)
+    state_shardings = param_shardings(state_shapes, mesh, bundle.rules)
+    b_shard = batch_sharding(mesh)
+    b_shard_seq = batch_sharding(mesh, seq_axis="sp")
+    batch_shardings = jax.tree.map(
+        lambda leaf: b_shard_seq if (plan.sp > 1 and len(leaf.shape) == 2)
+        else b_shard, sample_batch)
+
+    # The jitted fns run (and trace) under the mesh context so bare-
+    # PartitionSpec activation constraints inside models resolve
+    # (sharding.constrain_batch_activation).
+    def _under_mesh(fn):
+        @functools.wraps(fn)
+        def wrapped(*args):
+            with mesh:
+                return fn(*args)
+        return wrapped
+
+    init_jit = _under_mesh(jax.jit(init_state, out_shardings=state_shardings))
+    step_jit = _under_mesh(jax.jit(train_step,
+                                   in_shardings=(state_shardings,
+                                                 batch_shardings),
+                                   out_shardings=(state_shardings, None),
+                                   donate_argnums=0))
+
+    def make_batch(batch_size: int, rng: jax.Array):
+        batch = bundle.make_batch(batch_size, rng)
+        return jax.device_put(batch, batch_shardings)
+
+    return TrainSetup(mesh=mesh, plan=plan, state_shardings=state_shardings,
+                      batch_shardings=batch_shardings, init_fn=init_jit,
+                      train_step=step_jit, make_batch=make_batch,
+                      eval_shape_state=state_shapes,
+                      train_step_raw=train_step)
+
+
+class TrainSession:
+    """A live training session at a fixed chip count."""
+
+    def __init__(self, bundle: ModelBundle, num_chips: int,
+                 global_batch_size: int = 8, seed: int = 0,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 plan: Optional[MeshPlan] = None, init: bool = True,
+                 learning_rate: float = 1e-3,
+                 topology: Optional[Any] = None):
+        self.bundle = bundle
+        self.num_chips = num_chips
+        self.global_batch_size = global_batch_size
+        self.setup = make_train_setup(bundle, num_chips, devices=devices,
+                                      plan=plan, learning_rate=learning_rate,
+                                      global_batch_size=global_batch_size,
+                                      topology=topology)
+        self.rng = jax.random.PRNGKey(seed)
+        self.state = self.setup.init_fn(jax.random.PRNGKey(seed)) if init \
+            else None
+        self._saver = None
+
+    @property
+    def step(self) -> int:
+        self._require_state()
+        return int(self.state["step"])
+
+    def _require_state(self) -> None:
+        if self.state is None:
+            raise RuntimeError(
+                "TrainSession has no state: constructed with init=False — "
+                "restore a checkpoint (TrainSession.resume) first")
+
+    def run_steps(self, n: int) -> float:
+        """Run n steps; returns the last loss."""
+        self._require_state()
+        loss = jnp.zeros(())
+        for _ in range(n):
+            self.rng, sub = jax.random.split(self.rng)
+            batch = self.setup.make_batch(self.global_batch_size, sub)
+            self.state, loss = self.setup.train_step(self.state, batch)
+        return float(loss)
+
+    def save(self, ckpt_dir: str, keep_last: int = 2,
+             wait: bool = True) -> int:
+        """Checkpoint current (state, rng); returns the saved step.
+
+        `wait=False` overlaps the shard writes with subsequent training
+        steps (device→host copy still happens before returning, so the
+        donated state buffers are safe); call `finish_saves()` before the
+        process exits or before restoring elsewhere."""
+        self._require_state()
+        from vodascheduler_tpu.runtime.checkpoint import AsyncCheckpointSaver
+        if self._saver is None:
+            self._saver = AsyncCheckpointSaver()
+        return self._saver.save(ckpt_dir, self.state, self.rng,
+                                keep_last=keep_last, wait=wait)
+
+    def finish_saves(self) -> None:
+        """Drain any in-flight async save and release the checkpointer
+        (a later save lazily recreates it)."""
+        if self._saver is not None:
+            self._saver.close()
+            self._saver = None
+
+    @classmethod
+    def resume(cls, bundle: ModelBundle, num_chips: int, ckpt_dir: str,
+               global_batch_size: int = 8,
+               devices: Optional[Sequence[jax.Device]] = None,
+               plan: Optional[MeshPlan] = None,
+               step: Optional[int] = None,
+               learning_rate: float = 1e-3,
+               topology: Optional[Any] = None) -> "TrainSession":
+        """Rebuild a session at a (possibly different) chip count from a
+        checkpoint — the elastic-resize restore path (SURVEY.md §7:
+        resize = restart-with-reshard). `learning_rate` may differ from the
+        saved run's (e.g. linear scaling with the new chip count — the
+        reference rescales LR on every Horovod reset the same way)."""
+        from vodascheduler_tpu.runtime import checkpoint as ckpt
+        session = cls(bundle, num_chips, global_batch_size=global_batch_size,
+                      devices=devices, plan=plan, init=False,
+                      learning_rate=learning_rate, topology=topology)
+        session.state, session.rng = ckpt.restore_checkpoint(
+            ckpt_dir, session.setup, step=step)
+        return session

@@ -1,0 +1,7 @@
+"""The per-pool scheduler: the rescheduling control loop.
+
+Reference counterpart: pkg/scheduler — the heart of the system
+(SURVEY.md §3.2).
+"""
+
+from vodascheduler_tpu.scheduler.scheduler import Scheduler

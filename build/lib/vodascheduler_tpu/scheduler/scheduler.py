@@ -1,0 +1,687 @@
+"""The per-pool scheduler event loop.
+
+Reference counterpart: pkg/scheduler/scheduler/scheduler.go (1183 LoC Go).
+One scheduler owns one TPU pool (the reference runs one per GPU type): job
+state maps, rate-limited coalescing rescheduling, allocation diffing, time
+accounting with Tiresias priority transitions, host churn handling, and
+crash resume.
+
+Event-driven design: every timed behavior (rate-limit window, the
+time-metrics ticker, retry-after-failure) is a Clock timer, so the same
+scheduler runs in real time (service layer pumps a thread) or simulated
+time (trace replay advances a VirtualClock) with identical semantics —
+the property the reference's goroutine+wall-clock design lacked
+(SURVEY.md §4).
+
+The resize path is TPU-native: "scale" asks the backend to
+checkpoint-restart the job at the new size, and the placement pass may add
+migrations, which use the same restart mechanism (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from vodascheduler_tpu.algorithms.tiresias import (
+    TIRESIAS_PROMOTE_KNOB,
+    TIRESIAS_THRESHOLDS_SEC,
+    tiresias_demote_priority,
+    tiresias_promote_priority,
+)
+from vodascheduler_tpu.allocator import AllocationRequest, ResourceAllocator
+from vodascheduler_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterEvent,
+    ClusterEventKind,
+)
+from vodascheduler_tpu.common.clock import Clock, VirtualClock
+from vodascheduler_tpu.common.events import EventBus, JobEvent
+from vodascheduler_tpu.common.job import TrainingJob
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import (
+    EventVerb,
+    JobStatus,
+    ScheduleResult,
+)
+from vodascheduler_tpu.placement import PlacementManager
+
+log = logging.getLogger(__name__)
+
+DEFAULT_RATE_LIMIT_SECONDS = 30.0   # reference: scheduler.go:212
+DEFAULT_TICKER_SECONDS = 5.0        # reference: rateLimitTimeMetricsSeconds
+
+
+class Scheduler:
+    def __init__(
+        self,
+        pool_id: str,
+        backend: ClusterBackend,
+        store: JobStore,
+        allocator: ResourceAllocator,
+        clock: Clock,
+        bus: Optional[EventBus] = None,
+        placement_manager: Optional[PlacementManager] = None,
+        algorithm: str = "ElasticFIFO",
+        rate_limit_seconds: float = DEFAULT_RATE_LIMIT_SECONDS,
+        ticker_seconds: float = DEFAULT_TICKER_SECONDS,
+        resume: bool = False,
+        registry: Optional[Registry] = None,
+        scale_out_hysteresis: float = 1.0,
+        resize_cooldown_seconds: float = 120.0,
+        defrag_cross_host_threshold: int = 0,
+    ):
+        self.pool_id = pool_id
+        self.backend = backend
+        self.store = store
+        self.allocator = allocator
+        self.clock = clock
+        self.bus = bus
+        self.algorithm = algorithm
+        self.rate_limit_seconds = rate_limit_seconds
+        self.ticker_seconds = ticker_seconds
+        # TPU-specific: a scale-out is a checkpoint-restart, not a free ring
+        # rebuild, so small growth doesn't pay for the restart pause. Small
+        # growth (new < ceil(old * hysteresis)) is suppressed only within
+        # resize_cooldown_seconds of the job's last resize — suppression
+        # must delay a restart, never permanently strand idle chips. Set
+        # hysteresis to 1.0 to disable (reference semantics — it applies
+        # every diff, scheduler.go:448-480, because Horovod resizes are
+        # cheap).
+        self.scale_out_hysteresis = scale_out_hysteresis
+        self.resize_cooldown_seconds = resize_cooldown_seconds
+        # Incremental placement fragments over time; when more than this
+        # many jobs span hosts, the next pass runs the full repack +
+        # Hungarian consolidation (placement.defragment) and pays its
+        # migrations. 0 disables defragmentation.
+        self.defrag_cross_host_threshold = defrag_cross_host_threshold
+        self._last_cross_host = 0
+        self._last_resize_at: Dict[str, float] = {}
+        # Jobs needing re-placement after host churn even if their chip
+        # count is unchanged (e.g. their host died).
+        self._placement_dirty = False
+
+        # Job state (reference: ReadyJobsMap / DoneJobsMap / JobNumGPU,
+        # scheduler.go:81-93).
+        self.ready_jobs: Dict[str, TrainingJob] = {}
+        self.done_jobs: Dict[str, TrainingJob] = {}
+        self.job_num_chips: ScheduleResult = {}
+
+        # Host capacity (reference: TotalGpus via node informer).
+        self.total_chips = 0
+
+        self.placement_manager = placement_manager
+        self._init_hosts()
+
+        # Resched rate limiting (reference: lastResched/reschedBlockedUntil).
+        self.last_resched = -1.0
+        self.resched_blocked_until = -float("inf")
+        self._resched_pending = False
+        self._in_resched = False
+        self._stopped = False
+        # Serializes all entry points (reference: SchedulerLock,
+        # scheduler.go:88-89). Event-bus and backend callbacks arrive on the
+        # publisher's thread in real-time mode; reentrant because handlers
+        # trigger rescheds inline.
+        self._lock = threading.RLock()
+
+        self._init_metrics(registry or Registry())
+
+        backend.set_event_callback(self._on_cluster_event)
+        if bus is not None:
+            bus.subscribe(pool_id, self._on_job_event)
+
+        if resume:
+            self._construct_status_on_restart()
+
+        self._start_ticker()
+
+    # ---- setup -----------------------------------------------------------
+
+    def _init_hosts(self) -> None:
+        hosts = self.backend.list_hosts()
+        self.total_chips = sum(hosts.values())
+        if self.placement_manager is not None and not self.placement_manager.host_states:
+            for name, chips in hosts.items():
+                self.placement_manager.add_host(name, chips)
+
+    def _init_metrics(self, registry: Registry) -> None:
+        """Reference series: pkg/scheduler/scheduler/metrics.go:12-196."""
+        self.registry = registry
+        # pool const-label: N pools share one registry/exposition without
+        # colliding series (reference: one scheduler process per GPU type).
+        pool_l = {"pool": self.pool_id}
+        self.m_resched_total = registry.counter(
+            "voda_scheduler_resched_total", "Reschedulings executed",
+            const_labels=pool_l)
+        self.m_resched_seconds = registry.summary(
+            "voda_scheduler_resched_duration_seconds", "Rescheduling latency",
+            const_labels=pool_l)
+        self.m_alloc_seconds = registry.summary(
+            "voda_scheduler_resched_allocation_duration_seconds",
+            "Allocator call latency", const_labels=pool_l)
+        self.m_jobs_completed = registry.counter(
+            "voda_scheduler_jobs_completed_total", "Jobs completed",
+            const_labels=pool_l)
+        self.m_jobs_failed = registry.counter(
+            "voda_scheduler_jobs_failed_total", "Jobs failed",
+            const_labels=pool_l)
+        self.m_jobs_created = registry.counter(
+            "voda_scheduler_jobs_created_total", "Jobs accepted",
+            const_labels=pool_l)
+        self.m_jobs_deleted = registry.counter(
+            "voda_scheduler_jobs_deleted_total", "Jobs deleted by user",
+            const_labels=pool_l)
+        self.m_job_restarts = registry.counter(
+            "voda_scheduler_job_restarts_total",
+            "Checkpoint-restart incarnations (start/scale/migration)",
+            const_labels=pool_l)
+        registry.gauge("voda_scheduler_ready_jobs",
+                       "Jobs in the ready queue",
+                       fn=lambda: float(len(self.ready_jobs)),
+                       const_labels=pool_l)
+        registry.gauge("voda_scheduler_running_jobs", "Jobs allocated chips",
+                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n > 0)),
+                       const_labels=pool_l)
+        registry.gauge("voda_scheduler_waiting_jobs", "Ready jobs with zero chips",
+                       fn=lambda: float(sum(1 for n in self.job_num_chips.values() if n == 0)),
+                       const_labels=pool_l)
+        registry.gauge("voda_scheduler_total_chips", "Pool chip capacity",
+                       fn=lambda: float(self.total_chips),
+                       const_labels=pool_l)
+        registry.gauge("voda_scheduler_allocated_chips", "Chips allocated",
+                       fn=lambda: float(sum(self.job_num_chips.values())),
+                       const_labels=pool_l)
+
+    def _start_ticker(self) -> None:
+        def tick() -> None:
+            if self._stopped:
+                return
+            self.update_time_metrics()
+            self.clock.call_later(self.ticker_seconds, tick)
+
+        if isinstance(self.clock, VirtualClock):
+            self.clock.call_later(self.ticker_seconds, tick)
+        # Real-time mode: the service layer runs update_time_metrics from
+        # its own thread loop (service/daemon.py).
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # ---- event intake ----------------------------------------------------
+
+    def _on_job_event(self, event: JobEvent) -> None:
+        """Reference: readMsgs goroutine (scheduler.go:829-843)."""
+        with self._lock:
+            if event.verb == EventVerb.CREATE:
+                self.create_training_job(event.job_name)
+            elif event.verb == EventVerb.DELETE:
+                self.delete_training_job(event.job_name)
+
+    def _on_cluster_event(self, event: ClusterEvent) -> None:
+        """Reference: MPIJob + node informer handlers (scheduler.go:592-747)."""
+        with self._lock:
+            if event.kind == ClusterEventKind.JOB_COMPLETED:
+                self.handle_job_completed(event.name)
+            elif event.kind == ClusterEventKind.JOB_FAILED:
+                self.handle_job_failed(event.name)
+            elif event.kind == ClusterEventKind.HOST_ADDED:
+                self._on_host_added(event.name)
+            elif event.kind == ClusterEventKind.HOST_REMOVED:
+                self._on_host_removed(event.name)
+
+    # ---- job lifecycle ---------------------------------------------------
+
+    def create_training_job(self, name: str) -> None:
+        """Accept a job announced by the admission service
+        (reference: scheduler.go:845-890)."""
+        job = self.store.get_job(name)
+        if job is None:
+            log.error("create event for unknown job %s", name)
+            return
+        job.status = JobStatus.WAITING
+        job.metrics.last_update_time = self.clock.now()
+        self.store.update_job(job)
+        self.ready_jobs[name] = job
+        self.job_num_chips[name] = 0
+        self.m_jobs_created.inc()
+        self.trigger_resched()
+
+    def delete_training_job(self, name: str) -> None:
+        """User-initiated cancel (reference: scheduler.go:916-1000)."""
+        job = self.ready_jobs.pop(name, None)
+        if job is None:
+            return
+        if self.job_num_chips.get(name, 0) > 0:
+            self.backend.stop_job(name)
+        self.job_num_chips.pop(name, None)
+        job.status = JobStatus.CANCELED
+        job.finish_time = self.clock.now()
+        self.store.update_job(job)
+        self.done_jobs[name] = job
+        self.m_jobs_deleted.inc()
+        self.trigger_resched()
+
+    def handle_job_completed(self, name: str) -> None:
+        """Reference: handleJobCompleted (scheduler.go:630-650)."""
+        job = self.ready_jobs.get(name)
+        if job is None or job.status == JobStatus.COMPLETED:
+            return
+        self.update_time_metrics()  # final accounting before terminal state
+        job.status = JobStatus.COMPLETED
+        self._job_done(job)
+        self.m_jobs_completed.inc()
+        self.trigger_resched()
+
+    def handle_job_failed(self, name: str) -> None:
+        """Reference: handleJobFailed (scheduler.go:652-671)."""
+        job = self.ready_jobs.get(name)
+        if job is None or job.status == JobStatus.FAILED:
+            return
+        self.update_time_metrics()
+        job.status = JobStatus.FAILED
+        self._job_done(job)
+        self.m_jobs_failed.inc()
+        self.trigger_resched()
+
+    def _job_done(self, job: TrainingJob) -> None:
+        """Reference: handleJobDoneInternal (scheduler.go:673-686)."""
+        job.finish_time = self.clock.now()
+        self.store.update_job(job)
+        self.done_jobs[job.name] = job
+        self.ready_jobs.pop(job.name, None)
+        self.job_num_chips.pop(job.name, None)
+
+    # ---- host churn (reference: addNode/updateNode/deleteNode :689-747) --
+
+    def _on_host_added(self, name: str) -> None:
+        # Recompute rather than increment: a re-announced host (capacity
+        # update) must not double-count.
+        self.total_chips = sum(self.backend.list_hosts().values())
+        if self.placement_manager is not None:
+            chips = self.backend.list_hosts().get(name, 0)
+            self.placement_manager.add_host(name, chips)
+        self.trigger_resched()
+
+    def _on_host_removed(self, name: str) -> None:
+        # The backend no longer lists the host; recompute capacity.
+        self.total_chips = sum(self.backend.list_hosts().values())
+        if self.placement_manager is not None:
+            self.placement_manager.remove_host(name)
+            # Jobs that lost workers need re-placement even if the next
+            # allocation leaves their chip count unchanged.
+            self._placement_dirty = True
+        self.trigger_resched()
+
+    # ---- rescheduling (reference: Run select loop + resched :271-434) ----
+
+    def trigger_resched(self) -> None:
+        """Request a resched; coalesces and honors the rate limit
+        (reference: TriggerResched + the Run loop's drop-and-block logic,
+        scheduler.go:297-316)."""
+        with self._lock:
+            if self._resched_pending or self._stopped:
+                return
+            self._resched_pending = True
+            if self._in_resched:
+                return  # _run_resched_now reschedules after the current pass
+            now = self.clock.now()
+            at = max(now, self.resched_blocked_until)
+            if at <= now:
+                self._run_resched_now()
+            elif isinstance(self.clock, VirtualClock):
+                self.clock.call_at(at, self._run_resched_now)
+            # Real-time mode: service daemon polls resched_pending.
+
+    @property
+    def resched_pending(self) -> bool:
+        return self._resched_pending
+
+    def pump(self) -> None:
+        """Real-time driver hook (service/daemon.py): run a pending resched
+        once the rate-limit window opens. Under a VirtualClock the clock's
+        timers do this; under the wall clock a daemon thread calls pump().
+        """
+        with self._lock:
+            due = (self._resched_pending and not self._in_resched
+                   and self.clock.now() >= self.resched_blocked_until)
+        if due:
+            self._run_resched_now()
+
+    def set_algorithm(self, name: str) -> None:
+        """Switch the scheduling algorithm at runtime and reschedule
+        (reference: PUT /algorithm, scheduler.go:1127-1155)."""
+        from vodascheduler_tpu.algorithms import new_algorithm
+        new_algorithm(name, self.pool_id)  # validate; raises on unknown
+        with self._lock:
+            self.algorithm = name
+        self.trigger_resched()
+
+    def set_rate_limit(self, seconds: float) -> None:
+        """Adjust the resched rate limit (reference: PUT /ratelimit,
+        scheduler.go:1157-1183)."""
+        if seconds < 0:
+            raise ValueError("rate limit must be >= 0")
+        with self._lock:
+            self.rate_limit_seconds = seconds
+
+    def _run_resched_now(self) -> None:
+        with self._lock:
+            if not self._resched_pending or self._stopped:
+                return
+            self._resched_pending = False
+            self._in_resched = True
+            try:
+                self.resched()
+            finally:
+                self._in_resched = False
+            now = self.clock.now()
+            self.last_resched = now
+            self.resched_blocked_until = now + self.rate_limit_seconds
+            if self._resched_pending:
+                # Re-triggered mid-pass (e.g. a Tiresias priority flip): run
+                # again once the rate-limit window opens.
+                if isinstance(self.clock, VirtualClock):
+                    self.clock.call_at(self.resched_blocked_until,
+                                       self._run_resched_now)
+
+    def resched(self) -> None:
+        """One rescheduling pass (reference: resched, scheduler.go:326-364)."""
+        import time as _walltime
+
+        t_start = _walltime.monotonic()
+        self.update_time_metrics()
+        old = dict(self.job_num_chips)
+        jobs = list(self.ready_jobs.values())
+        t_alloc = _walltime.monotonic()
+        try:
+            new = self.allocator.allocate(AllocationRequest(
+                scheduler_id=self.pool_id,
+                num_chips=self.total_chips,
+                algorithm=self.algorithm,
+                ready_jobs=jobs,
+                # Slice-shape feasibility: with a modeled torus, grants are
+                # rounded to counts that admit a contiguous sub-slice
+                # (SURVEY.md §7 allocation-unit delta).
+                topology=(self.placement_manager.topology
+                          if self.placement_manager is not None else None),
+            ))
+        except Exception:
+            log.exception("allocation failed; retrying after rate limit")
+            self._schedule_retry()
+            return
+        self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
+
+        if self.scale_out_hysteresis > 1.0:
+            self._apply_hysteresis(old, new)
+        self.job_num_chips = new
+        halts, scale_ins, scale_outs, starts = self.compare_results(old)
+        changed = bool(halts or scale_ins or scale_outs or starts)
+
+        # Unlike the reference (which places *after* the MPI-Operator
+        # creates pods, steering them via tolerations and deleting movers,
+        # §3.3), we own the runtime: compute host bindings first and hand
+        # them to the backend with each start/scale.
+        placements: Dict[str, List[Tuple[str, int]]] = {}
+        placed = False
+        if (changed or self._placement_dirty) and self.placement_manager is not None:
+            requests = {j: n for j, n in self.job_num_chips.items() if n > 0}
+            if (self.defrag_cross_host_threshold > 0
+                    and self._last_cross_host >= self.defrag_cross_host_threshold):
+                decision = self.placement_manager.defragment(requests)
+            else:
+                decision = self.placement_manager.place(requests)
+            self._last_cross_host = decision.num_jobs_cross_host
+            placements = decision.placements
+            placed = True
+            self._placement_dirty = False
+
+        # Halts and scale-ins release chips before starts/scale-outs claim
+        # them (reference: applySchedulerResults order, scheduler.go:434-445).
+        for job in halts:
+            self._halt_job(job)
+        for job in scale_ins:
+            self._scale_job(job, placements.get(job))
+        for job in starts:
+            self._start_job(job, placements.get(job))
+        for job in scale_outs:
+            self._scale_job(job, placements.get(job))
+        if placed:
+            self._migrate_moved_jobs(
+                placements, set(halts) | set(starts) | set(scale_ins) | set(scale_outs))
+
+        self.store.flush()  # batch boundary for autoflush=False stores
+        self.m_resched_total.inc()
+        self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
+
+    def _migrate_moved_jobs(self, placements: Dict[str, List[Tuple[str, int]]],
+                            already_restarted: set) -> None:
+        """Restart same-size jobs whose host binding no longer matches what
+        the backend is running — including jobs whose workers died with a
+        removed host (those produce no index-level move in the placement
+        diff, so the backend's live view is the ground truth to compare)."""
+        live = self.backend.running_jobs()
+        for job_name, target in placements.items():
+            if job_name in already_restarted:
+                continue
+            handle = live.get(job_name)
+            if handle is None:
+                continue
+            if sorted(handle.placements) != sorted(target):
+                self.backend.migrate_workers(job_name, target)
+                self._last_resize_at[job_name] = self.clock.now()
+
+    def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
+        """Suppress small scale-outs of recently-resized running jobs (see
+        ctor comment) — on TPU every resize is a checkpoint-restart, so a
+        +1/-1 oscillation burns two restart windows for negligible speedup.
+
+        Keeping the old (smaller) allocation only shrinks the total, so
+        the result stays valid; the cooldown guarantees the growth
+        eventually applies instead of stranding chips forever. (Symmetric
+        scale-in suppression was tried and removed: holding a job at its
+        larger size delays the inevitable shrink-restart without saving
+        it, and measured neutral-to-negative on trace replay.)"""
+        import math as _math
+
+        now = self.clock.now()
+        for job, n_new in new.items():
+            n_old = old.get(job, 0)
+            if (n_old > 0 and n_new > n_old
+                    and n_new < _math.ceil(n_old * self.scale_out_hysteresis)
+                    and now - self._last_resize_at.get(job, -float("inf"))
+                    < self.resize_cooldown_seconds):
+                new[job] = n_old
+
+    def _schedule_retry(self) -> None:
+        """Reference: TriggerReschedAtTime after allocator failure
+        (scheduler.go:344-349)."""
+        delay = self.rate_limit_seconds + 1.0
+        if isinstance(self.clock, VirtualClock):
+            self.clock.call_later(delay, self.trigger_resched)
+        else:
+            # Real-time mode: keep the request pending so the service
+            # daemon retries once the window opens.
+            self._resched_pending = True
+            self.resched_blocked_until = self.clock.now() + delay
+
+    def compare_results(self, old: ScheduleResult) -> Tuple[
+            List[str], List[str], List[str], List[str]]:
+        """Diff old vs new allocations into (halts, scale_ins, scale_outs,
+        starts). Reference: compareResults (scheduler.go:448-480)."""
+        halts: List[str] = []
+        scale_ins: List[str] = []
+        scale_outs: List[str] = []
+        starts: List[str] = []
+        for job, n_old in old.items():
+            n_new = self.job_num_chips.get(job, 0)
+            if n_old > n_new:
+                if n_new == 0:
+                    status = self._job_status(job)
+                    # don't halt a job that already finished
+                    if status is not None and not status.is_terminal:
+                        halts.append(job)
+                else:
+                    scale_ins.append(job)
+            elif n_old < n_new:
+                if n_old == 0:
+                    starts.append(job)
+                else:
+                    scale_outs.append(job)
+        # jobs that appear only in the new result
+        for job, n_new in self.job_num_chips.items():
+            if job not in old and n_new > 0:
+                starts.append(job)
+        return halts, scale_ins, scale_outs, starts
+
+    def _start_job(self, name: str,
+                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        """Reference: startTrainingJob (scheduler.go:495-519)."""
+        job = self.ready_jobs.get(name)
+        if job is None:
+            return
+        self.backend.start_job(job.spec, self.job_num_chips[name], placements)
+        self.m_job_restarts.inc()
+        job.status = JobStatus.RUNNING
+        job.metrics.last_chip_seconds = 0.0
+        job.metrics.last_running_seconds = 0.0
+        job.metrics.seconds_since_restart = 0.0
+        # Also consume the waiting window (the reference leaves it,
+        # scheduler.go:505-514, letting a freshly-started job immediately
+        # satisfy the Tiresias promote test and bounce back to queue 0).
+        job.metrics.last_waiting_seconds = 0.0
+        self._last_resize_at[name] = self.clock.now()
+        if job.metrics.running_seconds == 0:
+            job.metrics.first_start_time = self.clock.now()
+        self.store.update_job(job)
+
+    def _scale_job(self, name: str,
+                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        """Reference: scaleTrainingJob (scheduler.go:542-574)."""
+        self.backend.scale_job(name, self.job_num_chips[name], placements)
+        self.m_job_restarts.inc()
+        self._last_resize_at[name] = self.clock.now()
+        job = self.ready_jobs.get(name)
+        if job is not None:
+            # A resize is a checkpoint-restart too: re-arm the preemption
+            # lease so the just-restarted job isn't evicted back-to-back.
+            job.metrics.seconds_since_restart = 0.0
+            self.store.update_job(job)
+
+    def _halt_job(self, name: str) -> None:
+        """Reference: haltTrainingJob (scheduler.go:576-590)."""
+        job = self.ready_jobs.get(name)
+        self.backend.stop_job(name)
+        if job is not None:
+            job.status = JobStatus.WAITING
+            job.metrics.last_waiting_seconds = 0.0
+            self.store.update_job(job)
+
+    def _job_status(self, name: str) -> Optional[JobStatus]:
+        job = self.ready_jobs.get(name) or self.done_jobs.get(name)
+        return job.status if job else None
+
+    # ---- time accounting + Tiresias transitions (reference :757-813) -----
+
+    def update_time_metrics(self) -> None:
+        with self._lock:
+            self._update_time_metrics_locked()
+
+    def _update_time_metrics_locked(self) -> None:
+        now = self.clock.now()
+        priority_changed = False
+        for job in self.ready_jobs.values():
+            elapsed = now - job.metrics.last_update_time
+            if elapsed < 0:
+                elapsed = 0.0
+            n = self.job_num_chips.get(job.name, 0)
+            m = job.metrics
+            if job.status == JobStatus.RUNNING:
+                m.running_seconds += elapsed
+                m.chip_seconds += elapsed * n
+                m.total_seconds += elapsed
+                m.last_running_seconds += elapsed
+                m.last_chip_seconds += elapsed * n
+                m.seconds_since_restart += elapsed
+            elif job.status == JobStatus.WAITING:
+                m.waiting_seconds += elapsed
+                m.total_seconds += elapsed
+                m.last_waiting_seconds += elapsed
+            m.last_update_time = now
+
+            if (self.algorithm in ("Tiresias", "ElasticTiresias")
+                    and job.status in (JobStatus.RUNNING, JobStatus.WAITING)):
+                # Deliberate fix over the reference (scheduler.go:787-802),
+                # which never resets the last_* windows on a transition: a
+                # preempted-then-starved job would oscillate promote/demote
+                # every tick, thrashing allocations. Consuming the window
+                # that caused each transition (per the Tiresias paper's
+                # window semantics) makes transitions one-shot.
+                threshold = TIRESIAS_THRESHOLDS_SEC.get(job.priority, float("inf"))
+                if m.last_chip_seconds > threshold:
+                    job.priority = tiresias_demote_priority(job.priority)
+                    m.last_chip_seconds = 0.0
+                    priority_changed = True
+                elif (m.last_waiting_seconds >= m.last_running_seconds * TIRESIAS_PROMOTE_KNOB
+                        and job.priority > 0):
+                    job.priority = tiresias_promote_priority(job.priority)
+                    m.last_waiting_seconds = 0.0
+                    priority_changed = True
+        if priority_changed:
+            self.trigger_resched()
+
+    # ---- crash resume (reference: constructStatusOnRestart :1009-1072) ---
+
+    def _construct_status_on_restart(self) -> None:
+        """Rebuild in-memory state from the store and the backend's live
+        view. Jobs recorded as non-terminal return to the ready queue; their
+        current allocation comes from the backend (like reading live MPIJob
+        Worker.Replicas)."""
+        running = self.backend.running_jobs()
+        for job in self.store.list_jobs(pool=self.pool_id):
+            if job.status.is_terminal:
+                self.done_jobs[job.name] = job
+                continue
+            handle = running.get(job.name)
+            n = handle.num_workers if handle else 0
+            job.status = JobStatus.RUNNING if n > 0 else JobStatus.WAITING
+            job.metrics.last_update_time = self.clock.now()
+            self.ready_jobs[job.name] = job
+            self.job_num_chips[job.name] = n
+        if self.placement_manager is not None:
+            self.placement_manager.restore(
+                {name: h.placements for name, h in running.items()
+                 if h.placements})
+        self.trigger_resched()
+
+    # ---- introspection (reference: GET /training table :968-998) ---------
+
+    def status_table(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return self._status_table_locked()
+
+    def _status_table_locked(self) -> List[Dict[str, object]]:
+        rows = []
+        for job in sorted(self.ready_jobs.values(), key=lambda j: j.submit_time):
+            rows.append({
+                "name": job.name,
+                "status": job.status.value,
+                "chips": self.job_num_chips.get(job.name, 0),
+                "priority": job.priority,
+                "running_seconds": round(job.metrics.running_seconds, 1),
+                "waiting_seconds": round(job.metrics.waiting_seconds, 1),
+                "chip_seconds": round(job.metrics.chip_seconds, 1),
+            })
+        for job in sorted(self.done_jobs.values(), key=lambda j: j.submit_time):
+            rows.append({
+                "name": job.name,
+                "status": job.status.value,
+                "chips": 0,
+                "priority": job.priority,
+                "running_seconds": round(job.metrics.running_seconds, 1),
+                "waiting_seconds": round(job.metrics.waiting_seconds, 1),
+                "chip_seconds": round(job.metrics.chip_seconds, 1),
+            })
+        return rows

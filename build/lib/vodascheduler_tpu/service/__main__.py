@@ -1,0 +1,7 @@
+"""`python -m vodascheduler_tpu.service` — run the full control plane."""
+
+import sys
+
+from vodascheduler_tpu.service.app import main
+
+sys.exit(main())

@@ -1,0 +1,131 @@
+"""Job admission: create/delete with persistence and event publication.
+
+Reference counterpart: pkg/service/service/handlers.go —
+`CreateTrainingJob` (:60): parse spec, timestamp the name (:85-88), create
+or inherit base job info (:77, getOrCreateBaseJobInfo), insert into Mongo,
+publish `create` to the GPU-type queue with rollback on publish failure
+(:119-134). `DeleteTrainingJob` (:255) mirrors it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from vodascheduler_tpu.common.clock import Clock
+from vodascheduler_tpu.common.events import EventBus, JobEvent
+from vodascheduler_tpu.common.job import (
+    JobSpec,
+    TrainingJob,
+    base_job_info,
+    category_of,
+    timestamped_name,
+)
+from vodascheduler_tpu.common.metrics import Registry, timed
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import EventVerb
+
+log = logging.getLogger(__name__)
+
+
+class AdmissionError(Exception):
+    pass
+
+
+class AdmissionService:
+    def __init__(self, store: JobStore, bus: EventBus, clock: Clock,
+                 registry: Optional[Registry] = None,
+                 valid_pools: Optional[set] = None):
+        self.store = store
+        self.bus = bus
+        self.clock = clock
+        # When set, jobs naming a pool outside it are rejected at
+        # admission: the bus queues events for unsubscribed topics
+        # silently, so an unvalidated typo'd (or defaulted) pool would be
+        # accepted 200 and then sit Submitted forever with no scheduler
+        # ever seeing it.
+        self.valid_pools = valid_pools
+        registry = registry or Registry()
+        # Reference series: pkg/service/service/metrics.go.
+        self.m_created = registry.counter(
+            "voda_service_jobs_created_total", "Jobs admitted")
+        self.m_deleted = registry.counter(
+            "voda_service_jobs_deleted_total", "Jobs deleted")
+        self.m_errors = registry.counter(
+            "voda_service_errors_total", "Admission errors")
+        self.m_create_duration = registry.summary(
+            "voda_service_create_duration_seconds",
+            "Job admission handler duration")
+        self.m_delete_duration = registry.summary(
+            "voda_service_delete_duration_seconds",
+            "Job deletion handler duration")
+
+    def create_training_job(self, spec: JobSpec) -> str:
+        """Admit a job; returns its timestamped name."""
+        with timed(self.m_create_duration):
+            return self._create_training_job(spec)
+
+    def _create_training_job(self, spec: JobSpec) -> str:
+        if self.valid_pools is not None and spec.pool not in self.valid_pools:
+            self.m_errors.inc()
+            raise AdmissionError(
+                f"unknown pool {spec.pool!r}; configured pools: "
+                f"{sorted(self.valid_pools)}")
+        now = self.clock.now()
+        # Second-resolution timestamps collide when jobs arrive in the same
+        # second (guaranteed in trace replay); bump until unique.
+        stamp = now
+        name = timestamped_name(spec.name, now=stamp)
+        while self.store.get_job(name) is not None:
+            stamp += 1.0
+            name = timestamped_name(spec.name, now=stamp)
+        spec = dataclasses.replace(spec, name=name)
+        category = category_of(name)
+
+        # Seed job info: inherit the category's learned curves if a past run
+        # of the same workload exists, else the linear prior
+        # (reference: getOrCreateBaseJobInfo, handlers.go:180-206).
+        past = self.store.find_category_info(category)
+        if past is not None:
+            info = dataclasses.replace(
+                past, name=name,
+                speedup=dict(past.speedup), efficiency=dict(past.efficiency),
+                epoch_seconds=dict(past.epoch_seconds),
+                step_seconds=dict(past.step_seconds))
+            # A fresh submission restarts from epoch 0: remaining time is
+            # the full run re-estimated from the learned epoch time.
+            if 1 in info.epoch_seconds:
+                info.estimated_remaining_seconds = (
+                    info.epoch_seconds[1] * spec.config.epochs)
+            info.current_epoch = -1
+            info.remaining_epochs = spec.config.epochs
+        else:
+            info = base_job_info(name, category, spec.pool)
+
+        job = TrainingJob.from_spec(spec, submit_time=now)
+        self.store.upsert_job_info(info)
+        self.store.insert_job(job)
+
+        try:
+            self.bus.publish(spec.pool, JobEvent(EventVerb.CREATE, name))
+        except Exception:
+            # Rollback like the reference (handlers.go:124-131): a job the
+            # scheduler never hears about must not linger in the store.
+            self.store.delete_job(name)
+            self.m_errors.inc()
+            raise
+        self.m_created.inc()
+        return name
+
+    def delete_training_job(self, name: str) -> None:
+        with timed(self.m_delete_duration):
+            job = self.store.get_job(name)
+            if job is None:
+                self.m_errors.inc()
+                raise AdmissionError(f"job {name} not found")
+            self.bus.publish(job.pool, JobEvent(EventVerb.DELETE, name))
+            self.m_deleted.inc()
+
+    def get_job(self, name: str) -> Optional[TrainingJob]:
+        return self.store.get_job(name)

@@ -168,10 +168,7 @@ class VodaApp:
                 # them through the workdir-side mount.
                 pod_metrics = f"/jobs/metrics/{ps.name}" if not single \
                     else "/jobs/metrics"
-                from vodascheduler_tpu.cluster.gke import DEFAULT_NAMESPACE
                 be = GkeBackend(kube if kube is not None else InClusterKube(),
-                                namespace=os.environ.get(
-                                    "VODA_NAMESPACE", DEFAULT_NAMESPACE),
                                 topology=ps.topology,
                                 pool="" if single else ps.name,
                                 pod_metrics_dir=pod_metrics)

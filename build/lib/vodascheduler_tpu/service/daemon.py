@@ -1,0 +1,67 @@
+"""Real-time driver: pumps schedulers and the metrics collector under the
+wall clock.
+
+Reference counterpart: the goroutines the Go services spawn — the
+scheduler's Run() select loop and 5 s time-metrics ticker
+(scheduler.go:271-316, 753-813) and the metrics-collector CronJob. Under a
+VirtualClock those behaviors ride clock timers (hermetic tests / replay);
+in a live deployment this daemon supplies the thread that makes the same
+code run in real time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+
+class SchedulerDaemon:
+    """One thread driving any number of schedulers + periodic callbacks."""
+
+    def __init__(self, schedulers: Sequence, poll_seconds: float = 0.5,
+                 ticker_seconds: float = 5.0,
+                 periodic: Optional[List[tuple]] = None):
+        """`periodic` is a list of (interval_seconds, fn) extras — e.g. the
+        metrics collector's collect_all at its cron interval."""
+        self.schedulers = list(schedulers)
+        self.poll_seconds = poll_seconds
+        self.ticker_seconds = ticker_seconds
+        self._periodic = [(interval, fn, [0.0]) for interval, fn
+                          in (periodic or [])]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_tick = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="voda-scheduler-daemon")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        import time
+        while not self._stop.is_set():
+            now = time.monotonic()
+            for sched in self.schedulers:
+                sched.pump()
+            if now - self._last_tick >= self.ticker_seconds:
+                self._last_tick = now
+                for sched in self.schedulers:
+                    sched.update_time_metrics()
+            for interval, fn, last in self._periodic:
+                if now - last[0] >= interval:
+                    last[0] = now
+                    try:
+                        fn()
+                    except Exception:  # keep the daemon alive
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "periodic task failed")
+            self._stop.wait(self.poll_seconds)

@@ -104,12 +104,7 @@ class RestServer:
         self._thread.start()
 
     def stop(self) -> None:
-        # shutdown() blocks until serve_forever acknowledges — which
-        # never happens if start() was never called (socketserver
-        # semantics); a constructed-but-unstarted server must still stop
-        # cleanly (e.g. VodaApp torn down before start()).
-        if self._thread is not None:
-            self.httpd.shutdown()
+        self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

@@ -8,6 +8,8 @@ churn — all without an API server.
 
 from typing import Any, Dict, List
 
+import os
+
 import pytest
 
 from vodascheduler_tpu.cluster.backend import ClusterEventKind
@@ -317,3 +319,33 @@ class TestVodaAppGke:
             assert app.store.get_job(name).status.value == "Completed"
         finally:
             app.stop()
+
+
+def test_pod_template_package_copy_matches_deploy_copy():
+    """The worker pod template ships as package data (a pip-installed
+    control plane has no repo checkout); deploy/gke keeps the
+    kubectl-facing copy. They must not drift."""
+    import vodascheduler_tpu.cluster as cluster
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(os.path.dirname(cluster.__file__),
+                       "worker_pod_template.yaml")
+    dep = os.path.join(repo, "deploy", "gke", "worker-pod-template.yaml")
+    assert open(pkg).read() == open(dep).read()
+
+
+def test_namespace_env_reaches_worker_pods(monkeypatch, tmp_path):
+    """VODA_NAMESPACE (the helm chart's knob) must flow through VodaApp
+    to GkeBackend so worker pods land in the chart's namespace instead
+    of the hardcoded default."""
+    monkeypatch.setenv("VODA_NAMESPACE", "my-ns")
+    from vodascheduler_tpu.service.app import VodaApp
+
+    kube = FakeKube([make_node("host-0")])
+    app = VodaApp(workdir=str(tmp_path), backend="gke", kube=kube,
+                  pools="v5p=1x1x1/1x1x1",
+                  service_port=0, scheduler_port=0, allocator_port=0,
+                  collector_interval_seconds=3600.0)
+    try:
+        assert app.backends["v5p"].namespace == "my-ns"
+    finally:
+        app.stop()
