@@ -7,7 +7,12 @@ themselves eagerly (e.g. the axon tunnel) — the config API call wins.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): child processes spawned by tests inherit
+# this env, and on TPU-attached images the ambient JAX_PLATFORMS (e.g.
+# "axon") would otherwise make hermetic subprocess probes target — and
+# hang on — the tunnel. TPU-marked tests override env explicitly when
+# spawning workers that should see the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -149,6 +149,7 @@ def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
               "step_time_ms": 1.0}}), flush=True)
         time.sleep(600)  # the wedge
     """))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
     monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "5")
     monkeypatch.setenv("VODA_BENCH_HW_PROBE_TIMEOUT", "120")
@@ -162,3 +163,115 @@ def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
     assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
     assert out["backend"] == "fake"
     assert "exceeded" in out.get("error", ""), out
+
+
+def _redirect_repo_dir(monkeypatch, bench, tmp_path):
+    """Make maybe_hardware see tmp_path as the repo root."""
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p, _real=os.path.dirname: str(tmp_path)
+                        if p == os.path.abspath(bench.__file__)
+                        else _real(p))
+
+
+def test_dead_tunnel_falls_back_to_cached_results(tmp_path, monkeypatch):
+    """When the accelerator probe never succeeds (dead tunnel — the r3
+    failure mode), maybe_hardware must emit the last-good cached results
+    tagged cached_from, not a bare error marker."""
+    import json
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    cached = {"backend": "tpu", "device_kind": "TPU v5 lite",
+              "models": [{"model": "llama_350m", "mfu": 0.38}],
+              "attention": []}
+    (tmp_path / "doc").mkdir()
+    (tmp_path / "doc" / "benchmarks_last_good.json").write_text(json.dumps(
+        {"captured_at": "2026-07-30T05:30:00Z", "hardware": cached}))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda repo_dir: (None, "accelerator probe timed "
+                                                "out (90s x3)"))
+    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    out = bench.maybe_hardware()
+    assert out["models"] == cached["models"]
+    assert out["cached_from"] == "2026-07-30T05:30:00Z"
+    assert "timed out" in out["live_error"]
+
+
+def test_dead_tunnel_without_cache_reports_error(tmp_path, monkeypatch):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda repo_dir: (None, "probe died"))
+    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    out = bench.maybe_hardware()
+    assert out == {"error": "probe died"}
+
+
+def test_probe_retries_then_succeeds(monkeypatch, tmp_path):
+    """_probe_backend must retry past transient flakes with backoff."""
+    import subprocess
+    import sys
+    import time
+    import types
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    calls = {"n": 0}
+    sleeps = []
+
+    def fake_run(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise subprocess.TimeoutExpired(cmd=a[0], timeout=kw["timeout"])
+        return types.SimpleNamespace(returncode=0, stdout="cpu\n", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    backend, err = bench._probe_backend(str(tmp_path))
+    assert backend == "cpu" and err is None
+    assert calls["n"] == 3
+    assert sleeps == [15, 30]  # backoff between attempts
+
+
+def test_successful_run_writes_last_good_cache(tmp_path, monkeypatch):
+    """A clean hardware run must refresh doc/benchmarks_last_good.json so
+    the NEXT flaked round has something to fall back on."""
+    import json
+    import sys
+    import textwrap
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    fake_pkg = tmp_path / "vodascheduler_tpu" / "runtime"
+    fake_pkg.mkdir(parents=True)
+    (tmp_path / "vodascheduler_tpu" / "__init__.py").write_text("")
+    (fake_pkg / "__init__.py").write_text("")
+    (fake_pkg / "hwbench.py").write_text(textwrap.dedent("""
+        import json
+        print(json.dumps({"kind": "meta", "data": {"backend": "fake"}}),
+              flush=True)
+        print(json.dumps({"kind": "model", "data": {"model": "m1",
+              "step_time_ms": 1.0}}), flush=True)
+    """))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
+    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "60")
+    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    out = bench.maybe_hardware()
+    assert "error" not in out, out
+    cache = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    assert cache["hardware"]["models"] == [{"model": "m1",
+                                            "step_time_ms": 1.0}]
+    assert cache["captured_at"]

@@ -438,7 +438,11 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
     # (e.g. Sq=520: fwd bq descends to 8, bwd bq=520), so check both.
     picks = [(bq, bk), (_pick_block(q.shape[1], block_bwd),
                         _pick_block(k.shape[1], block_bwd))]
-    aligned = all(pq % LSE_SUBLANES == 0 and (pk <= LANES or pk % LANES == 0)
+    # k blocks land as (1, 1, bk, D) tiles, so bk must sit on the 8-sublane
+    # grid even when it fits inside one lane group (e.g. bk=12 from S=12
+    # compiles to an off-sublane layout Mosaic rejects on real TPU).
+    aligned = all(pq % LSE_SUBLANES == 0 and pk % LSE_SUBLANES == 0
+                  and (pk <= LANES or pk % LANES == 0)
                   for pq, pk in picks)
     if (min(bq, bk) < MIN_BLOCK or not aligned) and q_offset is None:
         _warn_once(
