@@ -83,50 +83,126 @@ def parse_hw_stream(stdout: str) -> dict:
     return out
 
 
+LAST_GOOD_CACHE = os.path.join("doc", "benchmarks_last_good.json")
+
+
+def read_last_good(repo_dir: str):
+    """Most recent successful hardware section, or None."""
+    try:
+        with open(os.path.join(repo_dir, LAST_GOOD_CACHE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_last_good(repo_dir: str, hardware: dict) -> None:
+    import time
+    payload = {
+        "note": ("Last successful hardware-bench capture; bench.py emits "
+                 "this (tagged cached_from) when the accelerator tunnel is "
+                 "down at run time, so a transient flake never erases the "
+                 "round's hardware evidence."),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "hardware": hardware,
+    }
+    try:
+        path = os.path.join(repo_dir, LAST_GOOD_CACHE)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass  # read-only checkout: live results still print
+
+
+def _cached_fallback(repo_dir: str, live_error: str):
+    cache = read_last_good(repo_dir)
+    if cache is None:
+        return {"error": live_error}
+    out = dict(cache.get("hardware") or {})
+    out["cached_from"] = cache.get("captured_at", "unknown")
+    out["cache_note"] = ("accelerator unreachable at bench time; these are "
+                         "the last-good measured results (see cached_from)")
+    out["live_error"] = live_error
+    return out
+
+
+def _probe_backend(repo_dir: str):
+    """Backend name via a killable child, with bounded retries.
+
+    Returns (backend, None) on success or (None, error_string) after the
+    retries are spent. A dead tunnel hangs backend INIT inside native
+    code, so each attempt must be a subprocess we can kill from outside;
+    retries + backoff ride out transient tunnel flakes (r3 lost its
+    official hardware record to a single 120 s probe hang)."""
+    import subprocess
+    import sys
+    import time
+    probe = int(os.environ.get("VODA_BENCH_HW_PROBE_TIMEOUT", "90"))
+    retries = max(1, int(os.environ.get("VODA_BENCH_HW_PROBE_RETRIES", "3")))
+    err = "unknown"
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(min(60, 15 * attempt))
+        try:
+            probe_res = subprocess.run(
+                [sys.executable, "-c",
+                 # The config update makes JAX_PLATFORMS=cpu win over an
+                 # eagerly-registered TPU plugin (hermetic tests set it;
+                 # in production it is unset, probing the real backend).
+                 "import os, jax, numpy;\n"
+                 "if os.environ.get('JAX_PLATFORMS', '') == 'cpu':\n"
+                 "    jax.config.update('jax_platforms', 'cpu')\n"
+                 "print(jax.default_backend());"
+                 "float(numpy.asarray(jax.numpy.ones(()) + 1))"],
+                capture_output=True, text=True, timeout=probe,
+                cwd=repo_dir)
+        except subprocess.TimeoutExpired:
+            err = f"accelerator probe timed out ({probe}s x{attempt + 1})"
+            continue
+        if probe_res.returncode != 0:
+            err = (f"accelerator probe failed: "
+                   f"{probe_res.stderr.strip()[-300:]}")
+            continue
+        return probe_res.stdout.strip().splitlines()[-1], None
+    return None, err
+
+
 def maybe_hardware():
     """Measured numbers from the real chip; None off-accelerator (or when
-    VODA_BENCH_HW=0 skips it), an {"error": ...} marker if the
-    accelerator is present but the bench fails (e.g. tunnel flake) — the
-    replay headline must still print.
+    VODA_BENCH_HW=0 skips it). If the accelerator is present but
+    unreachable (tunnel flake), emits the last-good cached results tagged
+    `cached_from` instead of a bare error — the replay headline must
+    still print either way.
 
     The whole hardware section runs in a SUBPROCESS (hwbench --stream)
-    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 1800s): a
-    wedged remote compile blocks inside native code holding the GIL,
-    where no in-process signal can interrupt it (observed live in r3 —
-    a SIGALRM watchdog sailed straight past its deadline). Killing the
-    child from outside always works, and the streamed per-point JSON
-    lines mean every point completed before the wedge is kept. Popen +
-    a post-kill communicate() drain is load-bearing: subprocess.run()
-    on POSIX discards already-flushed child output on timeout."""
+    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 1800s) AND a
+    per-point stall watchdog (VODA_BENCH_HW_STALL_TIMEOUT, default 600s
+    between streamed lines): a wedged remote compile blocks inside
+    native code holding the GIL, where no in-process signal can
+    interrupt it (observed live in r3 — a SIGALRM watchdog sailed
+    straight past its deadline). Killing the child from outside always
+    works, and the streamed per-point JSON lines mean every point
+    completed before the wedge is kept. The reader thread (not
+    communicate()) is load-bearing: subprocess.run() on POSIX discards
+    already-flushed child output on timeout."""
     if os.environ.get("VODA_BENCH_HW") == "0":
         return None
     import subprocess
     import sys
+    import threading
+    import time
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
-        # A dead tunnel hangs backend INIT too — probe cheaply first so
-        # the full child (and its import costs) isn't spent learning it.
-        probe = int(os.environ.get("VODA_BENCH_HW_PROBE_TIMEOUT", "120"))
-        probe_res = subprocess.run(
-            [sys.executable, "-c",
-             # The config update makes JAX_PLATFORMS=cpu win over an
-             # eagerly-registered TPU plugin (hermetic tests set it; in
-             # production it is unset and the real backend is probed).
-             "import os, jax, numpy;\n"
-             "if os.environ.get('JAX_PLATFORMS', '') == 'cpu':\n"
-             "    jax.config.update('jax_platforms', 'cpu')\n"
-             "print(jax.default_backend());"
-             "float(numpy.asarray(jax.numpy.ones(()) + 1))"],
-            capture_output=True, text=True, timeout=probe)
-        if probe_res.returncode != 0:
-            return {"error": f"accelerator probe failed: "
-                             f"{probe_res.stderr.strip()[-300:]}"}
-        backend = probe_res.stdout.strip().splitlines()[-1]
+        backend, probe_err = _probe_backend(repo_dir)
+        if backend is None:
+            return _cached_fallback(repo_dir, probe_err)
         if backend not in ("tpu", "gpu") and not os.environ.get(
                 "VODA_HWBENCH_ON_CPU"):  # tests drive the full path on CPU
             return None
 
         timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
+        stall = int(os.environ.get("VODA_BENCH_HW_STALL_TIMEOUT", "600"))
         cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
                "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]
         # cwd pins the child's import root: the package is run from the
@@ -137,30 +213,65 @@ def maybe_hardware():
         # void every salvaged point.
         child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                  stderr=subprocess.PIPE, cwd=repo_dir)
-        timed_out = False
-        try:
-            stdout_b, stderr_b = child.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            child.kill()
-            stdout_b, stderr_b = child.communicate()
-            timed_out = True
-        stdout = (stdout_b or b"").decode("utf-8", errors="replace")
-        stderr_tail = (stderr_b or b"").decode(
+        chunks = {"out": [], "err": []}
+        last_line = [time.monotonic()]
+
+        def _drain(pipe, key, bump):
+            for raw in iter(pipe.readline, b""):
+                chunks[key].append(raw)
+                if bump:
+                    last_line[0] = time.monotonic()
+
+        readers = [
+            threading.Thread(target=_drain, args=(child.stdout, "out", True),
+                             daemon=True),
+            threading.Thread(target=_drain, args=(child.stderr, "err", False),
+                             daemon=True),
+        ]
+        for t in readers:
+            t.start()
+        start = time.monotonic()
+        timed_out = stalled = False
+        while child.poll() is None:
+            now = time.monotonic()
+            if now - start > timeout:
+                timed_out = True
+            elif now - last_line[0] > stall:
+                timed_out = stalled = True
+            if timed_out:
+                child.kill()
+                break
+            time.sleep(0.2)
+        child.wait()
+        for t in readers:
+            t.join(timeout=5)
+        stdout = b"".join(chunks["out"]).decode("utf-8", errors="replace")
+        stderr_tail = b"".join(chunks["err"]).decode(
             "utf-8", errors="replace").strip()[-300:]
         failed = timed_out or child.returncode != 0
 
         out = parse_hw_stream(stdout)
-        if timed_out:
+        if stalled:
+            out["error"] = (f"hardware bench stalled: no completed point "
+                            f"for {stall}s (deadline exceeded); points "
+                            "above completed before the stall")
+        elif timed_out:
             out["error"] = (f"hardware bench exceeded {timeout}s and was "
                             "killed; points above completed before the "
                             "deadline")
         elif failed:
             out["error"] = f"hardware bench subprocess failed: {stderr_tail}"
-        if not out["models"] and not out["attention"] and "error" not in out:
-            out["error"] = "hardware bench produced no points"
+        if not out["models"] and not out["attention"]:
+            # Nothing measured at all: a flaked tunnel, not a slow point.
+            # The cached last-good numbers are strictly more informative.
+            return _cached_fallback(
+                repo_dir, out.get("error", "hardware bench produced "
+                                           "no points"))
+        if "error" not in out:
+            write_last_good(repo_dir, out)
         return out
     except Exception as e:  # noqa: BLE001 - report, don't die
-        return {"error": f"{type(e).__name__}: {e}"}
+        return _cached_fallback(repo_dir, f"{type(e).__name__}: {e}")
 
 
 def main() -> None:
