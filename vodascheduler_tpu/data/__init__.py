@@ -1,0 +1,25 @@
+"""Real-dataset path for training jobs.
+
+The reference's examples train real MNIST/CIFAR end-to-end (reference:
+examples/py/tensorflow2/tensorflow2_keras_mnist_elastic.py:100-126 —
+keras.datasets.mnist + h5/CSV-epoch resume); the synthetic-batch makers
+in models/registry.py deliberately keep the framework hermetic, but a
+framework whose every batch is `jax.random` noise can't demonstrate that
+a checkpoint-restart resize *preserves training*. This package is the
+real-data counterpart, dependency-light by construction: every dataset
+here ships inside packages already baked into the image (no downloads).
+"""
+
+from vodascheduler_tpu.data.real import (
+    RealDataset,
+    eval_classifier,
+    load_digits_dataset,
+    make_sampling_batch_fn,
+)
+
+__all__ = [
+    "RealDataset",
+    "eval_classifier",
+    "load_digits_dataset",
+    "make_sampling_batch_fn",
+]

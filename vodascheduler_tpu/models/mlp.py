@@ -18,6 +18,8 @@ class MlpConfig:
 
 
 MNIST_MLP = MlpConfig()
+# Sized for the bundled UCI digits data (data/real.py): 8x8 real images.
+DIGITS_MLP = MlpConfig(input_dim=64, hidden=128)
 
 
 class Mlp(nn.Module):

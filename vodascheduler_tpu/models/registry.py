@@ -93,6 +93,17 @@ def _cls_loss(apply_fn, params, batch):
         logits.astype(jnp.float32), batch["labels"]).mean()
 
 
+def _digits_bundle() -> ModelBundle:
+    from vodascheduler_tpu.data import (
+        load_digits_dataset,
+        make_sampling_batch_fn,
+    )
+    return ModelBundle(
+        name="digits_mlp", module=mlp.Mlp(mlp.DIGITS_MLP),
+        make_batch=make_sampling_batch_fn(load_digits_dataset()),
+        loss_fn=_cls_loss, rules=CONV_RULES)
+
+
 def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
     return {
         "mnist_mlp": lambda: ModelBundle(
@@ -101,6 +112,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             loss_fn=lambda a, p, b: _cls_loss(
                 lambda pp, x: a(pp, x.reshape(x.shape[0], -1)), p, b),
             rules=CONV_RULES),
+        # Real data (data/real.py): the batch stream is a pure function
+        # of the checkpointed rng, so resizes resume it exactly — the
+        # convergence-across-resize evidence the synthetic bundles can't
+        # give (reference trains real MNIST the same way:
+        # examples/py/tensorflow2/tensorflow2_keras_mnist_elastic.py:100-126).
+        "digits_mlp": _digits_bundle,
         "resnet50": lambda: ModelBundle(
             name="resnet50", module=resnet.ResNet(resnet.RESNET50),
             make_batch=_image_batch(224, 3, 1000), loss_fn=_cls_loss,
