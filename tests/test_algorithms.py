@@ -214,6 +214,40 @@ class TestElasticTiresias:
                          speedup={n: float(n) for n in range(10)})]
         assert ElasticTiresias().schedule(jobs, 3) == {"run": 3}
 
+    def test_floor_lift_rescues_long_starved_job(self):
+        """r4 tail guard: a job stuck at its floor past
+        FLOOR_LIFT_AGE_SECONDS outbids a better-gain young job for the
+        leftover chip; the boost vanishes once it is off the floor, so
+        lifted jobs cannot hoard."""
+        from vodascheduler_tpu.common.types import JobStatus
+
+        def running(name, running_seconds, speedup):
+            j = make_job(name, num_chips=1, min_chips=1, max_chips=4,
+                         speedup=speedup, first_start_time=1.0,
+                         status=JobStatus.RUNNING)
+            j.metrics.running_seconds = running_seconds
+            return j
+
+        young = running("young", 100.0,
+                        {0: 0, 1: 1.0, 2: 1.9, 3: 2.7, 4: 3.4})  # gain .9
+        old = running("old", 5000.0,
+                      {0: 0, 1: 1.0, 2: 1.6, 3: 1.7, 4: 1.75})   # gain .6
+        # One leftover chip: raw gain prefers young (0.9 > 0.6), but the
+        # floor lift doubles old's bid (1.2) — old gets off the floor.
+        assert ElasticTiresias().schedule([young, old], 3) == {
+            "young": 1, "old": 2}
+        # Same shape, old not yet past the lift age: young wins.
+        old_fresh = running("old", 100.0,
+                            {0: 0, 1: 1.0, 2: 1.6, 3: 1.7, 4: 1.75})
+        assert ElasticTiresias().schedule([young, old_fresh], 3) == {
+            "young": 2, "old": 1}
+        # Two leftovers: old takes ONE (off the floor), then competes
+        # unboosted (gain 0.1 < 0.9) — young takes the second. No hoard.
+        old2 = running("old", 5000.0,
+                       {0: 0, 1: 1.0, 2: 1.6, 3: 1.7, 4: 1.75})
+        assert ElasticTiresias().schedule([young, old2], 4) == {
+            "young": 2, "old": 2}
+
     def test_pending_job_needs_full_min(self):
         jobs = [
             make_job("running", num_chips=1, min_chips=1, max_chips=2,
