@@ -321,6 +321,193 @@ class TestVodaAppGke:
             app.stop()
 
 
+class FlakyKube(FakeKube):
+    """FakeKube with scriptable fault injection: raises the queued
+    exception on the next matching API call (5xx storm / timeout
+    simulation)."""
+
+    def __init__(self, nodes):
+        super().__init__(nodes)
+        self.fail_list_pods: List[Exception] = []
+        self.fail_list_nodes: List[Exception] = []
+        self.fail_delete_pod: List[Exception] = []
+
+    @staticmethod
+    def _maybe_raise(queue: List[Exception]) -> None:
+        if queue:
+            raise queue.pop(0)
+
+    def list_pods(self, namespace, label_selector=""):
+        self._maybe_raise(self.fail_list_pods)
+        return super().list_pods(namespace, label_selector)
+
+    def list_nodes(self, label_selector=""):
+        self._maybe_raise(self.fail_list_nodes)
+        return super().list_nodes(label_selector)
+
+    def delete_pod(self, namespace, name, grace_seconds=30):
+        self._maybe_raise(self.fail_delete_pod)
+        super().delete_pod(namespace, name, grace_seconds)
+
+
+def _http_error(code: int) -> Exception:
+    import io
+    import urllib.error
+    return urllib.error.HTTPError("http://api", code, "boom", {},
+                                  io.BytesIO(b""))
+
+
+class TestApiFaultTolerance:
+    """The failure paths the reference gets from client-go informers
+    (resync + reconnect, scheduler.go:169-242) — here: poll backoff,
+    counted failures, and loss-proof terminal events."""
+
+    def test_failed_sweep_keeps_job_tracked(self, world):
+        kube, backend, events = world
+        backend.start_job(spec(), 4, placements=[("host-1", 4)])
+        flaky_err = _http_error(503)
+        kube.fail = [flaky_err]
+        orig = kube.list_pods
+
+        def flaky(namespace, label_selector=""):
+            if kube.fail:
+                raise kube.fail.pop(0)
+            return orig(namespace, label_selector)
+        kube.list_pods = flaky
+        with pytest.raises(Exception):
+            backend.poll_once()
+        # Job still tracked; a later healthy sweep completes it normally.
+        assert "job-a" in backend.running_jobs()
+        kube.finish_pod("voda-job-a-i1-w0", 0)
+        backend.poll_once()
+        assert [e.kind for e in events if e.name == "job-a"] == [
+            ClusterEventKind.JOB_COMPLETED]
+
+    def test_monitor_counts_failures_and_backs_off(self):
+        kube = FlakyKube([make_node("host-0")])
+
+        # No informer thread at all: this test drives poll_once manually
+        # and mutates the failure counter, and FlakyKube's fault queues
+        # are not thread-safe (the threaded path is covered by
+        # test_monitor_thread_survives_api_storm).
+        class NoThreadBackend(GkeBackend):
+            def _ensure_monitor(self):
+                pass
+
+        backend = NoThreadBackend(kube, pod_template=template(),
+                                  poll_interval_seconds=2.0)
+        try:
+            assert backend._poll_delay() == 2.0
+            kube.fail_list_nodes = [_http_error(503)] * 3
+            for expected in (1, 2, 3):
+                try:
+                    backend.poll_once()
+                except Exception:
+                    backend.monitor_consecutive_failures += 1
+                assert backend.monitor_consecutive_failures == expected
+            # Exponential, capped.
+            assert backend._poll_delay() == 16.0
+            backend.monitor_consecutive_failures = 50
+            assert backend._poll_delay() == GkeBackend.MONITOR_MAX_BACKOFF_SECONDS
+            backend.poll_once()  # healthy again
+            backend.monitor_consecutive_failures = 0
+            assert backend._poll_delay() == 2.0
+        finally:
+            backend.close()
+
+    def test_monitor_thread_survives_api_storm(self):
+        """End-to-end through the real monitor loop: sweeps fail, the
+        thread logs + counts + keeps going, then recovers."""
+        import time as _time
+        kube = FlakyKube([make_node("host-0")])
+        backend = GkeBackend(kube, pod_template=template(),
+                             poll_interval_seconds=0.01)
+        try:
+            kube.fail_list_nodes = [_http_error(503)] * 4
+            deadline = _time.time() + 10
+            while _time.time() < deadline and kube.fail_list_nodes:
+                _time.sleep(0.02)
+            assert not kube.fail_list_nodes  # storm consumed, thread alive
+            deadline = _time.time() + 10
+            while (_time.time() < deadline
+                   and backend.monitor_consecutive_failures != 0):
+                _time.sleep(0.02)
+            assert backend.monitor_consecutive_failures == 0  # recovered
+            assert backend._monitor.is_alive()
+        finally:
+            backend.close()
+
+    def test_terminal_event_survives_cleanup_failure(self, world):
+        """A 5xx on the terminal-pod delete must not lose JOB_COMPLETED —
+        the scheduler would wait on a 'running' job forever."""
+        kube, backend, events = world
+        backend.start_job(spec(), 4, placements=[("host-1", 4)])
+        kube.finish_pod("voda-job-a-i1-w0", 0)
+        orig = kube.delete_pod
+
+        def failing_delete(namespace, name, grace_seconds=30):
+            raise _http_error(503)
+        kube.delete_pod = failing_delete
+        backend.poll_once()
+        kube.delete_pod = orig
+        assert [e.kind for e in events if e.name == "job-a"] == [
+            ClusterEventKind.JOB_COMPLETED]
+        assert "job-a" not in backend.running_jobs()
+
+
+class TestTokenRefresh:
+    def test_401_forces_token_reread_and_retry(self, tmp_path, monkeypatch):
+        """Bound serviceaccount tokens rotate; a 401 must re-read the
+        projected file and retry once with the fresh token."""
+        import urllib.request
+
+        from vodascheduler_tpu.cluster.gke import InClusterKube
+
+        token_file = tmp_path / "token"
+        token_file.write_text("stale-token")
+        monkeypatch.setattr(InClusterKube, "SA_DIR", str(tmp_path))
+        kube = InClusterKube(base_url="https://api.fake")
+
+        seen = []
+
+        class FakeResp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            @staticmethod
+            def read():
+                return b'{"items": []}'
+
+        def fake_urlopen(req, context=None, timeout=None):
+            auth = req.get_header("Authorization")
+            seen.append(auth)
+            if auth == "Bearer stale-token":
+                token_file.write_text("fresh-token")  # kubelet rotated it
+                raise _http_error(401)
+            return FakeResp()
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        out = kube.list_pods("ns")
+        assert out == []
+        assert seen == ["Bearer stale-token", "Bearer fresh-token"]
+
+    def test_periodic_reread_picks_up_rotation(self, tmp_path, monkeypatch):
+        from vodascheduler_tpu.cluster.gke import InClusterKube
+
+        token_file = tmp_path / "token"
+        token_file.write_text("t1")
+        monkeypatch.setattr(InClusterKube, "SA_DIR", str(tmp_path))
+        kube = InClusterKube(base_url="https://api.fake")
+        assert kube._fresh_token() == "t1"       # within refresh window
+        token_file.write_text("t2")
+        assert kube._fresh_token() == "t1"       # still cached
+        kube._token_read_at -= 120.0             # age past the window
+        assert kube._fresh_token() == "t2"       # rotated token picked up
+
+
 def test_pod_template_package_copy_matches_deploy_copy():
     """The worker pod template ships as package data (a pip-installed
     control plane has no repo checkout); deploy/gke keeps the

@@ -29,14 +29,23 @@ Design:
   checkpoint, exit PREEMPTED_EXIT_CODE) — the k8s transport and the
   local transports share one protocol.
 - A poll thread turns pod phases into JOB_COMPLETED/JOB_FAILED events
-  and node-list diffs into HOST_ADDED/HOST_REMOVED — the informer analog
-  (reference watches; polling keeps the stdlib client simple and the
-  scheduler contract identical).
+  and node-list diffs into HOST_ADDED/HOST_REMOVED — the informer analog.
+  The reference uses client-go watch informers
+  (scheduler.go:169-242): sub-second reaction, one long-lived connection,
+  but a large dependency and relist/resync subtleties. Polling trades
+  event latency (bounded by poll_interval_seconds, default 2 s — already
+  far under the 30 s resched rate limit that actually gates reaction
+  time) for a stdlib-only client and trivially fake-able tests. API
+  failures degrade gracefully: a failed sweep is retried with exponential
+  backoff (monitor_consecutive_failures observable), and terminal-event
+  emission is ordered so a mid-sweep API error can delay but never lose
+  a JOB_COMPLETED/JOB_FAILED event.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import ssl
 import threading
@@ -53,6 +62,8 @@ from vodascheduler_tpu.cluster.backend import (
 )
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+LOG = logging.getLogger(__name__)
 
 DEFAULT_NAMESPACE = "voda-scheduler"
 COORDINATOR_PORT = 8476
@@ -91,33 +102,69 @@ class InClusterKube:
 
     SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+    # Re-read the projected token file at most this often. Bound
+    # serviceaccount tokens rotate (kubelet refreshes the projected file
+    # well before the ~1 h expiry); a token cached forever starts
+    # drawing 401s about an hour after the control plane boots.
+    TOKEN_REFRESH_SECONDS = 60.0
+
     def __init__(self, base_url: Optional[str] = None,
                  token: Optional[str] = None,
                  ca_path: Optional[str] = None):
         host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         self.base_url = base_url or f"https://{host}:{port}"
+        self._token_path = (None if token is not None
+                            else os.path.join(self.SA_DIR, "token"))
+        self._token_read_at = time.monotonic()
         if token is None:
-            with open(os.path.join(self.SA_DIR, "token")) as f:
-                token = f.read().strip()
+            token = self._read_token()
         self.token = token
         ca = ca_path or os.path.join(self.SA_DIR, "ca.crt")
         self._ctx = ssl.create_default_context(
             cafile=ca if os.path.exists(ca) else None)
+
+    def _read_token(self) -> str:
+        with open(self._token_path) as f:
+            return f.read().strip()
+
+    def _fresh_token(self, force: bool = False) -> str:
+        if self._token_path is not None and (
+                force or time.monotonic() - self._token_read_at
+                > self.TOKEN_REFRESH_SECONDS):
+            try:
+                self.token = self._read_token()
+                self._token_read_at = time.monotonic()
+            except OSError:  # keep the old token; maybe a transient blip
+                LOG.warning("serviceaccount token re-read failed; "
+                            "continuing with cached token", exc_info=True)
+        return self.token
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,
                  query: str = "") -> Any:
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method, headers={
-            "Authorization": f"Bearer {self.token}",
-            "Content-Type": "application/json",
-            "Accept": "application/json",
-        })
-        with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
-            payload = r.read()
-        return json.loads(payload) if payload else None
+
+        def send(token: str):
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers={
+                                             "Authorization": f"Bearer {token}",
+                                             "Content-Type": "application/json",
+                                             "Accept": "application/json",
+                                         })
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=30) as r:
+                payload = r.read()
+            return json.loads(payload) if payload else None
+
+        try:
+            return send(self._fresh_token())
+        except urllib.error.HTTPError as e:
+            if e.code != 401 or self._token_path is None:
+                raise
+            # Expired/rotated token: force a re-read and retry once.
+            return send(self._fresh_token(force=True))
 
     def create_pod(self, namespace, manifest):
         return self._request("POST", f"/api/v1/namespaces/{namespace}/pods",
@@ -178,6 +225,9 @@ def _job_selector(job: str) -> str:
 class GkeBackend(ClusterBackend):
     """ClusterBackend over a (fake or real) Kubernetes API."""
 
+    # Ceiling for the monitor's failure backoff (see _poll_delay).
+    MONITOR_MAX_BACKOFF_SECONDS = 60.0
+
     def __init__(self, kube: KubeApi,
                  namespace: str = DEFAULT_NAMESPACE,
                  pod_template: Optional[Dict[str, Any]] = None,
@@ -224,6 +274,10 @@ class GkeBackend(ClusterBackend):
         self._lock = threading.RLock()
         self._closed = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # Observable health of the informer analog: consecutive failed
+        # sweeps (0 = healthy). Drives the poll backoff and belongs on a
+        # status page next to the reference's informer-resync logging.
+        self.monitor_consecutive_failures = 0
         self._known_hosts = self._nodes_now()
         # The node-informer role outlives job presence: host churn (node
         # pool resizes, spot reclaims) must reach the scheduler even when
@@ -505,10 +559,27 @@ class GkeBackend(ClusterBackend):
                 if self._jobs.pop(job, None) is None:
                     continue  # a concurrent sweep already reaped + emitted
                 self._specs.pop(job, None)
+            # Cleanup is best-effort ONCE the job has been claimed for
+            # reaping: an API error between the pop above and the emit
+            # below must not lose the terminal event (the scheduler would
+            # wait on a "running" job forever). Each delete is guarded
+            # INDIVIDUALLY — one flaked pod delete must not skip the
+            # Service delete (pods are terminal and eventually GC'd;
+            # an orphaned Service would live forever).
             for p in pods:
-                self.kube.delete_pod(self.namespace, p["metadata"]["name"],
-                                     grace_seconds=0)
-            self.kube.delete_service(self.namespace, self._svc_name(job))
+                try:
+                    self.kube.delete_pod(self.namespace,
+                                         p["metadata"]["name"],
+                                         grace_seconds=0)
+                except Exception:
+                    LOG.warning("terminal-pod delete for %s failed", job,
+                                exc_info=True)
+            try:
+                self.kube.delete_service(self.namespace, self._svc_name(job))
+            except Exception:
+                LOG.warning("coordinator-service delete for %s failed; "
+                            "emitting the job event anyway", job,
+                            exc_info=True)
             if codes and all(c == 0 for c in codes):
                 self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED, job,
                                        timestamp=time.time()))
@@ -541,9 +612,26 @@ class GkeBackend(ClusterBackend):
         while not self._closed.is_set():
             try:
                 self.poll_once()
-            except Exception:  # pragma: no cover - keep informer alive
-                pass
-            self._closed.wait(self.poll_interval_seconds)
+                self.monitor_consecutive_failures = 0
+            except Exception:
+                # API flake (5xx storm, timeout, transient DNS): keep the
+                # informer alive, but LOUDLY — log every failure, count
+                # them observably, and back off exponentially so a
+                # struggling apiserver isn't hammered at full poll rate.
+                self.monitor_consecutive_failures += 1
+                LOG.warning(
+                    "GKE poll sweep failed (%d consecutive)",
+                    self.monitor_consecutive_failures, exc_info=True)
+            self._closed.wait(self._poll_delay())
+
+    def _poll_delay(self) -> float:
+        """Poll interval with exponential backoff under consecutive API
+        failures, capped at MONITOR_MAX_BACKOFF_SECONDS."""
+        n = self.monitor_consecutive_failures
+        if n <= 0:
+            return self.poll_interval_seconds
+        return min(self.poll_interval_seconds * (2 ** min(n, 10)),
+                   self.MONITOR_MAX_BACKOFF_SECONDS)
 
     def close(self) -> None:
         self._closed.set()
