@@ -162,6 +162,44 @@ class TestTpuMonitor:
         # a device not observed this poll must not keep exporting
         assert 'device="99"' not in registry.exposition()
 
+    def test_sdk_utilization_metrics_exported(self, monkeypatch):
+        """Duty-cycle/tensorcore telemetry (the nvidia_smi_exporter role,
+        reference README.md:94) rides the same collect_once sweep; the
+        libtpu SDK source is injected since CI owns no chips."""
+        from vodascheduler_tpu.common.metrics import Registry
+        from vodascheduler_tpu.runtime import tpu_monitor
+        from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+
+        registry = Registry()
+        mon = TpuMonitor(registry)
+        monkeypatch.setattr(tpu_monitor, "_read_sdk_metrics", lambda: {
+            "duty_cycle_pct": [87.5],
+            "tensorcore_util": [42.0],
+            "hbm_capacity_usage": [11.0e9],
+        })
+        mon.collect_once()
+        text = registry.exposition()
+        assert 'voda_tpu_duty_cycle_pct{accelerator="0"} 87.5' in text
+        assert 'voda_tpu_tensorcore_util_pct{accelerator="0"} 42.0' in text
+        assert 'voda_tpu_hbm_usage_bytes{accelerator="0"} 11000000000.0' in text
+        # Unreported metrics export no stale series.
+        assert 'voda_tpu_throttle_score{' not in text
+        # Chips lost (e.g. job took ownership): series clear next sweep.
+        monkeypatch.setattr(tpu_monitor, "_read_sdk_metrics", lambda: {})
+        mon.collect_once()
+        assert 'voda_tpu_duty_cycle_pct{' not in registry.exposition()
+
+    def test_read_sdk_metrics_off_tpu_is_empty_or_partial(self):
+        """The real reader degrades to {} (or parseable floats) without
+        chips — never raises. On this image libtpu is installed but the
+        process owns no accelerator, so data() comes back empty."""
+        from vodascheduler_tpu.runtime.tpu_monitor import _read_sdk_metrics
+
+        out = _read_sdk_metrics()
+        assert isinstance(out, dict)
+        for values in out.values():
+            assert all(isinstance(v, float) for v in values)
+
     def test_labeled_gauge_exposition_format(self):
         from vodascheduler_tpu.common.metrics import Registry
 

@@ -39,6 +39,60 @@ _STAT_SERIES = (
      "voda_tpu_memory_largest_free_block_bytes"),
 )
 
+# libtpu SDK monitoring metrics (sdk.tpumonitoring.get_metric) -> series.
+# This is the duty-cycle/utilization half of the nvidia_smi_exporter role
+# (reference README.md:94): tensorcore busy fraction, accelerator duty
+# cycle, HBM use, and thermal/power throttling — per local accelerator.
+_SDK_SERIES = (
+    ("duty_cycle_pct", "voda_tpu_duty_cycle_pct",
+     "Percentage of time the accelerator was actively processing"),
+    ("tensorcore_util", "voda_tpu_tensorcore_util_pct",
+     "TensorCore (MXU) utilization percentage"),
+    ("hbm_capacity_usage", "voda_tpu_hbm_usage_bytes",
+     "HBM bytes in use as reported by libtpu"),
+    ("hbm_capacity_total", "voda_tpu_hbm_total_bytes",
+     "Total HBM bytes as reported by libtpu"),
+    ("tpu_throttle_score", "voda_tpu_throttle_score",
+     "Thermal/power throttling score (0 = unthrottled)"),
+)
+
+
+def _read_sdk_metrics() -> dict:
+    """{metric_name: [per-accelerator float, ...]} from the libtpu SDK
+    monitoring API; {} when libtpu is absent, the process doesn't own
+    the chips, or a metric is unsupported by this libtpu build.
+
+    `get_metric(name).data()` returns a list of strings, one per local
+    accelerator in index order (sdk.tpumonitoring.help()); off-TPU it is
+    empty, which callers treat as "nothing to export".
+    """
+    try:
+        from libtpu import sdk  # type: ignore
+        mon = sdk.tpumonitoring
+    except Exception:
+        return {}
+    try:
+        supported = set(mon.list_supported_metrics())
+    except Exception:
+        supported = {name for name, _, _ in _SDK_SERIES}
+    out = {}
+    for name, _, _ in _SDK_SERIES:
+        if name not in supported:
+            continue
+        try:
+            values = mon.get_metric(name).data()
+        except Exception:
+            continue  # chips owned by another process / metric flaked
+        parsed = []
+        for v in values:
+            try:
+                parsed.append(float(v))
+            except (TypeError, ValueError):
+                parsed.append(float("nan"))
+        if parsed:
+            out[name] = parsed
+    return out
+
 
 class TpuMonitor:
     """Polls local device memory stats into labeled gauges."""
@@ -54,6 +108,10 @@ class TpuMonitor:
                 f"Per-device memory stat {key} as reported by the runtime",
                 labels=("device", "platform"))
             for key, series in _STAT_SERIES
+        }
+        self.m_sdk = {
+            name: registry.gauge(series, desc, labels=("accelerator",))
+            for name, series, desc in _SDK_SERIES
         }
 
     def collect_once(self) -> None:
@@ -80,3 +138,9 @@ class TpuMonitor:
                         float(stats[key])
         for series, values in new_values.items():
             self.m_mem[series].set_all(values)
+        # Utilization/duty-cycle half (libtpu SDK; empty off-TPU).
+        sdk_values = _read_sdk_metrics()
+        for name, _, _ in _SDK_SERIES:
+            readings = sdk_values.get(name, [])
+            self.m_sdk[name].set_all(
+                {(str(i),): v for i, v in enumerate(readings)})
