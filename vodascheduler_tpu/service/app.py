@@ -220,6 +220,14 @@ class VodaApp:
             # Register the gauges only when collection actually runs — a
             # disabled monitor must not export voda_tpu_devices=0 as if a
             # healthy host had no accelerators.
+            if hermetic_devices is not None:
+                # Hermetic mode must PIN jax to cpu before the monitor's
+                # first device touch: on TPU-attached images the tunnel
+                # plugin registers eagerly and wins over the env var, and
+                # a dead tunnel then hangs device init (r4, observed) —
+                # same workaround as runtime/supervisor._configure_devices.
+                import jax
+                jax.config.update("jax_platforms", "cpu")
             from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
             self.tpu_monitor = TpuMonitor(self.registry)
             periodic.append((30.0, self.tpu_monitor.collect_once))

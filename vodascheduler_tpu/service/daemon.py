@@ -26,8 +26,9 @@ class SchedulerDaemon:
         self.schedulers = list(schedulers)
         self.poll_seconds = poll_seconds
         self.ticker_seconds = ticker_seconds
-        self._periodic = [(interval, fn, [0.0]) for interval, fn
-                          in (periodic or [])]
+        # last-fire timestamp + in-flight flag per periodic callback.
+        self._periodic = [(interval, fn, [0.0], threading.Event())
+                          for interval, fn in (periodic or [])]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_tick = 0.0
@@ -46,22 +47,53 @@ class SchedulerDaemon:
             self._thread.join(timeout=5.0)
 
     def _loop(self) -> None:
+        import logging
         import time
+        log = logging.getLogger(__name__)
         while not self._stop.is_set():
             now = time.monotonic()
+            # Every per-scheduler call is individually guarded: this
+            # thread IS the control plane's heartbeat in real-time mode —
+            # one pool's resched blowing up must not stop scheduling for
+            # every pool forever (observed live in r4: an exception out
+            # of pump() silently killed the daemon and stranded every
+            # waiting job).
             for sched in self.schedulers:
-                sched.pump()
+                try:
+                    sched.pump()
+                except Exception:
+                    log.exception("scheduler pump failed (pool %s)",
+                                  getattr(sched, "pool_id", "?"))
             if now - self._last_tick >= self.ticker_seconds:
                 self._last_tick = now
                 for sched in self.schedulers:
-                    sched.update_time_metrics()
-            for interval, fn, last in self._periodic:
-                if now - last[0] >= interval:
-                    last[0] = now
                     try:
-                        fn()
-                    except Exception:  # keep the daemon alive
-                        import logging
-                        logging.getLogger(__name__).exception(
-                            "periodic task failed")
+                        sched.update_time_metrics()
+                    except Exception:
+                        log.exception("time-metrics tick failed (pool %s)",
+                                      getattr(sched, "pool_id", "?"))
+            # Periodic callbacks run on their OWN threads: this loop is
+            # the scheduling heartbeat, and a periodic that blocks in
+            # native code (observed live in r4: the TPU monitor's
+            # jax.local_devices() hanging on a dead accelerator tunnel —
+            # unkillable, not an exception) must stall only itself, never
+            # pump(). A callback whose previous tick is still in flight
+            # is skipped, so a wedged task cannot pile up threads either.
+            for interval, fn, last, in_flight in self._periodic:
+                if now - last[0] >= interval and not in_flight.is_set():
+                    last[0] = now
+                    in_flight.set()
+
+                    def run(fn=fn, in_flight=in_flight):
+                        try:
+                            fn()
+                        except Exception:
+                            import logging
+                            logging.getLogger(__name__).exception(
+                                "periodic task failed")
+                        finally:
+                            in_flight.clear()
+
+                    threading.Thread(target=run, daemon=True,
+                                     name="voda-periodic").start()
             self._stop.wait(self.poll_seconds)
