@@ -53,9 +53,65 @@ def run_replay():
     return harness.run()
 
 
-HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m_8k", 2]]
+# llama_1b last: ≥1B params on one 16 GB chip (adafactor bundle) is the
+# most OOM-prone point, and the stream salvages earlier points if it dies.
+HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m_8k", 2], ["llama_1b", 4]]
 # Attention points inherit the child's DEFAULT_ATTENTION_POINTS
 # (runtime/hwbench.py) — one canonical sweep definition, no drift.
+# Elastic-resize cost points (runtime/resize_bench.py): the models whose
+# restart economics the replay's restart_overhead_seconds prices.
+RESIZE_POINTS = [["llama_350m", 8], ["mixtral_small", 8]]
+
+
+def _run_streamed_child(cmd, repo_dir, timeout, stall):
+    """Run a line-streaming child under the wedge watchdog.
+
+    Returns (stdout, stderr_tail, timed_out, stalled, returncode). cwd
+    pins the child's import root (the package runs from the source tree);
+    binary pipes + errors="replace" because SIGKILL can cut the stream
+    mid-byte; reader threads (not communicate()) because subprocess.run
+    on POSIX discards already-flushed output on timeout."""
+    import subprocess
+    import threading
+    import time
+    child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, cwd=repo_dir)
+    chunks = {"out": [], "err": []}
+    last_line = [time.monotonic()]
+
+    def _drain(pipe, key, bump):
+        for raw in iter(pipe.readline, b""):
+            chunks[key].append(raw)
+            if bump:
+                last_line[0] = time.monotonic()
+
+    readers = [
+        threading.Thread(target=_drain, args=(child.stdout, "out", True),
+                         daemon=True),
+        threading.Thread(target=_drain, args=(child.stderr, "err", False),
+                         daemon=True),
+    ]
+    for t in readers:
+        t.start()
+    start = time.monotonic()
+    timed_out = stalled = False
+    while child.poll() is None:
+        now = time.monotonic()
+        if now - start > timeout:
+            timed_out = True
+        elif now - last_line[0] > stall:
+            timed_out = stalled = True
+        if timed_out:
+            child.kill()
+            break
+        time.sleep(0.2)
+    child.wait()
+    for t in readers:
+        t.join(timeout=5)
+    stdout = b"".join(chunks["out"]).decode("utf-8", errors="replace")
+    stderr_tail = b"".join(chunks["err"]).decode(
+        "utf-8", errors="replace").strip()[-300:]
+    return stdout, stderr_tail, timed_out, stalled, child.returncode
 
 
 def parse_hw_stream(stdout: str) -> dict:
@@ -80,6 +136,8 @@ def parse_hw_stream(stdout: str) -> dict:
             out["attention"].append(data)
         elif kind == "moe":
             out["moe"] = data
+        elif kind == "resize":
+            out.setdefault("resize", []).append(data)
     return out
 
 
@@ -205,50 +263,9 @@ def maybe_hardware():
         stall = int(os.environ.get("VODA_BENCH_HW_STALL_TIMEOUT", "600"))
         cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
                "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]
-        # cwd pins the child's import root: the package is run from the
-        # source tree, and `python /path/to/bench.py` from elsewhere
-        # must not strand the child without `vodascheduler_tpu`.
-        # Binary pipes + errors="replace" decode: SIGKILL can cut the
-        # stream at any byte, and one undecodable tail byte must not
-        # void every salvaged point.
-        child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                 stderr=subprocess.PIPE, cwd=repo_dir)
-        chunks = {"out": [], "err": []}
-        last_line = [time.monotonic()]
-
-        def _drain(pipe, key, bump):
-            for raw in iter(pipe.readline, b""):
-                chunks[key].append(raw)
-                if bump:
-                    last_line[0] = time.monotonic()
-
-        readers = [
-            threading.Thread(target=_drain, args=(child.stdout, "out", True),
-                             daemon=True),
-            threading.Thread(target=_drain, args=(child.stderr, "err", False),
-                             daemon=True),
-        ]
-        for t in readers:
-            t.start()
-        start = time.monotonic()
-        timed_out = stalled = False
-        while child.poll() is None:
-            now = time.monotonic()
-            if now - start > timeout:
-                timed_out = True
-            elif now - last_line[0] > stall:
-                timed_out = stalled = True
-            if timed_out:
-                child.kill()
-                break
-            time.sleep(0.2)
-        child.wait()
-        for t in readers:
-            t.join(timeout=5)
-        stdout = b"".join(chunks["out"]).decode("utf-8", errors="replace")
-        stderr_tail = b"".join(chunks["err"]).decode(
-            "utf-8", errors="replace").strip()[-300:]
-        failed = timed_out or child.returncode != 0
+        stdout, stderr_tail, timed_out, stalled, rc = _run_streamed_child(
+            cmd, repo_dir, timeout, stall)
+        failed = timed_out or rc != 0
 
         out = parse_hw_stream(stdout)
         if stalled:
@@ -261,6 +278,30 @@ def maybe_hardware():
                             "deadline")
         elif failed:
             out["error"] = f"hardware bench subprocess failed: {stderr_tail}"
+        if "error" in out and os.environ.get("VODA_BENCH_RESIZE") != "0":
+            # Absence must be distinguishable from "not configured":
+            # record WHY the resize sweep did not run.
+            out["resize_error"] = ("skipped: hardware bench did not "
+                                   "complete cleanly")
+        elif os.environ.get("VODA_BENCH_RESIZE") != "0":
+            # Elastic-resize cost (save / cold start / restore / first
+            # step): runs AFTER the hwbench child has exited — its
+            # measurement children must be able to take the chip.
+            rz_timeout = int(os.environ.get("VODA_BENCH_RESIZE_TIMEOUT",
+                                            "2400"))
+            rz_cmd = [sys.executable, "-m",
+                      "vodascheduler_tpu.runtime.resize_bench",
+                      json.dumps({"stream": True,
+                                  "points": RESIZE_POINTS})]
+            rz_out, rz_err, rz_to, _rz_stall, rz_rc = _run_streamed_child(
+                rz_cmd, repo_dir, rz_timeout, rz_timeout)
+            rz = parse_hw_stream(rz_out).get("resize", [])
+            if rz:
+                out["resize"] = rz
+            if rz_to or rz_rc != 0:
+                out["resize_error"] = (
+                    f"resize bench {'timed out' if rz_to else 'failed'}: "
+                    f"{rz_err}")
         if not out["models"] and not out["attention"]:
             # Nothing measured at all: a flaked tunnel, not a slow point.
             # The cached last-good numbers are strictly more informative.

@@ -90,6 +90,7 @@ def test_two_point_differencing_cancels_overhead():
     assert abs(s - 0.25) < 1e-9
 
 
+@pytest.mark.slow
 def test_stream_main_emits_parseable_lines():
     """hwbench --stream (the subprocess mode bench.py drives) emits one
     JSON line per completed item; bench.parse_hw_stream rebuilds the
@@ -267,6 +268,7 @@ def test_successful_run_writes_last_good_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
     monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "60")
+    monkeypatch.setenv("VODA_BENCH_RESIZE", "0")  # fake tree has no module
     _redirect_repo_dir(monkeypatch, bench, tmp_path)
     out = bench.maybe_hardware()
     assert "error" not in out, out

@@ -1,0 +1,54 @@
+"""Elastic-resize cost bench (runtime/resize_bench.py), hermetically.
+
+The measurement itself is meaningful only on hardware; these tests pin
+the machinery — two sequential children, cross-process mark stitching,
+per-phase segments, the replay-facing resize_cost_seconds rollup — on
+the CPU platform with a tiny model.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # spawns two jax subprocesses (~90 s)
+
+
+def test_resize_cost_breakdown_tiny(monkeypatch, tmp_path):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
+    from vodascheduler_tpu.runtime.resize_bench import bench_resize_cost
+
+    out = bench_resize_cost("llama_tiny", 2, warm_steps=2,
+                            workdir=os.fspath(tmp_path))
+    assert out["model"] == "llama_tiny"
+    assert out["backend"] == "cpu"
+    assert out["checkpoint_bytes"] > 100_000
+    # Async initiate must cost less than the full drain (the point of
+    # overlapping the shard writes with training).
+    assert 0 < out["save_async_initiate_ms"]
+    assert 0 < out["save_sync_ms"]
+    seg = out["restart_segments_ms"]
+    for mark in ("proc_start_ms", "backend_ready_ms", "restored_ms",
+                 "first_step_done_ms"):
+        assert seg[mark] >= 0, seg
+    # Total restart is the sum of its segments (same monotonic clock).
+    assert abs(sum(seg.values()) - out["restart_total_ms"]) < 1.0
+    assert out["resize_cost_seconds"] > 0
+
+
+def test_stream_mode_emits_resize_lines(monkeypatch, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", VODA_HWBENCH_ON_CPU="1")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "vodascheduler_tpu.runtime.resize_bench",
+         json.dumps({"stream": True, "points": [["llama_tiny", 2]]})],
+        capture_output=True, text=True, timeout=560, env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-500:]
+    sys.path.insert(0, repo)
+    from bench import parse_hw_stream
+    out = parse_hw_stream(r.stdout)
+    assert out["resize"][0]["model"] == "llama_tiny"
+    assert out["resize"][0]["restart_total_ms"] > 0

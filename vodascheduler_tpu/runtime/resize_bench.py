@@ -1,0 +1,235 @@
+"""Measured elastic-resize cost: the number the scheduling economics
+rides on.
+
+SURVEY.md §7 names restart-cost << epoch-time as hard part (a): every
+ElasticTiresias lease, hysteresis and cooldown knob — and the replay's
+`restart_overhead_seconds` — prices a resize. The reference never had to
+measure it (Horovod live ring re-form made resize nearly free by
+construction: /root/reference/examples/yaml/tensorflow2/
+tensorflow2-keras-mnist-elastic.yaml:32-44); the TPU design's
+checkpoint-restart resize is NOT free, so it must be measured, not
+assumed.
+
+What a resize costs end-to-end, as three measured phases:
+
+  (a) checkpoint save — async initiate (what the running job blocks on),
+      async drain, and a synced save for reference; plus checkpoint size.
+  (b) cold process start -> jax import -> TPU backend init — measured in
+      a FRESH subprocess, because that is what a restart is. The chip
+      handoff is real: each phase runs in its own child so the previous
+      owner has exited before the next init.
+  (c) restore + first step — Orbax read/device-put, then the first jitted
+      step (which carries the XLA compile).
+
+Cross-process stitching uses CLOCK_MONOTONIC (comparable across
+processes on the same host): the parent records spawn time, children
+print mark lines, and segments are differences between marks.
+
+Run: python -m vodascheduler_tpu.runtime.resize_bench '{"points": [["llama_350m", 8]]}'
+Each child honors VODA_HWBENCH_ON_CPU=1 + JAX_PLATFORMS=cpu for hermetic
+tests (tiny models on the CPU platform).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+MARK_PREFIX = "VODA_RESIZE_MARK "
+RESULT_PREFIX = "VODA_RESIZE_RESULT "
+
+
+def _emit_mark(name: str) -> None:
+    print(f"{MARK_PREFIX}{json.dumps({'mark': name, 't': time.monotonic()})}",
+          flush=True)
+
+
+def _run_child(phase: str, model: str, batch: int, ckpt_dir: str,
+               steps: int, timeout: float) -> Tuple[Dict[str, Any],
+                                                    List[Dict[str, Any]],
+                                                    float]:
+    """Spawn one measurement child; returns (result, marks, spawn_t).
+
+    The child inherits the caller's environment and decides the platform
+    itself (hermetic tests set JAX_PLATFORMS=cpu; the config update in
+    _child_main makes it win over eager TPU plugins)."""
+    cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.resize_bench",
+           "--child", phase, model, str(batch), ckpt_dir, str(steps)]
+    spawn_t = time.monotonic()
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__)))))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"resize-bench child {phase} failed: {proc.stderr[-800:]}")
+    marks, result = [], None
+    for line in proc.stdout.splitlines():
+        if line.startswith(MARK_PREFIX):
+            marks.append(json.loads(line[len(MARK_PREFIX):]))
+        elif line.startswith(RESULT_PREFIX):
+            result = json.loads(line[len(RESULT_PREFIX):])
+    if result is None:
+        raise RuntimeError(
+            f"resize-bench child {phase} produced no result line; "
+            f"stdout tail: {proc.stdout[-400:]}")
+    return result, marks, spawn_t
+
+
+def bench_resize_cost(model_name: str, global_batch_size: int,
+                      warm_steps: int = 3,
+                      child_timeout: float = 900.0,
+                      workdir: Optional[str] = None) -> Dict[str, Any]:
+    """The full resize-cost breakdown for one model at single-chip scale.
+
+    Two sequential children (the chip changes hands exactly like a real
+    scheduler-driven restart):
+      prepare: init -> warm steps -> timed saves -> exit
+      restart: cold start -> restore -> first step
+    """
+    import shutil
+    import tempfile
+
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="voda-resize-bench-")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    try:
+        prep, _, _ = _run_child("prepare", model_name, global_batch_size,
+                                ckpt_dir, warm_steps, child_timeout)
+        restart, marks, spawn_t = _run_child(
+            "restart", model_name, global_batch_size, ckpt_dir, 1,
+            child_timeout)
+        t = {m["mark"]: m["t"] for m in marks}
+        seg = {}
+        prev = spawn_t
+        for mark in ("proc_start", "jax_imported", "backend_ready",
+                     "setup_built", "restored", "first_step_done"):
+            if mark in t:
+                seg[mark + "_ms"] = round((t[mark] - prev) * 1000.0, 1)
+                prev = t[mark]
+        total_ms = round((t["first_step_done"] - spawn_t) * 1000.0, 1) \
+            if "first_step_done" in t else None
+        return {
+            "model": model_name,
+            "batch": global_batch_size,
+            "backend": restart.get("backend", prep.get("backend")),
+            "checkpoint_bytes": prep.get("checkpoint_bytes"),
+            "save_async_initiate_ms": prep.get("save_async_initiate_ms"),
+            "save_async_drain_ms": prep.get("save_async_drain_ms"),
+            "save_sync_ms": prep.get("save_sync_ms"),
+            "warm_step_ms": prep.get("warm_step_ms"),
+            "restart_segments_ms": seg,
+            "restart_total_ms": total_ms,
+            # The number the replay consumes: synced save + full restart
+            # (a preemption-driven resize pays the synchronous save; a
+            # planned resize overlaps the async drain with teardown).
+            "resize_cost_seconds": round(
+                ((prep.get("save_sync_ms") or 0.0) + (total_ms or 0.0))
+                / 1000.0, 2),
+        }
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _child_main(argv: Sequence[str]) -> None:
+    phase, model, batch, ckpt_dir, steps = (
+        argv[0], argv[1], int(argv[2]), argv[3], int(argv[4]))
+    _emit_mark("proc_start")
+    import jax  # noqa: E402
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    _emit_mark("jax_imported")
+    backend = jax.default_backend()
+    jax.devices()
+    _emit_mark("backend_ready")
+    if backend not in ("tpu", "gpu") and not os.environ.get(
+            "VODA_HWBENCH_ON_CPU"):
+        raise RuntimeError(
+            f"resize bench requires an accelerator (backend={backend}); "
+            "set VODA_HWBENCH_ON_CPU=1 to smoke-test on CPU")
+
+    from vodascheduler_tpu.models import get_model
+    from vodascheduler_tpu.runtime.train import TrainSession
+
+    bundle = get_model(model)
+    out: Dict[str, Any] = {"backend": backend}
+    if phase == "prepare":
+        session = TrainSession(bundle, 1, devices=jax.devices()[:1],
+                               global_batch_size=batch)
+        t0 = time.monotonic()
+        session.run_steps(steps)
+        out["warm_step_ms"] = round(
+            (time.monotonic() - t0) / max(1, steps) * 1000.0, 1)
+        # Async save: initiate (the running job's stall) vs drain.
+        t0 = time.monotonic()
+        session.save(ckpt_dir, wait=False)
+        out["save_async_initiate_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 1)
+        t0 = time.monotonic()
+        session.finish_saves()
+        out["save_async_drain_ms"] = round(
+            (time.monotonic() - t0) * 1000.0, 1)
+        # Synced save (what a SIGTERM-driven preemption checkpoint pays).
+        session.run_steps(1)  # dirty the state so the save is honest
+        t0 = time.monotonic()
+        session.save(ckpt_dir, wait=True)
+        session.finish_saves()
+        out["save_sync_ms"] = round((time.monotonic() - t0) * 1000.0, 1)
+        # Size of ONE checkpoint: the retention policy keeps two steps
+        # here (the async save + the sync save), so walk only the latest
+        # step's directory — the whole-tree total would double-count.
+        from vodascheduler_tpu.runtime.checkpoint import latest_step
+        step_dir = os.path.join(ckpt_dir, str(latest_step(ckpt_dir)))
+        total = 0
+        for root, _, files in os.walk(step_dir):
+            for f in files:
+                total += os.path.getsize(os.path.join(root, f))
+        out["checkpoint_bytes"] = total
+    elif phase == "restart":
+        session = TrainSession(bundle, 1, devices=jax.devices()[:1],
+                               global_batch_size=batch, init=False)
+        _emit_mark("setup_built")
+        from vodascheduler_tpu.runtime import checkpoint as ckpt
+        session.state, session.rng = ckpt.restore_checkpoint(
+            ckpt_dir, session.setup)
+        jax.block_until_ready(session.state)
+        _emit_mark("restored")
+        session.run_steps(1)
+        jax.block_until_ready(session.state)
+        _emit_mark("first_step_done")
+    else:
+        raise ValueError(f"unknown phase {phase!r}")
+    print(f"{RESULT_PREFIX}{json.dumps(out)}", flush=True)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "--child":
+        _child_main(args[1:])
+        return
+    kwargs = json.loads(args[0]) if args else {}
+    points = [tuple(p) for p in kwargs.get(
+        "points", [("llama_350m", 8), ("mixtral_small", 8)])]
+    stream = kwargs.get("stream", False)
+    results = []
+    for model, batch in points:
+        try:
+            res = bench_resize_cost(model, batch)
+        except Exception as e:  # noqa: BLE001 - per-point isolation
+            res = {"model": model, "batch": batch,
+                   "error": f"{type(e).__name__}: {e}"}
+        results.append(res)
+        if stream:
+            print(json.dumps({"kind": "resize", "data": res}), flush=True)
+    if not stream:
+        print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()
