@@ -77,6 +77,16 @@ LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
 # flash kernel's O(S²) advantage over the XLA lowering is largest —
 # the measured long-context point (doc/benchmarks.md, SURVEY §5.7).
 LLAMA_350M_8K = dataclasses.replace(LLAMA_350M, max_seq_len=8192)
+# ~1.0B single-chip config (BASELINE configs 4-5 direction): dim 2048 x
+# 16 layers x GQA 32/8 x mlp 7168 ≈ 1.00B params. Adam's 12 B/param
+# (f32 params + 2 moments ≈ 12 GB, doubled transiently by the f32 grad
+# tree) cannot fit a 16 GB v5e — this config pairs with the adafactor
+# bundle (models/registry.py): factored second moments put optimizer
+# state at ~4 B/param, the standard memory-frugal TPU recipe (T5).
+# scan+remat as in LLAMA_350M; same vocab for family-comparable curves.
+LLAMA_1B = LlamaConfig(dim=2048, num_layers=16, num_heads=32,
+                       num_kv_heads=8, mlp_hidden=7168, max_seq_len=2048,
+                       scan_layers=True, remat_layers=True)
 # Tiny config for tests / compile checks
 LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, mlp_hidden=128, max_seq_len=128,

@@ -34,6 +34,11 @@ class ModelBundle:
     seq_len: int = 0
     num_experts: int = 0
     has_batch_stats: bool = False     # BatchNorm models carry mutable state
+    # "adamw" (default) or "adafactor" — chosen per model scale: Adam's
+    # 12 B/param optimizer state OOMs ~1B-param models on a 16 GB chip;
+    # adafactor's factored moments (~4 B/param) are the standard TPU
+    # recipe at that scale (see runtime/train.py make_optimizer).
+    optimizer: str = "adamw"
 
 
 def _lm_batch(vocab: int, seq: int):
@@ -148,6 +153,11 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(llama.LLAMA3_8B.vocab_size, 4096),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=8.0,
             seq_len=4096),
+        "llama_1b": lambda: ModelBundle(
+            name="llama_1b", module=llama.Llama(llama.LLAMA_1B),
+            make_batch=_lm_batch(llama.LLAMA_1B.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=1.0,
+            seq_len=2048, optimizer="adafactor"),
         "llama_350m": lambda: ModelBundle(
             name="llama_350m", module=llama.Llama(llama.LLAMA_350M),
             make_batch=_lm_batch(llama.LLAMA_350M.vocab_size, 2048),
