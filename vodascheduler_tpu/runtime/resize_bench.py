@@ -184,8 +184,11 @@ def _child_main(argv: Sequence[str]) -> None:
         # Size of ONE checkpoint: the retention policy keeps two steps
         # here (the async save + the sync save), so walk only the latest
         # step's directory — the whole-tree total would double-count.
-        from vodascheduler_tpu.runtime.checkpoint import latest_step
-        step_dir = os.path.join(ckpt_dir, str(latest_step(ckpt_dir)))
+        from vodascheduler_tpu.runtime.checkpoint import (
+            _step_dir,
+            latest_step,
+        )
+        step_dir = _step_dir(ckpt_dir, latest_step(ckpt_dir))
         total = 0
         for root, _, files in os.walk(step_dir):
             for f in files:
