@@ -4,13 +4,20 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench native docker deploy-gke clean
+.PHONY: test test-all test-fast bench native docker deploy-gke clean
 
+# Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
+# matrices and subprocess e2e tests are marked `slow`;
+# tests/test_smoke_fast.py keeps a slice of each in this target.
 test:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# Everything, including the slow GSPMD matrices and subprocess e2e
+# (~35 min on one CPU core).
+test-all:
 	$(PY) -m pytest tests/ -x -q
 
-test-fast:
-	$(PY) -m pytest tests/ -x -q -m "not slow"
+test-fast: test
 
 bench:
 	$(PY) bench.py

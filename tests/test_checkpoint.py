@@ -13,7 +13,12 @@ import jax
 import numpy as np
 import pytest
 
-from vodascheduler_tpu.models import get_model
+# Resharded save/restore cycles recompile per mesh shape (~3.5 min on one
+# CPU core): slow module; test_smoke_fast.py keeps one reshard roundtrip
+# in `make test`.
+pytestmark = pytest.mark.slow
+
+from vodascheduler_tpu.models import get_model  # noqa: E402
 from vodascheduler_tpu.parallel.mesh import MeshPlan
 from vodascheduler_tpu.runtime import (
     TrainSession,

@@ -10,6 +10,11 @@ import jax.numpy as jnp
 import pytest
 
 from vodascheduler_tpu.models import get_model, MODEL_REGISTRY
+
+# CPU-mesh GSPMD compiles dominate (~6 min for the matrix on one core):
+# the whole module is `slow`; tests/test_smoke_fast.py keeps a one-model
+# slice of this path in `make test`.
+pytestmark = pytest.mark.slow
 from vodascheduler_tpu.parallel.mesh import MeshPlan
 from vodascheduler_tpu.runtime import TrainSession
 

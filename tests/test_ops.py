@@ -13,6 +13,10 @@ from vodascheduler_tpu.ops import flash_attention, make_flash_attention
 from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh
 from vodascheduler_tpu.parallel.ring_attention import reference_attention
 
+# Interpreter-mode Pallas sweeps compile-bound on CPU (~2 min): slow
+# module; test_smoke_fast.py keeps one tiny parity point fast.
+pytestmark = pytest.mark.slow
+
 
 def _qkv(seed, B=2, S=128, H=2, D=64, dtype=jnp.float32):
     keys = jax.random.split(jax.random.PRNGKey(seed), 3)

@@ -113,6 +113,7 @@ def test_training_survives_resize_on_real_data(tmp_path):
     assert int(resumed.state["step"]) == int(straight.state["step"])
 
 
+@pytest.mark.slow  # two subprocess legs, each importing jax (~40 s)
 @pytest.mark.parametrize("model", ["digits_mlp"])
 def test_real_data_example_script_smoke(tmp_path, model):
     """The runnable example (examples/jax/digits_real_data_elastic.py)
