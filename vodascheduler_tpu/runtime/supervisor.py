@@ -162,6 +162,11 @@ def run_job(workdir: str, num_chips: int,
         session = TrainSession.resume(
             bundle, num_chips, ckpt_dir, devices=devices,
             global_batch_size=spec.global_batch_size, topology=topology)
+        # The restart half of the checkpoint-restart resize contract:
+        # greppable evidence (e2e artifacts key on this line) that this
+        # incarnation resumed training rather than starting over.
+        print(f"resumed at step {session.step} on {num_chips} chips",
+              flush=True)
     else:
         session = TrainSession(bundle, num_chips, devices=devices,
                                global_batch_size=spec.global_batch_size,
