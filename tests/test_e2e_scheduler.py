@@ -65,15 +65,20 @@ def _tpu_reachable() -> bool:
 @pytest.mark.tpu
 @pytest.mark.slow
 def test_e2e_scheduler_real_tpu(tmp_path):
-    """The real-chip run: llama_350m jobs, supervisors own the TPU, the
-    control plane never touches it. Writes doc/e2e_tpu_r4.json (round
-    evidence) on success."""
+    """The real-chip run: llama_350m_text jobs (byte-level LM on the
+    bundled real-prose corpus), supervisors own the TPU, the control
+    plane never touches it. Writes doc/e2e_tpu_r4.json (round evidence)
+    on success."""
     if not _tpu_reachable():
         pytest.skip("no reachable TPU accelerator")
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     env.pop("VODA_E2E_HERMETIC", None)
     out = os.path.join(REPO, "doc", "e2e_tpu_r4.json")
-    r = _run(env, ["--workdir", os.fspath(tmp_path / "wd"),
+    # llama_350m_text: the scheduler-driven run trains on REAL prose
+    # (data/real.py), so the artifact also demonstrates real-data
+    # training under preemption on the chip.
+    r = _run(env, ["--model", "llama_350m_text",
+                   "--workdir", os.fspath(tmp_path / "wd"),
                    "--out", out], timeout=2600)
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-800:])
     art = json.loads(open(out).read())

@@ -113,6 +113,45 @@ def test_training_survives_resize_on_real_data(tmp_path):
     assert int(resumed.state["step"]) == int(straight.state["step"])
 
 
+def test_text_corpus_is_real_prose_and_deterministic():
+    from vodascheduler_tpu.data import load_text_corpus
+
+    c = load_text_corpus()
+    assert c.train.size > 400_000
+    assert c.test.size > 10_000
+    text = bytes(c.train[:200_000]).decode("utf-8", errors="replace")
+    # Real English prose, not noise: common words appear often.
+    assert text.count(" the ") > 200
+    assert load_text_corpus() is c  # cached
+
+
+def test_text_batch_stream_is_pure_function_of_key():
+    bundle = get_model("llama_tiny_text")
+    key = jax.random.PRNGKey(5)
+    a, b = bundle.make_batch(8, key), bundle.make_batch(8, key)
+    np.testing.assert_array_equal(np.asarray(a["inputs"]),
+                                  np.asarray(b["inputs"]))
+    # Targets are inputs shifted by one (next-byte LM).
+    np.testing.assert_array_equal(np.asarray(a["inputs"][:, 1:]),
+                                  np.asarray(a["targets"][:, :-1]))
+    assert int(a["inputs"].max()) < 256
+
+
+@pytest.mark.slow  # ~80 training steps on CPU
+def test_byte_lm_learns_real_text():
+    """The LM-family convergence evidence: loss on real prose falls well
+    below the uniform-byte floor (ln 256 ≈ 5.55) within ~80 steps."""
+    bundle = get_model("llama_tiny_text")
+    s = TrainSession(bundle, 2, devices=jax.devices()[:2],
+                     global_batch_size=16, seed=1, learning_rate=3e-3)
+    first = s.run_steps(5)
+    # Already below the uniform floor (ln 256 ≈ 5.55): byte frequencies
+    # are learned within a handful of steps.
+    assert 3.8 < first < 5.6, first
+    last = s.run_steps(75)
+    assert last < 3.6, last  # real structure learned, not just frequencies
+
+
 @pytest.mark.slow  # two subprocess legs, each importing jax (~40 s)
 @pytest.mark.parametrize("model", ["digits_mlp"])
 def test_real_data_example_script_smoke(tmp_path, model):

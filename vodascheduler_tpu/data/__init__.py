@@ -12,14 +12,20 @@ here ships inside packages already baked into the image (no downloads).
 
 from vodascheduler_tpu.data.real import (
     RealDataset,
+    TextCorpus,
     eval_classifier,
     load_digits_dataset,
+    load_text_corpus,
+    make_lm_batch_fn,
     make_sampling_batch_fn,
 )
 
 __all__ = [
     "RealDataset",
+    "TextCorpus",
     "eval_classifier",
     "load_digits_dataset",
+    "load_text_corpus",
+    "make_lm_batch_fn",
     "make_sampling_batch_fn",
 ]

@@ -70,6 +70,70 @@ def load_digits_dataset(test_fraction: float = 0.2,
         test_x=images[:n_test], test_y=labels[:n_test])
 
 
+@functools.lru_cache(maxsize=None)
+def load_text_corpus(test_fraction: float = 0.05) -> "TextCorpus":
+    """~560 KB of real English prose, byte-level, zero egress: the Python
+    documentation topics bundled in the standard library (pydoc_data)
+    plus scikit-learn's dataset descriptions. The LM-family counterpart
+    of the digits set — the reference's NMT example trains on a real
+    parallel corpus (examples/py/tensorflow2, Transformer-NMT); this is
+    the dependency-light equivalent for this image.
+
+    Deterministic: fixed source list, sorted traversal, head/tail split.
+    """
+    import os
+
+    from pydoc_data import topics
+
+    parts = [topics.topics[k] for k in sorted(topics.topics)]
+    try:
+        import sklearn.datasets as skd
+        descr = os.path.join(os.path.dirname(skd.__file__), "descr")
+        for fname in sorted(os.listdir(descr)):
+            if fname.endswith(".rst"):
+                with open(os.path.join(descr, fname), errors="replace") as f:
+                    parts.append(f.read())
+    except Exception:
+        pass  # sklearn layout changed: the pydoc corpus alone suffices
+    data = np.frombuffer("\n\n".join(parts).encode("utf-8"),
+                         dtype=np.uint8)
+    n_test = int(data.size * test_fraction)
+    split = data.size - n_test  # n_test may be 0: slice by index, not -0
+    return TextCorpus(name="pydoc-bytes",
+                      train=data[:split].copy(),
+                      test=data[split:].copy())
+
+
+@dataclasses.dataclass(frozen=True)
+class TextCorpus:
+    """A byte-level LM corpus with a deterministic holdout tail."""
+
+    name: str
+    train: np.ndarray  # uint8
+    test: np.ndarray
+
+
+def make_lm_batch_fn(
+        corpus: TextCorpus,
+        seq_len: int) -> Callable[[int, jax.Array], Dict[str, Any]]:
+    """ModelBundle.make_batch over real text: windows sampled by the
+    per-step rng key (same restart-stability contract as
+    make_sampling_batch_fn — the key IS the data position and it rides
+    in the checkpoint)."""
+    data = jnp.asarray(corpus.train.astype(np.int32))
+    n = int(corpus.train.size)
+    if n <= seq_len + 1:
+        raise ValueError(f"corpus too small ({n}) for seq_len {seq_len}")
+
+    def make(batch_size: int, rng: jax.Array) -> Dict[str, Any]:
+        starts = jax.random.randint(rng, (batch_size,), 0, n - seq_len - 1)
+        idx = starts[:, None] + jnp.arange(seq_len + 1)[None, :]
+        windows = jnp.take(data, idx, axis=0)
+        return {"inputs": windows[:, :-1], "targets": windows[:, 1:]}
+
+    return make
+
+
 def make_sampling_batch_fn(
         dataset: RealDataset) -> Callable[[int, jax.Array], Dict[str, Any]]:
     """A ModelBundle.make_batch over real data.

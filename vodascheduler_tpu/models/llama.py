@@ -72,6 +72,10 @@ LLAMA3_8B = LlamaConfig(scan_layers=True, remat_layers=True)
 LLAMA_350M = LlamaConfig(dim=1024, num_layers=24, num_heads=16,
                          num_kv_heads=8, mlp_hidden=2816, max_seq_len=2048,
                          scan_layers=True, remat_layers=True)
+# Byte-level variant of the flagship (~317M params): vocab 256 pairs it
+# with the bundled real-text corpus (data/real.py load_text_corpus) for
+# real-data training runs under scheduler control.
+LLAMA_350M_BYTES = dataclasses.replace(LLAMA_350M, vocab_size=256)
 # Long-context variant of the bench flagship (seq 8192, batch dropped to
 # keep tokens/step constant): the attention-dominated regime where the
 # flash kernel's O(S²) advantage over the XLA lowering is largest —

@@ -98,6 +98,19 @@ def _cls_loss(apply_fn, params, batch):
         logits.astype(jnp.float32), batch["labels"]).mean()
 
 
+def _text_lm_bundle(name: str, cfg, seq_len: int,
+                    params_b: float = 0.0) -> ModelBundle:
+    """Byte-level LM on the bundled real-prose corpus (data/real.py):
+    the LM-family real-data path. Batch windows are keyed by the
+    checkpointed rng, so resizes resume the stream exactly."""
+    from vodascheduler_tpu.data import load_text_corpus, make_lm_batch_fn
+    return ModelBundle(
+        name=name, module=llama.Llama(cfg),
+        make_batch=make_lm_batch_fn(load_text_corpus(), seq_len),
+        loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES,
+        params_b=params_b, seq_len=seq_len)
+
+
 def _digits_bundle() -> ModelBundle:
     from vodascheduler_tpu.data import (
         load_digits_dataset,
@@ -169,6 +182,11 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(llama.LLAMA_350M_8K.vocab_size, 8192),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
             seq_len=8192),
+        "llama_tiny_text": lambda: _text_lm_bundle(
+            "llama_tiny_text", llama.LLAMA_TINY, seq_len=64),
+        "llama_350m_text": lambda: _text_lm_bundle(
+            "llama_350m_text", llama.LLAMA_350M_BYTES, seq_len=2048,
+            params_b=0.32),
         "llama_tiny": lambda: ModelBundle(
             name="llama_tiny", module=llama.Llama(llama.LLAMA_TINY),
             make_batch=_lm_batch(llama.LLAMA_TINY.vocab_size, 64),
