@@ -9,27 +9,43 @@
 #   doc/e2e_tpu_r4.json            scheduler-driven run on the chip
 #   doc/benchmarks_last_good.json  hardware tables (bench.py writes it)
 #   doc/benchmarks_r4_raw.json     the full bench.py line, captured
+#
+# Refuses to stamp evidence from a TPU-less host: the e2e test must have
+# RUN (not skipped), and the bench hardware section must be live (no
+# cached_from/error markers).
 set -x
 
-# 1. Control plane driving the real chip end-to-end (tpu-marked test;
-#    skips itself if the accelerator is unreachable).
+# 1. Control plane driving the real chip end-to-end. -rA makes the
+#    skip/pass outcome parseable; a skip means no TPU — abort.
 python -m pytest tests/test_e2e_scheduler.py::test_e2e_scheduler_real_tpu \
-    -q -m "tpu" || exit 1
+    -q -rA -m "tpu" | tee /tmp/e2e_tpu_pytest.out
+grep -q "PASSED" /tmp/e2e_tpu_pytest.out || {
+    echo "e2e TPU test did not PASS (skipped or failed) — not capturing"
+    exit 1
+}
 
 # 2. Full benchmark: replay headline + hardware section (model MFU,
 #    flash-vs-XLA, MoE, llama_1b) + elastic-resize cost breakdown.
-python bench.py | tail -1 > /tmp/bench_r4_line.json || exit 1
-python - <<'EOF'
+#    bench.py prints exactly one stdout line; no pipe, so its exit
+#    status is the one tested.
+python bench.py > /tmp/bench_r4_line.json || exit 1
+python - <<'EOF' || exit 1
 import json
+import sys
+
 line = json.load(open("/tmp/bench_r4_line.json"))
+hw = line["detail"].get("hardware", {})
+stale = [k for k in ("cached_from", "error", "live_error") if k in hw]
+if stale or not hw.get("models"):
+    print(f"hardware section is not live ({stale or 'no models'}) — "
+          "refusing to write doc/benchmarks_r4_raw.json")
+    sys.exit(1)
 out = {
     "note": "Raw bench.py output captured live on the TPU (r4 session).",
     "bench_py_output": line,
 }
 json.dump(out, open("doc/benchmarks_r4_raw.json", "w"), indent=1)
 print("wrote doc/benchmarks_r4_raw.json")
-hw = line["detail"].get("hardware", {})
-print("hardware keys:", sorted(hw))
 for m in hw.get("models", []):
     print("model:", m.get("model"), "mfu:", m.get("mfu"))
 for r in hw.get("resize", []):
