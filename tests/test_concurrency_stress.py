@@ -1,0 +1,190 @@
+"""Thread-stress for the control plane's locking discipline.
+
+SURVEY.md §5.2: the reference serializes with two RW mutexes and a
+single-consumer channel, and ships no concurrency test at all; r3's
+VERDICT flagged the same gap here. This test hammers one scheduler from
+five concurrent threads — submissions, clock advances (firing backend
+completion timers), host churn, live algorithm/ratelimit flips, and
+status readers — then proves the system stayed coherent: no thread
+raised, no deadlock, every job terminal or cleanly allocated within
+capacity and its own bounds.
+"""
+
+import threading
+import time
+
+import pytest
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, WorkloadProfile
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.placement import PlacementManager, PoolTopology
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+# Hard cap on the whole storm; the actual run stops STORM_TAIL_SECONDS
+# after the submitter finishes (~0.1 s), so the fast suite pays a few
+# seconds, not the cap.
+WALL_BUDGET_SECONDS = 12.0
+STORM_TAIL_SECONDS = 3.0
+NUM_JOBS = 36
+
+
+def _build():
+    clock = VirtualClock(start=1_700_000_000.0)
+    store = JobStore()
+    bus = EventBus()
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=5.0)
+    topology = PoolTopology(torus_dims=(4, 2, 2), host_block=(2, 2, 1))
+    pm = PlacementManager("stress", topology=topology)
+    pm.add_hosts_from_topology(topology)
+    for coord in topology.host_coords():
+        backend.add_host(topology.host_name(coord),
+                         topology.chips_per_host, announce=False)
+    sched = Scheduler("stress", backend, store,
+                      ResourceAllocator(store), clock, bus=bus,
+                      placement_manager=pm, algorithm="ElasticTiresias",
+                      rate_limit_seconds=5.0)
+    admission = AdmissionService(store, bus, clock)
+    return clock, store, backend, sched, admission, topology
+
+
+def test_scheduler_survives_concurrent_hammering():
+    clock, store, backend, sched, admission, topology = _build()
+    errors = []
+    stop = threading.Event()
+    submitted = []
+    submitted_lock = threading.Lock()
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - collected and asserted
+                errors.append(e)
+                stop.set()
+        return run
+
+    def submitter():
+        for i in range(NUM_JOBS):
+            if stop.is_set():
+                return
+            spec = JobSpec(
+                name=f"stress-{i}", pool="stress", model="synthetic",
+                config=JobConfig(min_num_chips=1 + i % 2,
+                                 max_num_chips=2 + i % 6,
+                                 epochs=2 + i % 3))
+            name = admission.create_training_job(spec)
+            backend.register_profile(name, WorkloadProfile(
+                epoch_seconds_at_1=60.0 + 10 * (i % 5),
+                speedup_exponent=0.9))
+            with submitted_lock:
+                submitted.append(name)
+            time.sleep(0.002)
+
+    def advancer():
+        # The only thread that advances virtual time (VirtualClock fires
+        # timers inline); small steps keep the interleaving hot.
+        while not stop.is_set():
+            clock.advance(7.0)
+            time.sleep(0.001)
+
+    def chaos():
+        names = [topology.host_name(c) for c in topology.host_coords()]
+        flip = 0
+        while not stop.is_set():
+            host = names[flip % len(names)]
+            backend.remove_host(host)
+            time.sleep(0.004)
+            backend.add_host(host, topology.chips_per_host)
+            sched.set_algorithm(("ElasticFIFO", "ElasticTiresias",
+                                 "ElasticSRJF")[flip % 3])
+            sched.set_rate_limit(3.0 + flip % 5)
+            flip += 1
+            time.sleep(0.004)
+
+    def reader():
+        while not stop.is_set():
+            table = sched.status_table()
+            for row in table:
+                assert row["chips"] >= 0
+            sched.pump()
+            sched.update_time_metrics()
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=guard(fn), daemon=True)
+               for fn in (submitter, advancer, chaos, reader)]
+    deadline = time.monotonic() + WALL_BUDGET_SECONDS
+    for t in threads:
+        t.start()
+    # Let the submitter finish, then keep the storm going briefly.
+    threads[0].join(timeout=WALL_BUDGET_SECONDS)
+    tail_until = min(deadline, time.monotonic() + STORM_TAIL_SECONDS)
+    while time.monotonic() < tail_until and not stop.is_set():
+        time.sleep(0.05)
+    stop.set()
+    for t in threads[1:]:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "worker thread failed to stop: deadlock?"
+    assert not errors, errors
+
+    # The lock must be free (deadlock detector) and the scheduler still
+    # responsive after the storm.
+    assert sched._lock.acquire(timeout=5.0), "scheduler lock leaked"
+    sched._lock.release()
+    sched.trigger_resched()
+    sched.pump()
+
+    # Drain: advance simulated time until every submitted job reaches a
+    # terminal state (completions ride backend timers).
+    with submitted_lock:
+        names = list(submitted)
+    assert len(names) == NUM_JOBS
+    for _ in range(5_000):
+        done = set(backend.completed) | set(backend.failed)
+        if all(n in done for n in names):
+            break
+        sched.pump()
+        clock.advance(30.0)
+    done = set(backend.completed) | set(backend.failed)
+    assert all(n in done for n in names), (
+        f"{len(done & set(names))}/{len(names)} terminal")
+
+    # Post-quiesce coherence: allocations empty or within bounds.
+    for name, chips in sched.job_num_chips.items():
+        job = store.get_job(name)
+        assert job is not None
+        assert chips == 0 or (job.config.min_num_chips <= chips
+                              <= job.config.max_num_chips)
+
+
+@pytest.mark.parametrize("n_threads", [8])
+def test_event_bus_concurrent_publish(n_threads):
+    """The EventBus (reference: RabbitMQ client) under concurrent
+    publishers: every message delivered exactly once, no exception."""
+    from vodascheduler_tpu.common.events import EventBus, JobEvent
+    from vodascheduler_tpu.common.types import EventVerb
+
+    bus = EventBus()
+    got = []
+    lock = threading.Lock()
+    bus.subscribe("stress", lambda ev: (lock.acquire(), got.append(ev),
+                                        lock.release()))
+    per_thread = 200
+
+    def publish(tid):
+        for i in range(per_thread):
+            bus.publish("stress", JobEvent(verb=EventVerb.CREATE,
+                                           job_name=f"{tid}-{i}"))
+
+    threads = [threading.Thread(target=publish, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert len(got) == n_threads * per_thread
+    assert len({ev.job_name for ev in got}) == n_threads * per_thread
