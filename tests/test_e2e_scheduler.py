@@ -28,10 +28,12 @@ def _run(env, args, timeout):
 
 @pytest.mark.slow
 def test_e2e_scheduler_hermetic(tmp_path):
-    """CPU-platform run of the whole story; asserts the artifact records
-    3 completions AND a restart that resumed from a checkpoint."""
+    """CPU-platform run of the whole story on a 2-device pool; asserts
+    the artifact records 3 completions AND a restart that resumed from a
+    checkpoint. (At 2 devices the timeline additionally shows the
+    preempted job's chips bin-packed into two concurrent 1-chip jobs.)"""
     out = tmp_path / "e2e.json"
-    env = dict(os.environ, JAX_PLATFORMS="cpu", VODA_E2E_HERMETIC="1")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", VODA_E2E_HERMETIC="2")
     r = _run(env, ["--model", "mnist_mlp",
                    "--workdir", os.fspath(tmp_path / "wd"),
                    "--out", os.fspath(out),
