@@ -370,6 +370,98 @@ class TestPipelineParallel:
         assert 0 < loss < 20
 
 
+class TestLlama1B:
+    """llama_1b (BASELINE configs 4-5 direction): the ≥1B-param
+    single-chip point. These hermetic checks catch config rot before a
+    chip session spends its slot on the bench (VERDICT r4 weak #2)."""
+
+    def test_param_count_is_1b(self):
+        from vodascheduler_tpu.models.llama import LLAMA_1B
+        # The formula and the traced init must agree exactly — a drifted
+        # formula would mislead plan_mesh and the MFU denominators.
+        assert LLAMA_1B.param_count == 1_003_554_816
+        m = get_model("llama_1b").module
+        shapes = jax.eval_shape(
+            m.init, jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), dtype=jnp.int32))
+        traced = sum(l.size for l in jax.tree.leaves(shapes))
+        assert traced == LLAMA_1B.param_count
+
+    @staticmethod
+    def _tiny_adafactor_bundle():
+        # The llama_1b bundle with the model swapped to tiny shapes:
+        # same adafactor optimizer branch the 1B bench will hit.
+        import dataclasses
+
+        from vodascheduler_tpu.models import llama
+        from vodascheduler_tpu.models.registry import _lm_batch
+        bundle = get_model("llama_1b")
+        assert bundle.optimizer == "adafactor"
+        return dataclasses.replace(
+            bundle, module=llama.Llama(llama.LLAMA_TINY_SCAN),
+            make_batch=_lm_batch(llama.LLAMA_TINY_SCAN.vocab_size, 64),
+            params_b=0.0, seq_len=64)
+
+    def test_adafactor_bundle_steps_tiny(self):
+        s = TrainSession(self._tiny_adafactor_bundle(), num_chips=8,
+                         global_batch_size=8)
+        first = s.run_steps(1)
+        last = s.run_steps(5)
+        assert jnp.isfinite(first) and jnp.isfinite(last)
+        assert last < first
+
+    def test_adafactor_state_resharding_resume(self, tmp_path):
+        # Adafactor's factored-moment state tree (optax FactoredState:
+        # v_row/v_col for matrices, full v for vectors) must survive the
+        # Orbax save -> restart-at-new-topology -> resharded restore that
+        # the 1B bench's resize flow depends on — adamw trees have this
+        # proof elsewhere, adafactor's shape-heterogeneous tree did not.
+        tiny = self._tiny_adafactor_bundle()
+        d = str(tmp_path / "ckpt")
+        s = TrainSession(tiny, num_chips=8, global_batch_size=8,
+                         plan=MeshPlan(dp=8))
+        s.run_steps(2)
+        s.save(d)
+        r = TrainSession.resume(tiny, 4, d, global_batch_size=8,
+                                plan=MeshPlan(dp=2, fsdp=2))
+        assert r.step == 2
+        import numpy as np
+        before = [jax.device_get(l) for l in jax.tree.leaves(s.state["opt_state"])]
+        after = [jax.device_get(l) for l in jax.tree.leaves(r.state["opt_state"])]
+        assert len(before) == len(after)
+        for b, a in zip(before, after):
+            assert b.shape == a.shape
+            assert np.allclose(b, a), "opt_state changed across restore"
+        r.run_steps(1)
+        assert r.step == 3
+
+    def test_abstract_hbm_fit_on_one_v5e(self):
+        """Shape-level proof the bench point fits: f32 params + adafactor
+        state + the in-step transients (f32 grad tree, bf16 param cast,
+        per-layer remat boundary activations) under 16 GB at the bench
+        batch (bench.py HW_MODEL_POINTS: llama_1b at B=4)."""
+        from vodascheduler_tpu.models.llama import LLAMA_1B
+        from vodascheduler_tpu.runtime.train import make_train_setup
+
+        bundle = get_model("llama_1b")
+        setup = make_train_setup(bundle, 1, devices=jax.devices()[:1],
+                                 global_batch_size=4)
+        state_bytes = sum(l.size * l.dtype.itemsize
+                          for l in jax.tree.leaves(setup.eval_shape_state))
+        params = LLAMA_1B.param_count
+        # Adafactor's factored moments must be ~order-of-magnitude under
+        # Adam's 8 B/param — the reason this bundle exists.
+        opt_bytes = state_bytes - 4 * params - 4
+        assert opt_bytes < 1.0 * params, opt_bytes / params
+        cfg = LLAMA_1B
+        B = 4
+        acts = cfg.num_layers * B * cfg.max_seq_len * cfg.dim * 2  # bf16
+        est = state_bytes + 4 * params + 2 * params + acts
+        # ~11.0 GB measured abstractly; 16 GB chip. The margin absorbs
+        # XLA workspace/fragmentation the abstract sum can't see.
+        assert est < 0.80 * 16e9, est / 1e9
+
+
 class TestScaleFeasibility:
     @pytest.mark.slow
     def test_llama3_8b_state_shards_within_v5p_hbm(self):
