@@ -89,12 +89,31 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (2, 64, 256)
 
-    def test_dryrun_multichip(self, capsys):
+    def test_dryrun_multichip(self, capsys, monkeypatch):
         import __graft_entry__ as g
 
+        # The composed 16-device plans re-exec a subprocess; they have
+        # their own test below — keep this one in-process.
+        monkeypatch.setenv("VODA_DRYRUN_COMPOSED", "0")
         g.dryrun_multichip(8)
         out = capsys.readouterr().out
         assert "OK" in out
+
+    def test_dryrun_composed_16(self):
+        # The composed plans (dp.fsdp.tp.pp, dp.sp.ep, llama_1b
+        # fsdp.tp) need 16 devices — the conftest pins 8, so this runs
+        # through the same re-exec path the driver's 8-device dry run
+        # takes (__graft_entry__._spawn_composed_16).
+        import __graft_entry__ as g
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            g._spawn_composed_16()
+        out = buf.getvalue()
+        assert out.count("OK") == 3, out
+        assert "llama_1b fsdp.tp" in out, out
 
 
 class TestNmt:
