@@ -5,7 +5,7 @@ restart -> complete, with the collector learning speedup curves.
 
 The reference's equivalent evidence is its live demo
 (/root/reference/README.md:49-51); this script records the same story as
-a JSON artifact (doc/e2e_tpu_r4.json) from a scheduler-driven run on
+a JSON artifact (doc/e2e_tpu_r5.json) from a scheduler-driven run on
 whatever accelerator the host exposes.
 
 What it does:
@@ -63,7 +63,7 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", default="/tmp/voda-e2e-tpu")
     p.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "doc", "e2e_tpu_r4.json"))
+        "doc", "e2e_tpu_r5.json"))
     p.add_argument("--model", default="llama_350m")
     p.add_argument("--batch-size", type=int, default=4)
     p.add_argument("--steps-per-epoch", type=int, default=5)

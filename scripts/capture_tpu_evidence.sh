@@ -6,9 +6,9 @@
 #   sh scripts/capture_tpu_evidence.sh
 #
 # Produces / refreshes:
-#   doc/e2e_tpu_r4.json            scheduler-driven run on the chip
+#   doc/e2e_tpu_r5.json            scheduler-driven run on the chip
 #   doc/benchmarks_last_good.json  hardware tables (bench.py writes it)
-#   doc/benchmarks_r4_raw.json     the full bench.py line, captured
+#   doc/benchmarks_r5_raw.json     the full bench.py line, captured
 #
 # Refuses to stamp evidence from a TPU-less host: the e2e test must have
 # RUN (not skipped), and the bench hardware section must be live (no
@@ -28,26 +28,42 @@ grep -q "PASSED" /tmp/e2e_tpu_pytest.out || {
 #    flash-vs-XLA, MoE, llama_1b) + elastic-resize cost breakdown.
 #    bench.py prints exactly one stdout line; no pipe, so its exit
 #    status is the one tested.
-python bench.py > /tmp/bench_r4_line.json || exit 1
+python bench.py > /tmp/bench_r5_line.json || exit 1
 python - <<'EOF' || exit 1
 import json
 import sys
 
-line = json.load(open("/tmp/bench_r4_line.json"))
+line = json.load(open("/tmp/bench_r5_line.json"))
 hw = line["detail"].get("hardware", {})
 stale = [k for k in ("cached_from", "error", "live_error") if k in hw]
 if stale or not hw.get("models"):
     print(f"hardware section is not live ({stale or 'no models'}) — "
-          "refusing to write doc/benchmarks_r4_raw.json")
+          "refusing to write doc/benchmarks_r5_raw.json")
     sys.exit(1)
 out = {
-    "note": "Raw bench.py output captured live on the TPU (r4 session).",
+    "note": "Raw bench.py output captured live on the TPU (r5 session).",
     "bench_py_output": line,
 }
-json.dump(out, open("doc/benchmarks_r4_raw.json", "w"), indent=1)
-print("wrote doc/benchmarks_r4_raw.json")
+json.dump(out, open("doc/benchmarks_r5_raw.json", "w"), indent=1)
+print("wrote doc/benchmarks_r5_raw.json")
 for m in hw.get("models", []):
     print("model:", m.get("model"), "mfu:", m.get("mfu"))
 for r in hw.get("resize", []):
     print("resize:", r.get("model"), "cost_s:", r.get("resize_cost_seconds"))
+
+# The measured-restart artifact replay/restart_costs.py derives family
+# pricing from. Check it in; then re-run the knee sweep and update the
+# replay guards (VERDICT r4 item 2).
+from vodascheduler_tpu.replay.restart_costs import _complete
+points = [r for r in hw.get("resize", []) if _complete(r)]
+if points:
+    json.dump({
+        "note": "Measured on-chip by runtime/resize_bench.py via bench.py "
+                "(r5 session); consumed by replay/restart_costs.py.",
+        "points": points,
+    }, open("doc/resize_measured.json", "w"), indent=1)
+    print("wrote doc/resize_measured.json with", len(points), "points")
+else:
+    print("WARNING: no complete resize points; doc/resize_measured.json "
+          "not written")
 EOF

@@ -6,7 +6,7 @@ curves. The reference's equivalent evidence was its live demo
 
 The hermetic variant runs on the CPU platform; the `tpu` variant drives
 the real chip (skipped automatically when no accelerator is reachable)
-and refreshes doc/e2e_tpu_r4.json.
+and refreshes doc/e2e_tpu_r5.json.
 """
 
 import json
@@ -69,13 +69,13 @@ def _tpu_reachable() -> bool:
 def test_e2e_scheduler_real_tpu(tmp_path):
     """The real-chip run: llama_350m_text jobs (byte-level LM on the
     bundled real-prose corpus), supervisors own the TPU, the control
-    plane never touches it. Writes doc/e2e_tpu_r4.json (round evidence)
+    plane never touches it. Writes doc/e2e_tpu_r5.json (round evidence)
     on success."""
     if not _tpu_reachable():
         pytest.skip("no reachable TPU accelerator")
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     env.pop("VODA_E2E_HERMETIC", None)
-    out = os.path.join(REPO, "doc", "e2e_tpu_r4.json")
+    out = os.path.join(REPO, "doc", "e2e_tpu_r5.json")
     # llama_350m_text: the scheduler-driven run trains on REAL prose
     # (data/real.py), so the artifact also demonstrates real-data
     # training under preemption on the chip.

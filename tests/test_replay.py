@@ -102,23 +102,24 @@ class TestBenchScript:
 
 
 def test_bench_scenario_meets_targets():
-    """Regression guard for the headline bench (bench.py): the r3 knee
-    knobs (rate 20s / hysteresis 1.5 / cooldown 60s) with the headline
+    """Regression guard for the headline bench (bench.py): the r5 knee
+    knobs (rate 30s / hysteresis 1.5 / cooldown 300s) with the headline
     spot-preemption schedule must clear BOTH halves of the BASELINE
-    metric — steady-state utilization >= 0.88 AND avg JCT <= r1's 3195s
-    (VERDICT r2 item 3: JCT back in the headline with a target)."""
+    metric. Guard values are the first HONEST-workload measurements:
+    r5's profile-registration race fix (simulator._submit) revealed
+    29/64 trace jobs had been simulating the default 60 s-epoch toy
+    profile, so r1-r4 guard values (avg 3195 s, p95 10.5 ks...) are not
+    comparable — the true heavy-tailed trace is ~3.4x heavier. Sweep
+    provenance: scripts/replay_sweep.py, doc/replay_sweep_r5.json."""
     r = _headline_harness(64, (4, 4, 4)).run()
     assert r.completed == 64
     assert r.failed == 0, r                       # preemption kills no job
-    assert r.steady_state_utilization >= 0.88, r
-    assert r.avg_jct_seconds <= 3195.0, r         # r1's avg JCT, the floor
-    # Tail guard (r4): the ElasticTiresias floor lift cut p95 from
-    # 11,102 s to 10,086 s on this seed (elastic_tiresias.py
-    # FLOOR_LIFT_AGE_SECONDS); never regress past the r3 tail.
-    assert r.p95_jct_seconds <= 10_500.0, r
+    assert r.steady_state_utilization >= 0.96, r  # measured 0.9689
+    assert r.avg_jct_seconds <= 9_600.0, r        # measured 9,337.5 s
+    assert r.p95_jct_seconds <= 18_000.0, r       # measured 17,530 s
     assert r.steady_state_seconds > 0.5 * r.makespan_seconds, r
-    assert r.restarts_total <= 280, r
-    assert r.attainable_utilization >= 0.88, r
+    assert r.restarts_total <= 200, r             # measured 164
+    assert r.attainable_utilization >= 0.96, r
 
 
 def _headline_harness(num_jobs: int, torus_dims: tuple):
@@ -133,23 +134,25 @@ def _headline_harness(num_jobs: int, torus_dims: tuple):
                               max_job_chips=64)
     topo = PoolTopology(torus_dims=torus_dims, host_block=(2, 2, 1))
     return ReplayHarness(trace, algorithm="ElasticTiresias", topology=topo,
-                         rate_limit_seconds=20.0, scale_out_hysteresis=1.5,
-                         resize_cooldown_seconds=60.0,
+                         rate_limit_seconds=30.0, scale_out_hysteresis=1.5,
+                         resize_cooldown_seconds=300.0,
                          preemptions=config5_preemptions(topo))
 
 
 def test_v5p128_scale_replay():
     """BASELINE config 5 names v5p-128: double the pool and the job
     count (+ the spot dip) and the whole control plane must still clear
-    the north-star bars. Simulated time — runs in under a second."""
+    the north-star bars. Simulated time — runs in under a second.
+    True-workload measurements (r5): util 0.9521 / avg 7,648 s /
+    p95 17,055 s. The steady-state window is only ~27% of makespan at
+    this scale (the heavy tail drains long after arrivals stop), so no
+    ss_frac assertion here — the 64-job guard carries it."""
     r = _headline_harness(128, (4, 4, 8)).run()
     assert r.completed == 128
     assert r.failed == 0, r
-    # Same 0.88 bar the 64-chip headline guard enforces — the doc claims
-    # this point clears every bar (measured 0.8864).
-    assert r.steady_state_utilization >= 0.88, r
-    assert r.avg_jct_seconds <= 2_500.0, r   # measured 2,070 s (r4)
-    assert r.p95_jct_seconds <= 9_000.0, r   # measured 7,726 s (r4)
+    assert r.steady_state_utilization >= 0.94, r
+    assert r.avg_jct_seconds <= 8_000.0, r
+    assert r.p95_jct_seconds <= 17_800.0, r
 
 
 def test_algorithm_compare_runs_all_registered():

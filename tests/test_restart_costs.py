@@ -1,0 +1,104 @@
+"""Restart-cost provenance (replay/restart_costs.py): measured resize
+breakdowns -> per-family replay pricing, with the assumed fallback
+keeping tunnel-less checkouts deterministic."""
+
+import json
+
+import pytest
+
+from vodascheduler_tpu.replay.restart_costs import (
+    ASSUMED_RESTART_S,
+    FAMILY_FOOTPRINT,
+    default_restart_seconds,
+    derive_costs,
+    family_restart_costs,
+)
+
+
+def _point(model="llama_350m", ckpt_bytes=4_000_000_000,
+           save_sync_ms=2000.0, restored_ms=4000.0,
+           restart_total_ms=12000.0):
+    return {"model": model, "checkpoint_bytes": ckpt_bytes,
+            "save_sync_ms": save_sync_ms,
+            "restart_total_ms": restart_total_ms,
+            "restart_segments_ms": {"restored_ms": restored_ms},
+            "resize_cost_seconds": (save_sync_ms + restart_total_ms) / 1000}
+
+
+class TestDerive:
+    def test_fixed_plus_io_model(self):
+        # fixed = (12000 - 4000) ms = 8 s; io rate = 2*4 GB / 6 s.
+        costs = derive_costs([_point()])
+        io_rate = 8e9 / 6.0
+        for fam, fp in FAMILY_FOOTPRINT.items():
+            per_chip = fp["params_b"] * 1e9 * 12.0 / fp["typical_chips"]
+            assert costs[fam].restart_s == pytest.approx(
+                8.0 + per_chip / io_rate, abs=0.06), fam
+            assert "measured on llama_350m" in costs[fam].provenance
+
+    def test_bigger_checkpoints_cost_more(self):
+        costs = derive_costs([_point()])
+        assert (costs["mixtral"].restart_s > costs["llama8b"].restart_s
+                > costs["vitl"].restart_s > costs["resnet50"].restart_s)
+
+    def test_pooled_over_points(self):
+        # Two identical points pool to the same answer as one.
+        one = derive_costs([_point()])
+        two = derive_costs([_point(), _point(model="mixtral_small")])
+        for fam in one:
+            assert one[fam].restart_s == two[fam].restart_s
+
+
+class TestSource:
+    def test_fallback_is_assumed(self, tmp_path):
+        costs = family_restart_costs(path=str(tmp_path / "absent.json"))
+        for fam, s in ASSUMED_RESTART_S.items():
+            assert costs[fam].restart_s == s
+            assert costs[fam].provenance == "assumed"
+
+    def test_measured_file_wins(self, tmp_path):
+        p = tmp_path / "resize_measured.json"
+        p.write_text(json.dumps({"points": [_point()]}))
+        costs = family_restart_costs(path=str(p))
+        assert all(c.provenance != "assumed" for c in costs.values())
+
+    def test_error_points_are_ignored(self, tmp_path):
+        # A point that failed on-chip (error marker, no numbers) must not
+        # poison the derivation; all-failed falls back to assumed.
+        p = tmp_path / "resize_measured.json"
+        p.write_text(json.dumps(
+            {"points": [{"model": "llama_350m", "error": "timeout"}]}))
+        costs = family_restart_costs(path=str(p))
+        assert all(c.provenance == "assumed" for c in costs.values())
+
+    def test_half_failed_point_is_ignored(self, tmp_path):
+        # resize_bench emits restart_total_ms=None when the restart child
+        # dies before first_step_done, while resize_cost_seconds is still
+        # set from the save alone (resize_bench.py:130) — such a point
+        # must not reach derive_costs (it would TypeError every replay).
+        bad = _point()
+        bad["restart_total_ms"] = None
+        p = tmp_path / "resize_measured.json"
+        p.write_text(json.dumps({"points": [bad]}))
+        costs = family_restart_costs(path=str(p))
+        assert all(c.provenance == "assumed" for c in costs.values())
+
+    def test_family_tables_cover_trace_families(self):
+        from vodascheduler_tpu.replay.trace import MODEL_FAMILIES
+        assert set(MODEL_FAMILIES) == set(FAMILY_FOOTPRINT)
+        assert set(MODEL_FAMILIES) == set(ASSUMED_RESTART_S)
+
+    def test_default_is_family_weighted_mean(self, tmp_path):
+        # weights .30/.25/.20/.15/.10 over 10/15/20/45/60 s -> 23.5 s
+        assert default_restart_seconds(
+            path=str(tmp_path / "absent.json")) == 23.5
+
+
+class TestTraceWiring:
+    def test_trace_jobs_price_family_costs(self):
+        from vodascheduler_tpu.replay.trace import philly_like_trace
+        costs = family_restart_costs()
+        jobs = philly_like_trace(num_jobs=32, seed=7)
+        assert jobs
+        for j in jobs:
+            assert j.restart_overhead_seconds == costs[j.model].restart_s

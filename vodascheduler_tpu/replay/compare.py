@@ -31,9 +31,9 @@ def compare_algorithms(
     num_jobs: int = 64,
     seed: int = 20260729,
     algorithms: Optional[Sequence[str]] = None,
-    rate_limit_seconds: float = 20.0,
+    rate_limit_seconds: float = 30.0,
     scale_out_hysteresis: float = 1.5,
-    resize_cooldown_seconds: float = 60.0,
+    resize_cooldown_seconds: float = 300.0,
     preemptions: bool = False,
 ) -> List[ReplayReport]:
     """One ReplayReport per algorithm, same trace/pool/knobs for all.

@@ -89,7 +89,10 @@ class ReplayHarness:
         algorithm: str = "ElasticTiresias",
         topology: Optional[PoolTopology] = None,
         pool: str = "replay-pool",
-        restart_overhead_seconds: float = 30.0,
+        # None: the family-weighted mean from replay.restart_costs (the
+        # backend fallback for jobs without a per-job profile cost —
+        # trace jobs all carry their family's measured/assumed value).
+        restart_overhead_seconds: Optional[float] = None,
         rate_limit_seconds: float = 30.0,
         # TPU default: suppress sub-2x scale-outs within the resize
         # cooldown (scheduler._apply_hysteresis). On trace replay this
@@ -107,6 +110,11 @@ class ReplayHarness:
         self.clock = VirtualClock(start=start_epoch)
         self.store = JobStore()
         self.bus = EventBus()
+        if restart_overhead_seconds is None:
+            from vodascheduler_tpu.replay.restart_costs import (
+                default_restart_seconds,
+            )
+            restart_overhead_seconds = default_restart_seconds()
         self.backend = FakeClusterBackend(
             self.clock, restart_overhead_seconds=restart_overhead_seconds)
 
@@ -198,10 +206,17 @@ class ReplayHarness:
 
     def _submit(self, tj: TraceJob) -> None:
         self._accrue_attainable()
-        name = self.admission.create_training_job(tj.job_spec(self.pool))
-        # Exact-name registration: per-job fault injection must not leak to
+        # Profile registration rides the pre-publish hook: the CREATE
+        # event can synchronously start the job, and a sim started before
+        # its profile lands would be priced at the backend default
+        # (exactly what happened to 37/287 restarts before r5 — restart
+        # costs silently fell back to the 30 s default). Exact-name
+        # registration keeps per-job fault injection from leaking to
         # other jobs of the same family.
-        self.backend.register_profile(name, tj.profile())
+        name = self.admission.create_training_job(
+            tj.job_spec(self.pool),
+            on_admitted=lambda n: self.backend.register_profile(
+                n, tj.profile()))
         self._submitted.append(name)
         if self._first_submit_at is None:
             self._first_submit_at = self.clock.now()

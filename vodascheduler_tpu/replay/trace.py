@@ -54,18 +54,20 @@ class TraceJob:
 # configs (BASELINE.md): vision models are epoch-dominated and modest-sized;
 # LLMs have huge serial work, wide elastic chip ranges (FSDP scales), and
 # near-linear speedup at these scales. chip_k = (min, max) exponent range of
-# the job's *maximum* chips (2^k), sampled uniformly.
+# the job's *maximum* chips (2^k), sampled uniformly. Restart costs are NOT
+# here: they come from replay.restart_costs (measured on-chip when
+# doc/resize_measured.json exists, assumed-with-provenance otherwise).
 MODEL_FAMILIES: Dict[str, Dict[str, object]] = {
     "resnet50": {"epoch_seconds": 240.0, "exponent": 0.92, "weight": 0.30,
-                 "chip_k": (1, 4), "epochs_base": 30, "restart_s": 10.0},
+                 "chip_k": (1, 4), "epochs_base": 30},
     "bert":     {"epoch_seconds": 480.0, "exponent": 0.90, "weight": 0.25,
-                 "chip_k": (2, 4), "epochs_base": 20, "restart_s": 15.0},
+                 "chip_k": (2, 4), "epochs_base": 20},
     "vitl":     {"epoch_seconds": 900.0, "exponent": 0.90, "weight": 0.20,
-                 "chip_k": (2, 5), "epochs_base": 15, "restart_s": 20.0},
+                 "chip_k": (2, 5), "epochs_base": 15},
     "llama8b":  {"epoch_seconds": 3600.0, "exponent": 0.95, "weight": 0.15,
-                 "chip_k": (4, 6), "epochs_base": 8, "restart_s": 45.0},
+                 "chip_k": (4, 6), "epochs_base": 8},
     "mixtral":  {"epoch_seconds": 5400.0, "exponent": 0.93, "weight": 0.10,
-                 "chip_k": (4, 6), "epochs_base": 6, "restart_s": 60.0},
+                 "chip_k": (4, 6), "epochs_base": 6},
 }
 
 
@@ -83,9 +85,12 @@ def philly_like_trace(
       range (Philly mode is small jobs; LLM families claim large slices)
     - duration: log-normal heavy tail on epoch count
     """
+    from vodascheduler_tpu.replay.restart_costs import family_restart_costs
+
     rng = random.Random(seed)
     names = list(MODEL_FAMILIES)
     weights = [float(MODEL_FAMILIES[m]["weight"]) for m in names]
+    restart_costs = family_restart_costs()
 
     jobs: List[TraceJob] = []
     t = 0.0
@@ -117,7 +122,7 @@ def philly_like_trace(
             epoch_seconds_at_1=float(fam["epoch_seconds"]),
             speedup_exponent=float(fam["exponent"]),
             fail_at_epoch=fail_at,
-            restart_overhead_seconds=float(fam["restart_s"]),
+            restart_overhead_seconds=restart_costs[model].restart_s,
         ))
     return jobs
 

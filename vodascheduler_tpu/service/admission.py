@@ -61,12 +61,19 @@ class AdmissionService:
             "voda_service_delete_duration_seconds",
             "Job deletion handler duration")
 
-    def create_training_job(self, spec: JobSpec) -> str:
-        """Admit a job; returns its timestamped name."""
-        with timed(self.m_create_duration):
-            return self._create_training_job(spec)
+    def create_training_job(self, spec: JobSpec,
+                            on_admitted=None) -> str:
+        """Admit a job; returns its timestamped name.
 
-    def _create_training_job(self, spec: JobSpec) -> str:
+        `on_admitted(name)`, when given, runs after the store write but
+        BEFORE the scheduler hears the CREATE event — the only window
+        where per-job metadata (e.g. the replay's workload profiles) can
+        be attached race-free, since publish may synchronously trigger a
+        reschedule that starts the job."""
+        with timed(self.m_create_duration):
+            return self._create_training_job(spec, on_admitted)
+
+    def _create_training_job(self, spec: JobSpec, on_admitted=None) -> str:
         if self.valid_pools is not None and spec.pool not in self.valid_pools:
             self.m_errors.inc()
             raise AdmissionError(
@@ -108,6 +115,8 @@ class AdmissionService:
         self.store.insert_job(job)
 
         try:
+            if on_admitted is not None:
+                on_admitted(name)
             self.bus.publish(spec.pool, JobEvent(EventVerb.CREATE, name))
         except Exception:
             # Rollback like the reference (handlers.go:124-131): a job the
