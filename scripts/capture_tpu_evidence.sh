@@ -24,6 +24,17 @@ grep -q "PASSED" /tmp/e2e_tpu_pytest.out || {
     exit 1
 }
 
+# 1b. Live libtpu telemetry: SDK metric names verified against this
+#     image's libtpu build while real training steps run (VERDICT r4
+#     item 4). After it passes, mark the series list in
+#     doc/prometheus-metrics-exposed.md "verified live".
+python -m pytest tests/test_tpu_telemetry.py -q -rA -m "tpu" \
+    | tee /tmp/telemetry_tpu_pytest.out
+grep -q "PASSED" /tmp/telemetry_tpu_pytest.out || {
+    echo "live telemetry test did not PASS — not capturing"
+    exit 1
+}
+
 # 2. Full benchmark: replay headline + hardware section (model MFU,
 #    flash-vs-XLA, MoE, llama_1b) + elastic-resize cost breakdown.
 #    bench.py prints exactly one stdout line; no pipe, so its exit

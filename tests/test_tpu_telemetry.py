@@ -1,0 +1,77 @@
+"""Live libtpu telemetry (VERDICT r4 item 4): the SDK metric names in
+runtime/tpu_monitor.py are verified against the actual libtpu build by
+sampling TpuMonitor DURING real training steps on the chip and asserting
+the duty-cycle / tensorcore gauges export nonzero values. The hermetic
+mock test (test_metricscollector.py) proves the wiring; only this proves
+the names.
+
+Runs in a subprocess with the ambient (non-cpu) platform because the
+conftest pins in-process jax to the CPU mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_e2e_scheduler import _tpu_reachable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import jax
+assert jax.default_backend() == "tpu", jax.default_backend()
+
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.models import get_model
+from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor, _read_sdk_metrics
+from vodascheduler_tpu.runtime.train import TrainSession
+
+try:
+    from libtpu import sdk
+    print("supported:", sorted(sdk.tpumonitoring.list_supported_metrics()))
+except Exception as e:
+    print("sdk probe failed:", e)
+
+reg = Registry()
+mon = TpuMonitor(reg)
+# llama_350m keeps the MXU genuinely busy between samples, so the
+# duty-cycle/tensorcore windows cannot legitimately read zero.
+session = TrainSession(get_model("llama_350m"), 1,
+                       devices=jax.devices()[:1], global_batch_size=8)
+duty, tc, hbm = [], [], []
+for _ in range(3):
+    session.run_steps(8)
+    mon.collect_once()
+    sdk_vals = _read_sdk_metrics()
+    duty += sdk_vals.get("duty_cycle_pct", [])
+    tc += sdk_vals.get("tensorcore_util", [])
+    hbm += sdk_vals.get("hbm_capacity_usage", [])
+    print("sample:", {k: v for k, v in sdk_vals.items()})
+
+assert duty, "duty_cycle_pct exported nothing — SDK metric name wrong?"
+assert tc, "tensorcore_util exported nothing — SDK metric name wrong?"
+assert max(duty) > 0.0, duty
+assert max(tc) > 0.0, tc
+# Gauges carry the same values through the registry (scrape surface) —
+# the exported series must equal the last SDK sample, whatever it was.
+assert mon.m_sdk["duty_cycle_pct"].value(accelerator="0") == duty[-1]
+# Memory gauges export for the real device too.
+assert mon.m_devices.value() >= 1.0
+print("LIVE_TELEMETRY_OK max_duty", max(duty), "max_tc", max(tc),
+      "hbm", max(hbm) if hbm else None)
+"""
+
+
+@pytest.mark.tpu
+@pytest.mark.slow
+def test_live_libtpu_telemetry_nonzero():
+    if not _tpu_reachable():
+        pytest.skip("no reachable TPU accelerator")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    r = subprocess.run([sys.executable, "-c", _CHILD], capture_output=True,
+                       text=True, timeout=900, env=env, cwd=REPO)
+    sys.stdout.write(r.stdout[-2000:])
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1500:])
+    assert "LIVE_TELEMETRY_OK" in r.stdout

@@ -136,10 +136,12 @@ def family_restart_costs(
     # a footprint/assumed entry here would KeyError every replay.
     from vodascheduler_tpu.replay.trace import MODEL_FAMILIES
 
-    assert set(MODEL_FAMILIES) == set(FAMILY_FOOTPRINT) == set(
-        ASSUMED_RESTART_S), (
-        "replay families out of sync: trace.MODEL_FAMILIES vs "
-        "restart_costs.FAMILY_FOOTPRINT/ASSUMED_RESTART_S")
+    if not (set(MODEL_FAMILIES) == set(FAMILY_FOOTPRINT)
+            == set(ASSUMED_RESTART_S)):
+        raise ValueError(
+            "replay families out of sync: trace.MODEL_FAMILIES vs "
+            "restart_costs.FAMILY_FOOTPRINT/ASSUMED_RESTART_S — a new "
+            "family needs entries in all three tables")
     points = load_measured(path)
     if points:
         return derive_costs(points)

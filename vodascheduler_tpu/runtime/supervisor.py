@@ -207,6 +207,7 @@ def run_job(workdir: str, num_chips: int,
 
     warmup_pending = True
     warmup_step_time = 0.0
+    last_loss = float("nan")
     while session.step < total_steps:
         epoch_end_step = min(total_steps,
                              (session.step // steps_per_epoch + 1)
@@ -214,7 +215,7 @@ def run_job(workdir: str, num_chips: int,
         steps_this_epoch = epoch_end_step - session.step
         if warmup_pending:
             t0 = time.monotonic()
-            session.run_steps(1)
+            last_loss = session.run_steps(1)
             warmup_step_time = time.monotonic() - t0
             warmup_pending = False
         timed_steps = 0
@@ -246,7 +247,7 @@ def run_job(workdir: str, num_chips: int,
                           file=sys.stderr)
                 t0 = time.monotonic()
                 try:
-                    session.run_steps(n)
+                    last_loss = session.run_steps(n)
                 finally:
                     if started:
                         try:
@@ -262,7 +263,7 @@ def run_job(workdir: str, num_chips: int,
                 profiled_steps += n
                 continue
             t0 = time.monotonic()
-            session.run_steps(n)
+            last_loss = session.run_steps(n)
             timed_time += time.monotonic() - t0
             timed_steps += n
         # Fallback order when an epoch has no cleanly-timed steps: the
@@ -280,6 +281,14 @@ def run_job(workdir: str, num_chips: int,
                              step_time_sec=step_time,
                              workers=num_chips,
                              start_time=str(time.time()))
+        if jax.process_index() == 0:
+            # Greppable per-epoch loss: e2e artifacts parse these lines
+            # to assert training-loss continuity across a checkpoint
+            # restart (a lost restore would snap the loss back to its
+            # from-scratch value). Not in the epoch CSV — that schema is
+            # the reference-compatible collector contract.
+            print(f"epoch {(session.step - 1) // steps_per_epoch} "
+                  f"loss {last_loss:.6f}", flush=True)
         # Async: the next epoch's compute overlaps this save's shard
         # writes (the device->host copy is synchronous inside save).
         session.save(ckpt_dir, wait=False)
