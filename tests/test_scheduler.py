@@ -313,3 +313,109 @@ class TestMultiPool:
         assert a not in scheds["p2"].job_num_chips
         assert not backends["p2"].running_jobs()
         assert a not in scheds["p1"].job_num_chips
+
+
+class TestApplyFailureIsolation:
+    """A backend raise during start/scale must not strand the job as
+    phantom-running (found live in r5: one 503 during start_job left
+    job_num_chips claiming chips the backend never realized, so the
+    diff never re-emitted the start)."""
+
+    class _FlakyStartBackend(FakeClusterBackend):
+        def __init__(self, clock, fail_starts=1, **kw):
+            super().__init__(clock, **kw)
+            self.fail_starts = fail_starts
+            self.start_attempts = 0
+
+        def start_job(self, spec, num_workers, placements=None):
+            self.start_attempts += 1
+            if self.fail_starts > 0:
+                self.fail_starts -= 1
+                raise RuntimeError("injected 503")
+            super().start_job(spec, num_workers, placements)
+
+    def test_failed_start_reverts_and_retries(self):
+        clock = VirtualClock(start=1753760000.0)
+        backend = self._FlakyStartBackend(clock, fail_starts=1,
+                                          restart_overhead_seconds=5.0)
+        for i in range(2):
+            backend.add_host(f"host-{i}", 4, announce=False)
+        clock2, store, bus, backend, sched, admission = build_world(
+            backend=backend, clock=clock)
+        backend.register_profile("j", WorkloadProfile(epoch_seconds_at_1=30.0))
+        name = admission.create_training_job(spec("j", max_chips=8, epochs=2))
+        # First start failed: bookkeeping must NOT claim the allocation.
+        assert sched.job_num_chips.get(name, 0) == 0
+        assert store.get_job(name).status != JobStatus.RUNNING
+        # The scheduled retry starts it for real.
+        clock.advance(10.0)
+        assert backend.start_attempts >= 2
+        assert store.get_job(name).status == JobStatus.RUNNING
+        assert sched.job_num_chips[name] == 8
+        # And the job runs to completion as normal.
+        clock.advance(3600.0)
+        assert store.get_job(name).status == JobStatus.COMPLETED
+
+    def test_other_jobs_survive_one_failed_start(self):
+        clock = VirtualClock(start=1753760000.0)
+        backend = self._FlakyStartBackend(clock, fail_starts=1,
+                                          restart_overhead_seconds=5.0)
+        for i in range(2):
+            backend.add_host(f"host-{i}", 4, announce=False)
+        _, store, bus, backend, sched, admission = build_world(
+            backend=backend, clock=clock)
+        for j in ("a", "b"):
+            backend.register_profile(
+                j, WorkloadProfile(epoch_seconds_at_1=30.0))
+        # One job's failed start must not poison the other: both are
+        # submitted while the storm eats the first attempt, and both
+        # must still run to completion via the retry machinery.
+        na = admission.create_training_job(spec("a", max_chips=4, epochs=2))
+        nb = admission.create_training_job(spec("b", max_chips=4, epochs=2))
+        clock.advance(10.0)
+        statuses = {store.get_job(n).status for n in (na, nb)}
+        assert JobStatus.FAILED not in statuses
+        assert JobStatus.RUNNING in statuses
+        clock.advance(3600.0)
+        assert store.get_job(na).status == JobStatus.COMPLETED
+        assert store.get_job(nb).status == JobStatus.COMPLETED
+
+    class _FlakyStopBackend(FakeClusterBackend):
+        def __init__(self, clock, fail_stops=1, **kw):
+            super().__init__(clock, **kw)
+            self.fail_stops = fail_stops
+
+        def stop_job(self, name):
+            if self.fail_stops > 0:
+                self.fail_stops -= 1
+                raise RuntimeError("injected stop 503")
+            super().stop_job(name)
+
+    def test_failed_halt_aborts_pass_no_double_booking(self):
+        # SRJF preempts a long job for a short one. If the halt raises,
+        # the short job's start was computed assuming the freed chips —
+        # applying it would double-book hosts; the pass must stop and
+        # the retry must do the whole swap cleanly.
+        clock = VirtualClock(start=1753760000.0)
+        backend = self._FlakyStopBackend(clock, fail_stops=1,
+                                         restart_overhead_seconds=5.0)
+        for i in range(2):
+            backend.add_host(f"host-{i}", 4, announce=False)
+        _, store, bus, backend, sched, admission = build_world(
+            backend=backend, clock=clock, algorithm="SRJF")
+        backend.register_profile(
+            "long", WorkloadProfile(epoch_seconds_at_1=120.0))
+        backend.register_profile(
+            "short", WorkloadProfile(epoch_seconds_at_1=30.0))
+        nl = admission.create_training_job(
+            spec("long", max_chips=8, epochs=50))
+        assert store.get_job(nl).status == JobStatus.RUNNING
+        ns = admission.create_training_job(
+            spec("short", max_chips=8, epochs=1))
+        clock.advance(5.0)  # the pass with the failing halt
+        booked = sum(sched.job_num_chips.values())
+        assert booked <= sched.total_chips, sched.job_num_chips
+        clock.advance(3600.0)
+        assert store.get_job(ns).status == JobStatus.COMPLETED
+        clock.advance(100000.0)
+        assert store.get_job(nl).status == JobStatus.COMPLETED

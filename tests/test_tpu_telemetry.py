@@ -25,7 +25,7 @@ assert jax.default_backend() == "tpu", jax.default_backend()
 
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.models import get_model
-from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor, _read_sdk_metrics
+from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
 from vodascheduler_tpu.runtime.train import TrainSession
 
 try:
@@ -40,27 +40,27 @@ mon = TpuMonitor(reg)
 # duty-cycle/tensorcore windows cannot legitimately read zero.
 session = TrainSession(get_model("llama_350m"), 1,
                        devices=jax.devices()[:1], global_batch_size=8)
-duty, tc, hbm = [], [], []
+# Read the GAUGES collect_once populated (the scrape surface) — never
+# re-sample the SDK for comparison; two live samples differ.
+duty, tc = [], []
 for _ in range(3):
     session.run_steps(8)
     mon.collect_once()
-    sdk_vals = _read_sdk_metrics()
-    duty += sdk_vals.get("duty_cycle_pct", [])
-    tc += sdk_vals.get("tensorcore_util", [])
-    hbm += sdk_vals.get("hbm_capacity_usage", [])
-    print("sample:", {k: v for k, v in sdk_vals.items()})
+    sample = {name: mon.m_sdk[name].value(accelerator="0")
+              for name in ("duty_cycle_pct", "tensorcore_util",
+                           "hbm_capacity_usage")}
+    duty.append(sample["duty_cycle_pct"])
+    tc.append(sample["tensorcore_util"])
+    print("gauge sample:", sample)
 
-assert duty, "duty_cycle_pct exported nothing — SDK metric name wrong?"
-assert tc, "tensorcore_util exported nothing — SDK metric name wrong?"
-assert max(duty) > 0.0, duty
-assert max(tc) > 0.0, tc
-# Gauges carry the same values through the registry (scrape surface) —
-# the exported series must equal the last SDK sample, whatever it was.
-assert mon.m_sdk["duty_cycle_pct"].value(accelerator="0") == duty[-1]
+# Gauge.value returns 0.0 for an absent series, so nonzero here proves
+# both halves at once: the SDK metric NAME resolves on this libtpu
+# build, and the value is live during real training.
+assert max(duty) > 0.0, f"duty_cycle_pct never nonzero: {duty}"
+assert max(tc) > 0.0, f"tensorcore_util never nonzero: {tc}"
 # Memory gauges export for the real device too.
 assert mon.m_devices.value() >= 1.0
-print("LIVE_TELEMETRY_OK max_duty", max(duty), "max_tc", max(tc),
-      "hbm", max(hbm) if hbm else None)
+print("LIVE_TELEMETRY_OK max_duty", max(duty), "max_tc", max(tc))
 """
 
 

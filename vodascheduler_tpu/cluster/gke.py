@@ -317,7 +317,17 @@ class GkeBackend(ClusterBackend):
             self._missing_pods.pop(spec.name, None)  # fresh vanish grace
             placements = placements or self._default_placements(num_workers)
             self._specs[spec.name] = spec
-            self._create_pods(spec, num_workers, placements)
+            try:
+                self._create_pods(spec, num_workers, placements)
+            except Exception:
+                # A 5xx mid-loop leaves earlier pods (and the coord
+                # service) live but the job untracked — no sweep would
+                # ever reap them and they'd hold TPU chips forever.
+                # Clean up this incarnation best-effort, then let the
+                # caller see the failure (job stays schedulable).
+                self._cleanup_incarnation(spec.name, len(placements))
+                self._specs.pop(spec.name, None)
+                raise
             self._jobs[spec.name] = JobHandle(
                 name=spec.name, num_workers=num_workers,
                 placements=list(placements))
@@ -335,7 +345,22 @@ class GkeBackend(ClusterBackend):
             with self._lock:
                 placements = placements or self._default_placements(
                     num_workers)
-                self._create_pods(spec, num_workers, placements)
+                try:
+                    self._create_pods(spec, num_workers, placements)
+                except Exception:
+                    # Old pods are gone and the new set is partial: a
+                    # half-created incarnation would sit Pending under
+                    # the job's label and the sweep would wait on it
+                    # forever. Clean up and drop the job, then let the
+                    # exception reach the scheduler, which reverts its
+                    # allocation bookkeeping and retries the start — the
+                    # checkpoint makes this a resumable pause, so no
+                    # JOB_FAILED (that verdict is permanent) for a
+                    # transient API storm.
+                    self._cleanup_incarnation(name, len(placements))
+                    self._jobs.pop(name, None)
+                    self._specs.pop(name, None)
+                    raise
                 self._jobs[name] = JobHandle(name=name,
                                              num_workers=num_workers,
                                              placements=list(placements))
@@ -479,6 +504,26 @@ class GkeBackend(ClusterBackend):
             container.setdefault("resources", {}).setdefault(
                 "limits", {})[TPU_RESOURCE] = str(chips)
             self.kube.create_pod(self.namespace, manifest)
+
+    def _cleanup_incarnation(self, job: str, n_pods: int) -> None:
+        """Best-effort removal of the CURRENT incarnation's attempted
+        pods and coordinator service after a partial _create_pods —
+        names are derived (not listed) so cleanup works mid-API-storm,
+        and each delete is independent so one flake can't strand the
+        rest."""
+        gen = self._incarnation.get(job, 0)
+        for pid in range(n_pods):
+            try:
+                self.kube.delete_pod(self.namespace,
+                                     f"voda-{job}-i{gen}-w{pid}",
+                                     grace_seconds=0)
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
+        try:
+            self.kube.delete_service(self.namespace,
+                                     f"voda-{job}-i{gen}-coord")
+        except Exception:  # noqa: BLE001 - best-effort
+            pass
 
     def _delete_pods(self, job: str) -> None:
         gens = {self._incarnation.get(job, 0)}

@@ -202,6 +202,26 @@ class MultiHostBackend(ClusterBackend):
         port = _free_port()
         procs: List[subprocess.Popen] = []
         single = len(placements) == 1
+        try:
+            self._spawn_procs(spec, num_chips, placements, port, single,
+                              job_dir, procs)
+        except Exception:
+            # Partial spawn (e.g. Popen resource exhaustion on the 2nd
+            # host): already-started supervisors would keep training
+            # untracked and hold their chips. Kill them, then surface
+            # the failure — the scheduler reverts and retries.
+            for p in procs:
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+            raise
+        self._jobs[spec.name] = _ProcSet(procs, num_chips, list(placements))
+
+    def _spawn_procs(self, spec: JobSpec, num_chips: int,
+                     placements: List[Tuple[str, int]], port: int,
+                     single: bool, job_dir: str,
+                     procs: List[subprocess.Popen]) -> None:
         for pid, (host, chips) in enumerate(placements):
             env = dict(os.environ)
             # Each process owns its host's chips as a local CPU platform;
@@ -223,7 +243,6 @@ class MultiHostBackend(ClusterBackend):
                 procs.append(subprocess.Popen(cmd, env=env, stdout=log_f,
                                               stderr=log_f,
                                               start_new_session=True))
-        self._jobs[spec.name] = _ProcSet(procs, num_chips, list(placements))
 
     def _stop_set(self, name: str) -> None:
         with self._lock:

@@ -440,14 +440,41 @@ class Scheduler:
 
         # Halts and scale-ins release chips before starts/scale-outs claim
         # them (reference: applySchedulerResults order, scheduler.go:434-445).
+        # Each apply is isolated: a backend failure (API storm during pod
+        # creation) must not abort the rest of the pass, and — critically —
+        # must not leave job_num_chips claiming an allocation the backend
+        # never realized, or the diff would never emit the start again and
+        # the job would strand as phantom-running (found live in r5: a
+        # single 503 during start_job stranded the job permanently).
+        halt_failed = False
         for job in halts:
-            self._halt_job(job)
+            try:
+                self._halt_job(job)
+            except Exception:
+                log.exception("halt of %r failed; keeping its allocation "
+                              "booked so the halt is retried", job)
+                self.job_num_chips[job] = old.get(job, 0)
+                halt_failed = True
+        if halt_failed:
+            # The rest of this pass was computed assuming the halted
+            # chips are free — applying it would double-book their hosts
+            # (starts pinned onto still-occupied nodes). Revert every
+            # unapplied booking and leave the whole pass to the retry,
+            # which recomputes from consistent state.
+            for job in scale_ins + scale_outs + starts:
+                self.job_num_chips[job] = old.get(job, 0)
+            self._placement_dirty = True
+            self._schedule_retry()
+            self.store.flush()
+            self.m_resched_total.inc()
+            self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
+            return
         for job in scale_ins:
-            self._scale_job(job, placements.get(job))
+            self._apply_scale(job, placements.get(job))
         for job in starts:
-            self._start_job(job, placements.get(job))
+            self._apply_start(job, placements.get(job))
         for job in scale_outs:
-            self._scale_job(job, placements.get(job))
+            self._apply_scale(job, placements.get(job))
         if placed:
             self._migrate_moved_jobs(
                 placements, set(halts) | set(starts) | set(scale_ins) | set(scale_outs))
@@ -470,7 +497,19 @@ class Scheduler:
             if handle is None:
                 continue
             if sorted(handle.placements) != sorted(target):
-                self.backend.migrate_workers(job_name, target)
+                try:
+                    self.backend.migrate_workers(job_name, target)
+                except Exception:
+                    log.exception("migration of %r failed; re-booking from "
+                                  "backend state and retrying", job_name)
+                    if job_name not in self.backend.running_jobs():
+                        self._revert_to_waiting(job_name)
+                    # The retry only recomputes placements when dirty —
+                    # without this, an unchanged allocation would never
+                    # re-check the mismatched binding.
+                    self._placement_dirty = True
+                    self._schedule_retry()
+                    continue
                 self._last_resize_at[job_name] = self.clock.now()
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
@@ -535,6 +574,53 @@ class Scheduler:
             if job not in old and n_new > 0:
                 starts.append(job)
         return halts, scale_ins, scale_outs, starts
+
+    def _apply_start(self, name: str,
+                     placements: Optional[List[Tuple[str, int]]] = None
+                     ) -> None:
+        """_start_job with failure isolation: on a backend raise the
+        bookkeeping reverts to 'not running' (backends guarantee a
+        raising start leaves nothing running — gke cleans partial pods,
+        multihost kills partial spawns) and a retry is scheduled."""
+        try:
+            self._start_job(name, placements)
+        except Exception:
+            log.exception("start of %r failed; reverting allocation and "
+                          "retrying after the rate limit", name)
+            self._revert_to_waiting(name)
+            self._schedule_retry()
+
+    def _apply_scale(self, name: str,
+                     placements: Optional[List[Tuple[str, int]]] = None
+                     ) -> None:
+        """_scale_job with failure isolation. If the backend still runs
+        the old incarnation, book its live size (the resize simply didn't
+        happen); if the backend dropped the job (gke's cleaned partial
+        resize), revert to waiting — the checkpoint makes the later
+        restart a resume, not lost work."""
+        try:
+            self._scale_job(name, placements)
+        except Exception:
+            log.exception("resize of %r failed; re-booking from backend "
+                          "state and retrying", name)
+            live = {}
+            try:
+                live = self.backend.running_jobs()
+            except Exception:  # noqa: BLE001 - storm may still be on
+                pass
+            if name in live:
+                self.job_num_chips[name] = live[name].num_workers
+            else:
+                self._revert_to_waiting(name)
+            self._schedule_retry()
+
+    def _revert_to_waiting(self, name: str) -> None:
+        self.job_num_chips[name] = 0
+        job = self.ready_jobs.get(name)
+        if job is not None and job.status == JobStatus.RUNNING:
+            job.status = JobStatus.WAITING
+            job.metrics.last_waiting_seconds = 0.0
+            self.store.update_job(job)
 
     def _start_job(self, name: str,
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
