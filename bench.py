@@ -61,9 +61,13 @@ def run_replay():
     return harness.run()
 
 
-# llama_1b last: ≥1B params on one 16 GB chip (adafactor bundle) is the
-# most OOM-prone point, and the stream salvages earlier points if it dies.
-HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m_8k", 2], ["llama_1b", 4]]
+# llama_350m at B=16: the r4 state-donation fix halved in-step HBM, so
+# double the r3 batch may now fit — streamed AFTER the known-good B=8
+# point so an OOM costs nothing. llama_1b last: ≥1B params on one 16 GB
+# chip (adafactor bundle) is the most OOM-prone point, and the stream
+# salvages earlier points if it dies.
+HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m", 16],
+                   ["llama_350m_8k", 2], ["llama_1b", 4]]
 # Attention points inherit the child's DEFAULT_ATTENTION_POINTS
 # (runtime/hwbench.py) — one canonical sweep definition, no drift.
 # Elastic-resize cost points (runtime/resize_bench.py): the models whose
@@ -242,7 +246,7 @@ def maybe_hardware():
     still print either way.
 
     The whole hardware section runs in a SUBPROCESS (hwbench --stream)
-    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 1800s) AND a
+    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 2400s) AND a
     per-point stall watchdog (VODA_BENCH_HW_STALL_TIMEOUT, default 600s
     between streamed lines): a wedged remote compile blocks inside
     native code holding the GIL, where no in-process signal can
@@ -267,7 +271,10 @@ def maybe_hardware():
                 "VODA_HWBENCH_ON_CPU"):  # tests drive the full path on CPU
             return None
 
-        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "1800"))
+        # 2400s: the r5 point list grew (llama_350m B=16 candidate +
+        # llama_1b); at ~2-4 min/point plus the attention and MoE sweeps
+        # the old 1800s budget had no headroom left.
+        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "2400"))
         stall = int(os.environ.get("VODA_BENCH_HW_STALL_TIMEOUT", "600"))
         cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
                "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]

@@ -419,3 +419,58 @@ class TestApplyFailureIsolation:
         assert store.get_job(ns).status == JobStatus.COMPLETED
         clock.advance(100000.0)
         assert store.get_job(nl).status == JobStatus.COMPLETED
+
+    class _StormBackend(FakeClusterBackend):
+        """scale_job AND running_jobs both fail while the storm is on."""
+
+        def __init__(self, clock, storm_calls=1, **kw):
+            super().__init__(clock, **kw)
+            self.storm_calls = storm_calls
+
+        def _storm(self):
+            if self.storm_calls > 0:
+                self.storm_calls -= 1
+                raise RuntimeError("injected storm 503")
+
+        def scale_job(self, name, num_workers, placements=None):
+            self._storm()
+            super().scale_job(name, num_workers, placements)
+
+        def running_jobs(self):
+            self._storm()
+            return super().running_jobs()
+
+    def test_storm_during_scale_keeps_old_booking_no_livelock(self):
+        # scale_job raises AND the post-failure running_jobs() probe
+        # raises too: the scheduler must keep the OLD booking (pods may
+        # still hold the chips) instead of assuming not-running — the
+        # wrong assumption double-books hosts and livelocks retried
+        # starts against "already running". After the storm passes, the
+        # shrink applies and both jobs complete.
+        clock = VirtualClock(start=1753760000.0)
+        backend = self._StormBackend(clock, storm_calls=2,
+                                     restart_overhead_seconds=5.0)
+        for i in range(2):
+            backend.add_host(f"host-{i}", 4, announce=False)
+        _, store, bus, backend, sched, admission = build_world(
+            backend=backend, clock=clock)
+        backend.register_profile(
+            "a", WorkloadProfile(epoch_seconds_at_1=60.0))
+        backend.register_profile(
+            "b", WorkloadProfile(epoch_seconds_at_1=60.0))
+        na = admission.create_training_job(spec("a", max_chips=8, epochs=20))
+        assert sched.job_num_chips[na] == 8
+        clock.advance(2.0)
+        # b's admission triggers the shrink of a — which hits the storm.
+        nb = admission.create_training_job(spec("b", max_chips=8, epochs=2))
+        # a keeps its old 8-chip booking; b must NOT have started onto
+        # a's hosts (the pass aborted before applying the start).
+        assert sched.job_num_chips[na] == 8, sched.job_num_chips
+        assert sched.job_num_chips.get(nb, 0) == 0, sched.job_num_chips
+        assert sum(sched.job_num_chips.values()) <= sched.total_chips
+        clock.advance(10.0)  # retry lands after the storm
+        assert sched.job_num_chips[na] == 4
+        assert sched.job_num_chips[nb] == 4
+        clock.advance(100000.0)
+        assert store.get_job(na).status == JobStatus.COMPLETED
+        assert store.get_job(nb).status == JobStatus.COMPLETED

@@ -341,7 +341,26 @@ class GkeBackend(ClusterBackend):
         with self._lock:
             self._resizing.add(name)
         try:
-            self._delete_pods(name)
+            try:
+                self._delete_pods(name)
+            except Exception:
+                # Half-deleted incarnation: SIGTERM'd workers are already
+                # checkpointing out, and survivors exit once their
+                # collective loses a peer — if the job stayed tracked the
+                # sweep would read those exits as an EXTERNAL preemption
+                # and emit the permanent JOB_FAILED for what is a
+                # transient API storm. Finish the teardown best-effort by
+                # derived name (list may be down), drop the job, and let
+                # the raise reach the scheduler's revert+retry — the
+                # checkpoint makes the restart a resume.
+                handle = self._jobs.get(name)
+                n = len(handle.placements) if (handle and handle.placements) \
+                    else 16
+                self._cleanup_incarnation(name, n)
+                with self._lock:
+                    self._jobs.pop(name, None)
+                    self._specs.pop(name, None)
+                raise
             with self._lock:
                 placements = placements or self._default_placements(
                     num_workers)

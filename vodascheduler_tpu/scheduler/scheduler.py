@@ -446,7 +446,7 @@ class Scheduler:
         # never realized, or the diff would never emit the start again and
         # the job would strand as phantom-running (found live in r5: a
         # single 503 during start_job stranded the job permanently).
-        halt_failed = False
+        release_failed = False
         for job in halts:
             try:
                 self._halt_job(job)
@@ -454,14 +454,27 @@ class Scheduler:
                 log.exception("halt of %r failed; keeping its allocation "
                               "booked so the halt is retried", job)
                 self.job_num_chips[job] = old.get(job, 0)
-                halt_failed = True
-        if halt_failed:
-            # The rest of this pass was computed assuming the halted
+                release_failed = True
+        applied_scale_ins = set()
+        if not release_failed:
+            for job in scale_ins:
+                before = self.job_num_chips.get(job, 0)
+                self._apply_scale(job, placements.get(job), old.get(job, 0))
+                applied_scale_ins.add(job)
+                if self.job_num_chips.get(job, 0) > before:
+                    # The shrink didn't happen (failure handler re-booked
+                    # the old/live size): its chips were never freed.
+                    release_failed = True
+                    break
+        if release_failed:
+            # The rest of this pass was computed assuming the released
             # chips are free — applying it would double-book their hosts
             # (starts pinned onto still-occupied nodes). Revert every
-            # unapplied booking and leave the whole pass to the retry,
-            # which recomputes from consistent state.
-            for job in scale_ins + scale_outs + starts:
+            # UNAPPLIED booking (applied scale-ins already book backend
+            # truth) and leave the pass to the retry, which recomputes
+            # from consistent state.
+            unapplied = [j for j in scale_ins if j not in applied_scale_ins]
+            for job in unapplied + scale_outs + starts:
                 self.job_num_chips[job] = old.get(job, 0)
             self._placement_dirty = True
             self._schedule_retry()
@@ -469,12 +482,10 @@ class Scheduler:
             self.m_resched_total.inc()
             self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
             return
-        for job in scale_ins:
-            self._apply_scale(job, placements.get(job))
         for job in starts:
             self._apply_start(job, placements.get(job))
         for job in scale_outs:
-            self._apply_scale(job, placements.get(job))
+            self._apply_scale(job, placements.get(job), old.get(job, 0))
         if placed:
             self._migrate_moved_jobs(
                 placements, set(halts) | set(starts) | set(scale_ins) | set(scale_outs))
@@ -502,7 +513,11 @@ class Scheduler:
                 except Exception:
                     log.exception("migration of %r failed; re-booking from "
                                   "backend state and retrying", job_name)
-                    if job_name not in self.backend.running_jobs():
+                    try:
+                        still_live = job_name in self.backend.running_jobs()
+                    except Exception:  # noqa: BLE001 - storm still on
+                        still_live = True  # keep the booking; retry decides
+                    if not still_live:
                         self._revert_to_waiting(job_name)
                     # The retry only recomputes placements when dirty —
                     # without this, an unchanged allocation would never
@@ -591,23 +606,28 @@ class Scheduler:
             self._schedule_retry()
 
     def _apply_scale(self, name: str,
-                     placements: Optional[List[Tuple[str, int]]] = None
-                     ) -> None:
+                     placements: Optional[List[Tuple[str, int]]] = None,
+                     old_chips: int = 0) -> None:
         """_scale_job with failure isolation. If the backend still runs
         the old incarnation, book its live size (the resize simply didn't
         happen); if the backend dropped the job (gke's cleaned partial
         resize), revert to waiting — the checkpoint makes the later
-        restart a resume, not lost work."""
+        restart a resume, not lost work. If the backend can't even be
+        ASKED (the storm also broke running_jobs), keep the old booking:
+        assuming not-running while pods still hold chips would double-
+        book hosts and livelock retried starts against 'already
+        running'."""
         try:
             self._scale_job(name, placements)
         except Exception:
             log.exception("resize of %r failed; re-booking from backend "
                           "state and retrying", name)
-            live = {}
             try:
                 live = self.backend.running_jobs()
             except Exception:  # noqa: BLE001 - storm may still be on
-                pass
+                self.job_num_chips[name] = old_chips
+                self._schedule_retry()
+                return
             if name in live:
                 self.job_num_chips[name] = live[name].num_workers
             else:
