@@ -28,3 +28,22 @@ DEFAULT_ALGORITHM = os.environ.get("VODA_DEFAULT_ALGORITHM", "ElasticFIFO")
 # Root for job workdirs (checkpoints, metrics CSVs, supervisor logs) — the
 # role of the reference's shared PVCs.
 WORKDIR = os.environ.get("VODA_WORKDIR", os.path.expanduser("~/.voda"))
+
+def _env_float(name: str, default: str) -> float:
+    raw = os.environ.get(name, default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {raw!r}") from None
+
+
+# TPU-delta resize knobs (no reference counterpart — Horovod resizes were
+# ~free; checkpoint-restart resizes are not). The ONE source of truth for
+# the shipped values: Scheduler ctor defaults and ReplayHarness both read
+# these, so replay evidence and production policy cannot drift. Defaults
+# are the r5 sweep knee (scripts/replay_sweep.py,
+# doc/replay_sweep_r5.json); the env overrides exist for operators
+# re-tuning on their own workload.
+SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.5")
+RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "300")

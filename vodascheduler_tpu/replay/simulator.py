@@ -13,6 +13,7 @@ import dataclasses
 import statistics
 from typing import List, Optional, Sequence
 
+from vodascheduler_tpu import config
 from vodascheduler_tpu.allocator import ResourceAllocator
 from vodascheduler_tpu.cluster.fake import FakeClusterBackend
 from vodascheduler_tpu.common.clock import VirtualClock
@@ -94,12 +95,12 @@ class ReplayHarness:
         # trace jobs all carry their family's measured/assumed value).
         restart_overhead_seconds: Optional[float] = None,
         rate_limit_seconds: float = 30.0,
-        # TPU default: suppress sub-2x scale-outs within the resize
-        # cooldown (scheduler._apply_hysteresis). On trace replay this
-        # cuts +1-chip resize oscillation, improving both utilization and
-        # mean JCT; 1.0 restores reference apply-every-diff semantics.
-        scale_out_hysteresis: float = 2.0,
-        resize_cooldown_seconds: float = 120.0,
+        # None -> the production defaults (config.SCALE_OUT_HYSTERESIS /
+        # RESIZE_COOLDOWN_SECONDS, the r5 sweep knee): replay evidence
+        # and deployed policy must not drift. 1.0 restores reference
+        # apply-every-diff semantics.
+        scale_out_hysteresis: Optional[float] = None,
+        resize_cooldown_seconds: Optional[float] = None,
         collector_interval_seconds: float = 60.0,
         preemptions: Sequence[PreemptionEvent] = (),
         start_epoch: float = 1753760000.0,
@@ -130,8 +131,13 @@ class ReplayHarness:
             pool, self.backend, self.store, ResourceAllocator(self.store),
             self.clock, bus=self.bus, placement_manager=pm,
             algorithm=algorithm, rate_limit_seconds=rate_limit_seconds,
-            scale_out_hysteresis=scale_out_hysteresis,
-            resize_cooldown_seconds=resize_cooldown_seconds)
+            scale_out_hysteresis=(
+                config.SCALE_OUT_HYSTERESIS if scale_out_hysteresis is None
+                else scale_out_hysteresis),
+            resize_cooldown_seconds=(
+                config.RESIZE_COOLDOWN_SECONDS
+                if resize_cooldown_seconds is None
+                else resize_cooldown_seconds))
         self.admission = AdmissionService(self.store, self.bus, self.clock)
         self.collector = MetricsCollector(
             self.store, BackendRowSource(self.backend), self.clock,
