@@ -36,10 +36,15 @@ BASELINE_TARGET_UTILIZATION = 0.85  # BASELINE.json north star
 # regression reference. Earlier rounds' 3195 s target was measured on
 # the corrupted-trace replay and is not comparable.
 JCT_TARGET_SECONDS = 9340.0
-# The r5 sweep knee (see module docstring); used by the run AND the report.
+# The r5 sweep knee (see module docstring); used by the run AND the
+# report. Hysteresis/cooldown come from config — the single source the
+# production Scheduler defaults also read — so the bench always measures
+# the shipped policy.
+from vodascheduler_tpu import config as _config  # noqa: E402
+
 RATE_LIMIT_SECONDS = 30.0
-SCALE_OUT_HYSTERESIS = 1.5
-RESIZE_COOLDOWN_SECONDS = 300.0
+SCALE_OUT_HYSTERESIS = _config.SCALE_OUT_HYSTERESIS
+RESIZE_COOLDOWN_SECONDS = _config.RESIZE_COOLDOWN_SECONDS
 
 
 def run_replay():

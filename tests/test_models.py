@@ -482,6 +482,31 @@ class TestLlama1B:
 
 
 class TestScaleFeasibility:
+    def test_bench_hw_points_fit_hbm_abstract(self):
+        """Every bench.py HW_MODEL_POINT must fit a 16 GB v5e at the
+        shape level (state + f32 grads + bf16 cast + remat boundary
+        activations < 80% of HBM) — a point added without this check
+        wastes its chip-session slot on an OOM."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import HW_MODEL_POINTS
+        from vodascheduler_tpu.runtime.train import make_train_setup
+
+        for name, batch in HW_MODEL_POINTS:
+            bundle = get_model(name)
+            setup = make_train_setup(bundle, 1, devices=jax.devices()[:1],
+                                     global_batch_size=batch)
+            leaves = jax.tree.leaves(setup.eval_shape_state)
+            state = sum(l.size * l.dtype.itemsize for l in leaves)
+            params = sum(l.size for l in
+                         jax.tree.leaves(setup.eval_shape_state["params"]))
+            cfg = bundle.module.cfg
+            acts = cfg.num_layers * batch * cfg.max_seq_len * cfg.dim * 2
+            est = state + 4 * params + 2 * params + acts
+            assert est < 0.80 * 16e9, (name, batch, est / 1e9)
+
     @pytest.mark.slow
     def test_llama3_8b_state_shards_within_v5p_hbm(self):
         """BASELINE config 4 (Llama-3-8B FSDP elastic on v5p-64), proven

@@ -32,8 +32,10 @@ def compare_algorithms(
     seed: int = 20260729,
     algorithms: Optional[Sequence[str]] = None,
     rate_limit_seconds: float = 30.0,
-    scale_out_hysteresis: float = 1.5,
-    resize_cooldown_seconds: float = 300.0,
+    # None -> the production defaults (config, the r5 sweep knee) via
+    # ReplayHarness's own resolution — one source of truth.
+    scale_out_hysteresis: Optional[float] = None,
+    resize_cooldown_seconds: Optional[float] = None,
     preemptions: bool = False,
 ) -> List[ReplayReport]:
     """One ReplayReport per algorithm, same trace/pool/knobs for all.

@@ -189,8 +189,6 @@ class VodaApp:
                 allocator=self.allocator, clock=self.clock, bus=self.bus,
                 algorithm=ps.algorithm or algorithm,
                 rate_limit_seconds=rate_limit_seconds,
-                scale_out_hysteresis=config.SCALE_OUT_HYSTERESIS,
-                resize_cooldown_seconds=config.RESIZE_COOLDOWN_SECONDS,
                 resume=resume, registry=self.registry,
                 placement_manager=pm)
             self.backends[ps.name] = be
