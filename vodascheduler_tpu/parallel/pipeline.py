@@ -35,6 +35,36 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as PSpec
+
+from vodascheduler_tpu.parallel.sharding import _ambient_mesh_active
+
+
+def _pin_stage_axis(arr: jax.Array) -> jax.Array:
+    """Constrain a [P, mb, ...] stage-stacked activation to pp on axis 0
+    and the data axes on the microbatch dim (trailing dims replicated —
+    the same layout constrain_batch_activation pins for [B, S, D]
+    activations). Without this GSPMD can propagate a model-axis sharding
+    from the layer compute into the loop carry, and the next tick's roll
+    pays an involuntary full rematerialization re-partitioning it
+    (observed on dp x fsdp x tp x pp meshes)."""
+    if not _ambient_mesh_active():
+        return arr
+    return jax.lax.with_sharding_constraint(
+        arr, PSpec("pp", ("dp", "fsdp")))
+
+
+def _pin_params_stage_axis(leaf: jax.Array) -> jax.Array:
+    """Pin ONLY axis 0 of a [P, L/P, ...] stage-params leaf to pp,
+    leaving every trailing dim UNCONSTRAINED so the rules' fsdp/tp
+    shardings survive (a None dim would mean REPLICATED — an all-gather
+    that defeats FSDP). Keeps axis 0 pinned through the reshape; without
+    it GSPMD may re-derive a model-axis sharding for the scan-carried
+    params and pay an involuntary replicate-repartition every tick."""
+    if not _ambient_mesh_active():
+        return leaf
+    return jax.lax.with_sharding_constraint(
+        leaf, PSpec("pp", *([PSpec.UNCONSTRAINED] * (leaf.ndim - 1))))
 
 
 def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
@@ -69,9 +99,11 @@ def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
                                   policy=_resolve_remat_policy(remat_policy))
 
     # [P, L/P, ...]: stage-major layer blocks. L is pp-sharded in P
-    # equal pieces, so this reshape is device-local.
+    # equal pieces, so this reshape is device-local (see
+    # _pin_params_stage_axis for why the constraint exists).
     stage_params = jax.tree.map(
-        lambda leaf: leaf.reshape(P, L // P, *leaf.shape[1:]),
+        lambda leaf: _pin_params_stage_axis(
+            leaf.reshape(P, L // P, *leaf.shape[1:])),
         stacked_params)
     xs = x.reshape(M, mb, *x.shape[1:])
 
@@ -90,7 +122,7 @@ def spmd_pipeline(layer_fn: Callable[[Any, jax.Array], jax.Array],
         shifted = jnp.roll(state, shift=1, axis=0)       # CollectivePermute
         shifted = shifted.at[0].set(
             jnp.where(t < M, feed, jnp.zeros_like(feed)))
-        state = jax.vmap(stage_fn)(stage_params, shifted)
+        state = _pin_stage_axis(jax.vmap(stage_fn)(stage_params, shifted))
         out_idx = t - (P - 1)
         cand = jax.lax.dynamic_update_index_in_dim(
             outputs, state[-1], jnp.clip(out_idx, 0, M - 1), 0)
