@@ -364,6 +364,16 @@ def _http_error(code: int) -> Exception:
                                   io.BytesIO(b""))
 
 
+class NoThreadBackend(GkeBackend):
+    """GkeBackend without the informer thread: tests drive poll_once()
+    deterministically, and FlakyKube's fault queues are not thread-safe
+    (a thread-consumed injection makes the explicit poll not raise).
+    The threaded path is covered by test_monitor_thread_survives_api_storm."""
+
+    def _ensure_monitor(self):
+        pass
+
+
 class TestApiFaultTolerance:
     """The failure paths the reference gets from client-go informers
     (resync + reconnect, scheduler.go:169-242) — here: poll backoff,
@@ -392,14 +402,6 @@ class TestApiFaultTolerance:
 
     def test_monitor_counts_failures_and_backs_off(self):
         kube = FlakyKube([make_node("host-0")])
-
-        # No informer thread at all: this test drives poll_once manually
-        # and mutates the failure counter, and FlakyKube's fault queues
-        # are not thread-safe (the threaded path is covered by
-        # test_monitor_thread_survives_api_storm).
-        class NoThreadBackend(GkeBackend):
-            def _ensure_monitor(self):
-                pass
 
         backend = NoThreadBackend(kube, pod_template=template(),
                                   poll_interval_seconds=2.0)
@@ -554,8 +556,8 @@ class TestPartialCreateCleanup:
 
     def _flaky_world(self):
         kube = FlakyKube([make_node(f"host-{i}") for i in range(4)])
-        backend = GkeBackend(kube, pod_template=template(),
-                             poll_interval_seconds=600.0)
+        backend = NoThreadBackend(kube, pod_template=template(),
+                                  poll_interval_seconds=600.0)
         events = []
         backend.set_event_callback(events.append)
         return kube, backend, events

@@ -41,17 +41,20 @@ from vodascheduler_tpu.parallel.sharding import _ambient_mesh_active
 
 
 def _pin_stage_axis(arr: jax.Array) -> jax.Array:
-    """Constrain a [P, mb, ...] stage-stacked activation to pp on axis 0
-    and the data axes on the microbatch dim (trailing dims replicated —
-    the same layout constrain_batch_activation pins for [B, S, D]
-    activations). Without this GSPMD can propagate a model-axis sharding
-    from the layer compute into the loop carry, and the next tick's roll
-    pays an involuntary full rematerialization re-partitioning it
-    (observed on dp x fsdp x tp x pp meshes)."""
+    """Constrain a [P, mb, S, D] stage-stacked activation to pp on axis
+    0, the data axes on the microbatch dim, and sp on the seq dim — the
+    same layout constrain_batch_activation pins for [B, S, D]
+    activations (sp is a no-op axis on sp=1 meshes, and the runtime
+    rejects pp x sp today, but a standalone spmd_pipeline caller with a
+    real sp axis must not see its seq sharding forced to replicated).
+    Without this GSPMD can propagate a model-axis sharding from the
+    layer compute into the loop carry, and the next tick's roll pays an
+    involuntary full rematerialization re-partitioning it (observed on
+    dp x fsdp x tp x pp meshes)."""
     if not _ambient_mesh_active():
         return arr
     return jax.lax.with_sharding_constraint(
-        arr, PSpec("pp", ("dp", "fsdp")))
+        arr, PSpec("pp", ("dp", "fsdp"), "sp"))
 
 
 def _pin_params_stage_axis(leaf: jax.Array) -> jax.Array:
