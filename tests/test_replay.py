@@ -111,7 +111,8 @@ def test_bench_scenario_meets_targets():
     profile, so r1-r4 guard values (avg 3195 s, p95 10.5 ks...) are not
     comparable — the true heavy-tailed trace is ~3.4x heavier. Sweep
     provenance: scripts/replay_sweep.py, doc/replay_sweep_r5.json."""
-    r = _headline_harness(64, (4, 4, 4)).run()
+    _, h = _headline_harness(64, (4, 4, 4))
+    r = h.run()
     assert r.completed == 64
     assert r.failed == 0, r                       # preemption kills no job
     assert r.steady_state_utilization >= 0.96, r  # measured 0.9689
@@ -122,21 +123,25 @@ def test_bench_scenario_meets_targets():
     assert r.attainable_utilization >= 0.96, r
 
 
-def _headline_harness(num_jobs: int, torus_dims: tuple):
-    """The bench.py headline configuration (knee knobs + config-5 spot
-    dip) at a given scale — ONE definition shared by the 64- and
-    128-chip guards so a future knee re-tune moves both."""
+def _headline_harness(num_jobs: int, torus_dims: tuple,
+                      algorithm: str = "ElasticTiresias",
+                      failure_fraction: float = 0.0):
+    """The bench.py headline configuration (explicitly pinned knee knobs
+    + config-5 spot dip) at a given scale — ONE definition shared by
+    every guard in this file so a future knee re-tune moves them all."""
     from vodascheduler_tpu.placement import PoolTopology
     from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
     from vodascheduler_tpu.replay.simulator import config5_preemptions
 
     trace = philly_like_trace(num_jobs=num_jobs, seed=20260729,
-                              max_job_chips=64)
+                              max_job_chips=64,
+                              failure_fraction=failure_fraction)
     topo = PoolTopology(torus_dims=torus_dims, host_block=(2, 2, 1))
-    return ReplayHarness(trace, algorithm="ElasticTiresias", topology=topo,
-                         rate_limit_seconds=30.0, scale_out_hysteresis=1.5,
-                         resize_cooldown_seconds=300.0,
-                         preemptions=config5_preemptions(topo))
+    return trace, ReplayHarness(
+        trace, algorithm=algorithm, topology=topo,
+        rate_limit_seconds=30.0, scale_out_hysteresis=1.5,
+        resize_cooldown_seconds=300.0,
+        preemptions=config5_preemptions(topo))
 
 
 def test_v5p128_scale_replay():
@@ -147,7 +152,8 @@ def test_v5p128_scale_replay():
     p95 17,055 s. The steady-state window is only ~27% of makespan at
     this scale (the heavy tail drains long after arrivals stop), so no
     ss_frac assertion here — the 64-job guard carries it."""
-    r = _headline_harness(128, (4, 4, 8)).run()
+    _, h = _headline_harness(128, (4, 4, 8))
+    r = h.run()
     assert r.completed == 128
     assert r.failed == 0, r
     assert r.steady_state_utilization >= 0.94, r
@@ -168,3 +174,23 @@ def test_algorithm_compare_runs_all_registered():
     assert [r["algorithm"] for r in rows] == ["FIFO", "ElasticTiresias"]
     assert all(r["completed"] == 8 and r["failed"] == 0 for r in rows)
     assert all(r["avg_jct_s"] > 0 for r in rows)
+
+
+@pytest.mark.slow
+def test_failure_matrix_exact_accounting_all_algorithms():
+    """20% injected crashes + the spot dip, replayed under every
+    registered algorithm at the headline configuration: each must
+    account exactly (completed + failed == num_jobs, failed == the
+    injected count) — a lost or double-counted job under ANY policy is
+    a control-plane bug, not a policy difference. Full table in
+    doc/benchmarks.md."""
+    from vodascheduler_tpu.algorithms import ALGORITHM_NAMES
+
+    for algo in ALGORITHM_NAMES:
+        trace, h = _headline_harness(64, (4, 4, 4), algorithm=algo,
+                                     failure_fraction=0.2)
+        injected = sum(1 for t in trace if t.fail_at_epoch is not None)
+        assert injected > 0
+        r = h.run()
+        assert r.completed == 64 - injected, (algo, r)
+        assert r.failed == injected, (algo, r)

@@ -88,6 +88,13 @@ def philly_like_trace(
     from vodascheduler_tpu.replay.restart_costs import family_restart_costs
 
     rng = random.Random(seed)
+    # Failure marks ride their OWN stream: failure_fraction must compose
+    # with the base trace (same arrivals/families/sizes, only fail_at
+    # added) so a failure-injection run is comparable to the headline
+    # run on the same seed — drawing from `rng` would shift every
+    # subsequent sample and generate a different workload.
+    fail_rng = random.Random(f"{seed}-fail")  # str-seeded: deterministic
+    # across processes (tuple seeds hash with the salted str hash)
     names = list(MODEL_FAMILIES)
     weights = [float(MODEL_FAMILIES[m]["weight"]) for m in names]
     restart_costs = family_restart_costs()
@@ -110,7 +117,7 @@ def philly_like_trace(
         epochs = max(1, int(round(float(fam["epochs_base"]) * duration_scale)))
 
         fail_at = None
-        if failure_fraction > 0 and rng.random() < failure_fraction:
+        if failure_fraction > 0 and fail_rng.random() < failure_fraction:
             fail_at = max(1, epochs // 2)
 
         jobs.append(TraceJob(
