@@ -9,10 +9,26 @@
 #   doc/e2e_tpu_r5.json            scheduler-driven run on the chip
 #   doc/benchmarks_last_good.json  hardware tables (bench.py writes it)
 #   doc/benchmarks_r5_raw.json     the full bench.py line, captured
+#   doc/resize_measured.json       measured restart costs (replay pricing)
 #
 # Refuses to stamp evidence from a TPU-less host: the e2e test must have
 # RUN (not skipped), and the bench hardware section must be live (no
 # cached_from/error markers).
+#
+# AFTER a successful capture (the measured-resize -> replay loop):
+#   1. Commit doc/resize_measured.json — replay/restart_costs.py now
+#      derives per-family restart pricing from it (provenance switches
+#      from "assumed" to "scaled:..." automatically).
+#   2. Re-run `python scripts/replay_sweep.py all` — measured costs can
+#      move the knee; if it moved, update config.py knob defaults, the
+#      guard values in tests/test_replay.py, BASELINE.md and
+#      doc/benchmarks.md ("r5 re-base" section conventions).
+#   3. Re-derive the p95 floor analysis (doc/benchmarks.md "JCT tail on
+#      the true workload") with the re-swept numbers.
+#   4. Mark the libtpu series in doc/prometheus-metrics-exposed.md
+#      "verified live" (stage 1b below proved the metric names).
+#   5. If llama_350m B=16 beat the B=8 bar, note the new flagship batch
+#      in BASELINE.md "Measured hardware bars".
 set -x
 
 # 1. Control plane driving the real chip end-to-end. -rA makes the
