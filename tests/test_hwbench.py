@@ -110,9 +110,7 @@ def test_stream_main_emits_parseable_lines():
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert res.returncode == 0, res.stderr[-500:]
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from bench import parse_hw_stream
+    parse_hw_stream = _bench_module().parse_hw_stream
     out = parse_hw_stream(res.stdout)
     assert out["models"][0]["model"] == "llama_tiny"
     assert out["attention"][0]["flash_ms"] > 0
@@ -125,19 +123,18 @@ def test_stream_main_emits_parseable_lines():
     assert partial["models"][0]["model"] == "llama_tiny"
 
 
-def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
-    """The wedge scenario end-to-end: the hwbench child flushes points,
-    then hangs past the deadline; maybe_hardware must kill it and keep
-    every flushed point (Popen + post-kill drain — subprocess.run()
-    discards the pipe on POSIX timeouts)."""
+def _bench_module():
     import sys
-    import textwrap
-
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import bench
+    return bench
 
-    # Stand in for the hwbench module: emit two points, then wedge.
+
+def _install_fake_hwbench(tmp_path, tail: str) -> None:
+    """Stand in for the hwbench module under tmp_path: emit two points,
+    then run `tail` (the scenario under test)."""
+    import textwrap
     fake_pkg = tmp_path / "vodascheduler_tpu" / "runtime"
     fake_pkg.mkdir(parents=True)
     (tmp_path / "vodascheduler_tpu" / "__init__.py").write_text("")
@@ -148,22 +145,62 @@ def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
               flush=True)
         print(json.dumps({"kind": "model", "data": {"model": "m1",
               "step_time_ms": 1.0}}), flush=True)
-        time.sleep(600)  # the wedge
-    """))
+    """) + textwrap.dedent(tail))
+
+
+def _watchdog_env(monkeypatch, timeout: str, stall: str) -> None:
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
-    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "5")
+    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", timeout)
+    monkeypatch.setenv("VODA_BENCH_HW_STALL_TIMEOUT", stall)
     monkeypatch.setenv("VODA_BENCH_HW_PROBE_TIMEOUT", "120")
-    # Point the child's import root at the fake package tree.
-    monkeypatch.setattr(bench.os.path, "dirname",
-                        lambda p, _real=os.path.dirname: str(tmp_path)
-                        if p == os.path.abspath(bench.__file__)
-                        else _real(p))
+
+
+def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
+    """The wedge scenario end-to-end: the hwbench child flushes points,
+    then hangs; maybe_hardware must kill it and keep every flushed point
+    (Popen + post-kill drain — subprocess.run() discards the pipe on
+    POSIX timeouts). Killed via the STALL watchdog with a 12s window:
+    the stall clock does still run during child startup (last_line is
+    initialized at Popen), so this is a margin bump, not immunity — the
+    original 5s hard deadline flaked when slow startup under host load
+    (a concurrent chip-attached capture) ate the whole budget before
+    the two points landed; 12s of pure startup is far past anything
+    observed."""
+    bench = _bench_module()
+    _install_fake_hwbench(tmp_path, "time.sleep(600)  # the wedge\n")
+    _watchdog_env(monkeypatch, timeout="300", stall="12")
+    _redirect_repo_dir(monkeypatch, bench, tmp_path)
     out = bench.maybe_hardware()
     assert out is not None
     assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
     assert out["backend"] == "fake"
-    assert "exceeded" in out.get("error", ""), out
+    # Specifically the STALL watchdog's message — the hard-deadline
+    # branch has its own test below.
+    assert "stalled" in out.get("error", ""), out
+
+
+def test_hard_deadline_kills_still_streaming_child(tmp_path, monkeypatch):
+    """The other watchdog: a child that never stalls (keeps flushing
+    heartbeat lines) but runs past VODA_BENCH_HW_TIMEOUT must be killed
+    by the hard deadline, keeping completed points. The 0.25s heartbeats
+    pin the stall clock, so only the hard-deadline branch can fire — and
+    the 15s deadline leaves 3× the startup margin that flaked at 5s."""
+    bench = _bench_module()
+    _install_fake_hwbench(tmp_path, """
+        while True:  # never stalls, never finishes
+            print(json.dumps({"kind": "tick", "data": {}}), flush=True)
+            time.sleep(0.25)
+    """)
+    _watchdog_env(monkeypatch, timeout="15", stall="300")
+    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    out = bench.maybe_hardware()
+    assert out is not None
+    assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
+    assert out["backend"] == "fake"
+    err = out.get("error", "")
+    assert "exceeded 15s" in err and "killed" in err, out
+    assert "stalled" not in err, out
 
 
 def _redirect_repo_dir(monkeypatch, bench, tmp_path):
@@ -179,12 +216,8 @@ def test_dead_tunnel_falls_back_to_cached_results(tmp_path, monkeypatch):
     failure mode), maybe_hardware must emit the last-good cached results
     tagged cached_from, not a bare error marker."""
     import json
-    import sys
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
+    bench = _bench_module()
     cached = {"backend": "tpu", "device_kind": "TPU v5 lite",
               "models": [{"model": "llama_350m", "mfu": 0.38}],
               "attention": []}
@@ -202,12 +235,7 @@ def test_dead_tunnel_falls_back_to_cached_results(tmp_path, monkeypatch):
 
 
 def test_dead_tunnel_without_cache_reports_error(tmp_path, monkeypatch):
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
+    bench = _bench_module()
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda repo_dir: (None, "probe died"))
     _redirect_repo_dir(monkeypatch, bench, tmp_path)
@@ -218,14 +246,10 @@ def test_dead_tunnel_without_cache_reports_error(tmp_path, monkeypatch):
 def test_probe_retries_then_succeeds(monkeypatch, tmp_path):
     """_probe_backend must retry past transient flakes with backoff."""
     import subprocess
-    import sys
     import time
     import types
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
+    bench = _bench_module()
     calls = {"n": 0}
     sleeps = []
 
@@ -247,24 +271,9 @@ def test_successful_run_writes_last_good_cache(tmp_path, monkeypatch):
     """A clean hardware run must refresh doc/benchmarks_last_good.json so
     the NEXT flaked round has something to fall back on."""
     import json
-    import sys
-    import textwrap
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
-    fake_pkg = tmp_path / "vodascheduler_tpu" / "runtime"
-    fake_pkg.mkdir(parents=True)
-    (tmp_path / "vodascheduler_tpu" / "__init__.py").write_text("")
-    (fake_pkg / "__init__.py").write_text("")
-    (fake_pkg / "hwbench.py").write_text(textwrap.dedent("""
-        import json
-        print(json.dumps({"kind": "meta", "data": {"backend": "fake"}}),
-              flush=True)
-        print(json.dumps({"kind": "model", "data": {"model": "m1",
-              "step_time_ms": 1.0}}), flush=True)
-    """))
+    bench = _bench_module()
+    _install_fake_hwbench(tmp_path, "")  # clean exit after the points
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
     monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "60")
