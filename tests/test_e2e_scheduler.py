@@ -88,9 +88,29 @@ def test_e2e_scheduler_real_tpu(tmp_path):
     # llama_350m_text: the scheduler-driven run trains on REAL prose
     # (data/real.py), so the artifact also demonstrates real-data
     # training under preemption on the chip.
+    #
+    # Timing calibration (measured in the r5 session's first attempt,
+    # which timed out): over the remote-chip tunnel every checkpoint
+    # save/restore moves ~4.2 GB of AdamW state at tunnel bandwidth
+    # (~300 s per copy). So: stop grace must cover a preemption save
+    # (default 120 s SIGKILLed every save → jobs thrashed from scratch);
+    # the queue-0 threshold/lease must cover warmup + an epoch + the
+    # final-save drain or no stint can ever complete (default 150 s
+    # rotated three jobs forever); and the total deadline gets 5400 s
+    # instead of 2400 s.
+    # --epochs-a 8: at the measured ~190 s/epoch (compute + deduped
+    # per-epoch save), the 600 s demotion lands around epoch 3 — far
+    # enough from the end that job A resumes with epochs still to run,
+    # which is what produces the before/after loss-continuity pairs (the
+    # 4-epoch default got preempted after its last step: restart
+    # evidence, but zero pairs).
+    env["VODA_STOP_GRACE_SECONDS"] = "900"
     r = _run(env, ["--model", "llama_350m_text",
                    "--workdir", os.fspath(tmp_path / "wd"),
-                   "--out", out], timeout=2600)
+                   "--queue0-threshold", "600",
+                   "--epochs-a", "8",
+                   "--timeout", "5400",
+                   "--out", out], timeout=5800)
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-800:])
     art = json.loads(open(out).read())
     assert [v["status"] for v in art["jobs"].values()] == ["Completed"] * 3

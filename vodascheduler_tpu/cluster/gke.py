@@ -54,6 +54,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Protocol, Tuple
 
+from vodascheduler_tpu import config
 from vodascheduler_tpu.cluster.backend import (
     ClusterBackend,
     ClusterEvent,
@@ -231,7 +232,7 @@ class GkeBackend(ClusterBackend):
     def __init__(self, kube: KubeApi,
                  namespace: str = DEFAULT_NAMESPACE,
                  pod_template: Optional[Dict[str, Any]] = None,
-                 stop_grace_seconds: int = 120,
+                 stop_grace_seconds: Optional[int] = None,
                  poll_interval_seconds: float = 2.0,
                  image: Optional[str] = None,
                  topology: Optional[Any] = None,
@@ -240,7 +241,9 @@ class GkeBackend(ClusterBackend):
         self.kube = kube
         self.namespace = namespace
         self.pod_template = pod_template or _default_pod_template()
-        self.stop_grace_seconds = stop_grace_seconds
+        # int: the k8s gracePeriodSeconds query parameter is integral.
+        self.stop_grace_seconds = int(
+            config.stop_grace_seconds(stop_grace_seconds))
         self.poll_interval_seconds = poll_interval_seconds
         self.image = image
         # Pool topology (PoolTopology) injected as VODA_TOPOLOGY in every

@@ -34,6 +34,7 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEventKind,
     JobHandle,
 )
+from vodascheduler_tpu import config
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
 
@@ -50,7 +51,7 @@ class LocalBackend(ClusterBackend):
                  hermetic_devices: Optional[int] = None,
                  metrics_dir: Optional[str] = None,
                  host_name: str = "localhost",
-                 stop_grace_seconds: float = 120.0,
+                 stop_grace_seconds: Optional[float] = None,
                  poll_interval_seconds: float = 0.2,
                  topology: Optional[object] = None):
         self.workdir = os.path.abspath(workdir)
@@ -61,7 +62,8 @@ class LocalBackend(ClusterBackend):
         # supervisor via VODA_TOPOLOGY so plan_mesh keeps tp intra-host on
         # this pool's real host block (VERDICT r2 item 5).
         self.topology = topology
-        self.stop_grace_seconds = stop_grace_seconds
+        self.stop_grace_seconds = config.stop_grace_seconds(
+            stop_grace_seconds)
         self.poll_interval_seconds = poll_interval_seconds
         if chips is None:
             chips = hermetic_devices or self._detect_chips()

@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from vodascheduler_tpu import config
 from vodascheduler_tpu.cluster.backend import (
     ClusterBackend,
     ClusterEvent,
@@ -76,7 +77,7 @@ class MultiHostBackend(ClusterBackend):
                  hosts: Optional[Dict[str, int]] = None,
                  num_hosts: int = 2, chips_per_host: int = 4,
                  metrics_dir: Optional[str] = None,
-                 stop_grace_seconds: float = 120.0,
+                 stop_grace_seconds: Optional[float] = None,
                  poll_interval_seconds: float = 0.2,
                  topology: Optional[object] = None):
         self.workdir = os.path.abspath(workdir)
@@ -86,7 +87,8 @@ class MultiHostBackend(ClusterBackend):
         # Pool topology forwarded to supervisors as VODA_TOPOLOGY (mesh
         # planning keeps tp within this pool's host block).
         self.topology = topology
-        self.stop_grace_seconds = stop_grace_seconds
+        self.stop_grace_seconds = config.stop_grace_seconds(
+            stop_grace_seconds)
         self.poll_interval_seconds = poll_interval_seconds
         os.makedirs(self.workdir, exist_ok=True)
         os.makedirs(self.metrics_dir, exist_ok=True)

@@ -47,3 +47,20 @@ def _env_float(name: str, default: str) -> float:
 # re-tuning on their own workload.
 SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.5")
 RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "300")
+
+# How long a preempted worker gets between SIGTERM and SIGKILL — it must
+# cover a full synchronous checkpoint save (the SIGTERM→save→PREEMPTED
+# protocol, runtime/supervisor.py) at the deployment's real storage
+# bandwidth, or every preemption silently loses the job's progress. The
+# k8s analog is terminationGracePeriodSeconds. Default matches the old
+# hardcoded backend defaults; measured r5: a remote-chip tunnel moving
+# llama_350m's ~4.2 GB AdamW state needs ~300 s, i.e. this MUST be
+# raised on tunnel-attached or slow-NFS deployments.
+STOP_GRACE_SECONDS = _env_float("VODA_STOP_GRACE_SECONDS", "120")
+
+
+def stop_grace_seconds(override=None) -> float:
+    """The effective SIGTERM→SIGKILL grace: a backend's explicit ctor
+    argument wins; None falls back to the env-configurable default. One
+    resolution point shared by every backend."""
+    return STOP_GRACE_SECONDS if override is None else float(override)
