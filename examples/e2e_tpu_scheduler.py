@@ -221,9 +221,13 @@ def main(argv=None) -> int:
             "note": ("Scheduler-driven end-to-end run on real hardware: "
                      "VodaApp (admission+scheduler+allocator+collector, "
                      "REST) + LocalBackend supervisor subprocesses. "
-                     "queue-0 threshold shortened to "
-                     f"{args.queue0_threshold}s for demo pacing; all "
-                     "other knobs production defaults."),
+                     "Demo-pacing knobs (all others production "
+                     f"defaults): queue-0 threshold {args.queue0_threshold}s, "
+                     f"epochs {args.epochs_a}/{args.epochs_bc} x "
+                     f"{args.steps_per_epoch} steps, deadline "
+                     f"{args.timeout:.0f}s, stop grace "
+                     f"{os.environ.get('VODA_STOP_GRACE_SECONDS', '120')}s "
+                     "(calibrated to measured checkpoint bandwidth)."),
             "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime()),
             "model": args.model,

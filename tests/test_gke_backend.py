@@ -146,6 +146,10 @@ class TestPodCreation:
         env = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
         assert "VODA_COORDINATOR_ADDRESS" not in env
         assert not kube.services  # no coordinator for single-host
+        # Kubelet-initiated terminations must leave time for the
+        # preemption checkpoint save (config.stop_grace_seconds).
+        assert (pod["spec"]["terminationGracePeriodSeconds"]
+                == backend.stop_grace_seconds)
 
     def test_multi_host_job_has_coordinator(self, world):
         kube, backend, _ = world

@@ -503,6 +503,12 @@ class GkeBackend(ClusterBackend):
             podspec = manifest["spec"]
             podspec["nodeName"] = host      # placement manager's binding
             podspec.pop("nodeSelector", None)  # nodeName supersedes it
+            # Kubelet-initiated terminations (drain, eviction) honor the
+            # pod spec, not our delete call's gracePeriodSeconds — both
+            # must cover a preemption checkpoint save at real storage
+            # bandwidth (config.stop_grace_seconds; measured ~300s for
+            # llama_350m over slow transports).
+            podspec["terminationGracePeriodSeconds"] = self.stop_grace_seconds
             container = podspec["containers"][0]
             if self.image:
                 container["image"] = self.image

@@ -326,13 +326,15 @@ class TrainSession:
             # fall-through save is a COLLECTIVE): only the coordinator
             # reads the filesystem — its rename is what commits a save,
             # and other hosts' NFS metadata caches may lag it — and all
-            # processes follow its verdict.
-            committed = latest_step(ckpt_dir) == key[1]
+            # processes follow its broadcast verdict.
             if jax.process_count() > 1:
                 import numpy as np
                 from jax.experimental import multihost_utils
                 committed = bool(multihost_utils.broadcast_one_to_all(
-                    np.asarray(committed)))
+                    np.asarray(jax.process_index() == 0
+                               and latest_step(ckpt_dir) == key[1])))
+            else:
+                committed = latest_step(ckpt_dir) == key[1]
             if committed:
                 return key[1]
             # The drained save never committed — fall through and save.
