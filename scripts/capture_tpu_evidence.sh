@@ -42,11 +42,18 @@ grep -q "PASSED" /tmp/e2e_tpu_pytest.out || {
 
 # 1b. Live libtpu telemetry: SDK metric names verified against this
 #     image's libtpu build while real training steps run (VERDICT r4
-#     item 4). After it passes, mark the series list in
+#     item 4). Over a remote-chip transport the monitoring data plane
+#     is absent (chip-local API) — the test then verifies the NAMES and
+#     skips the liveness half with that reason, which must not abort
+#     the capture (stage 1 already proved the chip is real). After a
+#     full PASS on a chip-local host, mark the series list in
 #     doc/prometheus-metrics-exposed.md "verified live".
 python -m pytest tests/test_tpu_telemetry.py -q -rA -m "tpu" \
     | tee /tmp/telemetry_tpu_pytest.out
-grep -q "PASSED" /tmp/telemetry_tpu_pytest.out || {
+# Anchored to the -rA short-summary lines (column 0): a FAILED run's
+# traceback may quote the skip-reason string from the test source, and
+# an unanchored match would let it through.
+grep -Eq "^PASSED|^SKIPPED.*data plane absent" /tmp/telemetry_tpu_pytest.out || {
     echo "live telemetry test did not PASS — not capturing"
     exit 1
 }

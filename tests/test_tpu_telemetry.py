@@ -1,9 +1,18 @@
-"""Live libtpu telemetry (VERDICT r4 item 4): the SDK metric names in
-runtime/tpu_monitor.py are verified against the actual libtpu build by
-sampling TpuMonitor DURING real training steps on the chip and asserting
-the duty-cycle / tensorcore gauges export nonzero values. The hermetic
-mock test (test_metricscollector.py) proves the wiring; only this proves
-the names.
+"""Live libtpu telemetry (VERDICT r4 item 4), in two verifiable halves:
+
+1. NAME rot guard — every SDK metric name runtime/tpu_monitor.py reads
+   must be in this libtpu build's list_supported_metrics(). Always
+   asserted when a TPU backend is reachable.
+2. LIVENESS — sampling TpuMonitor during real training steps must export
+   nonzero duty-cycle/tensorcore gauges. This half needs the libtpu
+   monitoring DATA plane, which is chip-local: over a remote-chip
+   transport (the axon tunnel) every get_metric(...).data() returns []
+   (measured r5 — even static hbm_capacity_total; device.memory_stats()
+   is likewise None), so the child detects that and the test skips with
+   the transport reason rather than failing on an environment limit.
+
+The hermetic mock test (test_metricscollector.py) proves the wiring;
+this proves the names, and — on a chip-local host — the values.
 
 Runs in a subprocess with the ambient (non-cpu) platform because the
 conftest pins in-process jax to the CPU mesh.
@@ -19,20 +28,37 @@ from tests.test_e2e_scheduler import _tpu_reachable
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Exit code the child uses for "names verified, but this transport has
+# no monitoring data plane" — the test maps it to a skip.
+NO_DATA_PLANE_EXIT = 42
+
 _CHILD = """
+import sys
+
 import jax
 assert jax.default_backend() == "tpu", jax.default_backend()
 
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.models import get_model
-from vodascheduler_tpu.runtime.tpu_monitor import TpuMonitor
+from vodascheduler_tpu.runtime.tpu_monitor import _SDK_SERIES, TpuMonitor
 from vodascheduler_tpu.runtime.train import TrainSession
 
-try:
-    from libtpu import sdk
-    print("supported:", sorted(sdk.tpumonitoring.list_supported_metrics()))
-except Exception as e:
-    print("sdk probe failed:", e)
+from libtpu import sdk  # the image must ship the SDK; absence is a FAIL
+
+supported = set(sdk.tpumonitoring.list_supported_metrics())
+print("supported:", sorted(supported))
+# Half 1, the rot guard: every name the monitor reads must resolve on
+# THIS libtpu build.
+missing = [name for name, _, _ in _SDK_SERIES if name not in supported]
+assert not missing, f"tpu_monitor reads unsupported metrics: {missing}"
+print("NAMES_VERIFIED", sorted(name for name, _, _ in _SDK_SERIES))
+
+# Data-plane probe: hbm_capacity_total is static — a chip-local host
+# reports it even when idle. Empty means the monitoring data plane is
+# not attached (remote-chip transport); the liveness half cannot run.
+if not sdk.tpumonitoring.get_metric("hbm_capacity_total").data():
+    print("NO_DATA_PLANE: get_metric('hbm_capacity_total').data() == []")
+    sys.exit(%d)
 
 reg = Registry()
 mon = TpuMonitor(reg)
@@ -53,15 +79,12 @@ for _ in range(3):
     tc.append(sample["tensorcore_util"])
     print("gauge sample:", sample)
 
-# Gauge.value returns 0.0 for an absent series, so nonzero here proves
-# both halves at once: the SDK metric NAME resolves on this libtpu
-# build, and the value is live during real training.
 assert max(duty) > 0.0, f"duty_cycle_pct never nonzero: {duty}"
 assert max(tc) > 0.0, f"tensorcore_util never nonzero: {tc}"
 # Memory gauges export for the real device too.
 assert mon.m_devices.value() >= 1.0
 print("LIVE_TELEMETRY_OK max_duty", max(duty), "max_tc", max(tc))
-"""
+""" % NO_DATA_PLANE_EXIT
 
 
 @pytest.mark.tpu
@@ -73,5 +96,13 @@ def test_live_libtpu_telemetry_nonzero():
     r = subprocess.run([sys.executable, "-c", _CHILD], capture_output=True,
                        text=True, timeout=900, env=env, cwd=REPO)
     sys.stdout.write(r.stdout[-2000:])
+    if r.returncode == NO_DATA_PLANE_EXIT:
+        # Names verified (the child asserts them before this exit); only
+        # the liveness half is unavailable here.
+        assert "NAMES_VERIFIED" in r.stdout
+        pytest.skip("libtpu monitoring data plane absent on this "
+                    "transport (chip-local API; remote-chip tunnel) — "
+                    "metric names verified, liveness needs a chip-local "
+                    "host")
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1500:])
     assert "LIVE_TELEMETRY_OK" in r.stdout
