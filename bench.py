@@ -172,6 +172,14 @@ def read_last_good(repo_dir: str):
 
 def write_last_good(repo_dir: str, hardware: dict) -> None:
     import time
+    # Per-row failures must not become fallback "evidence": a cached
+    # error row would replay a known-stale failure as the round's
+    # hardware result on every tunnel flake (r5: a pre-fix llama_1b OOM
+    # row was cached this way). The live line keeps the error rows; the
+    # cache keeps only measured points.
+    hardware = dict(hardware)
+    hardware["models"] = [m for m in hardware.get("models", [])
+                          if "error" not in m]
     payload = {
         "note": ("Last successful hardware-bench capture; bench.py emits "
                  "this (tagged cached_from) when the accelerator tunnel is "

@@ -90,6 +90,19 @@ def test_two_point_differencing_cancels_overhead():
     assert abs(s - 0.25) < 1e-9
 
 
+def test_every_bench_point_has_flops_structure():
+    """Config-rot guard: every model bench.py ships to the chip must
+    have an analytic-FLOPs structure entry — in r5 a missing llama_1b
+    entry burned the chip slot and surfaced as an unrelated XLA OOM
+    from the retry path."""
+    from vodascheduler_tpu.runtime.hwbench import _lm_structure
+
+    bench = _bench_module()
+    for model_name, _ in bench.HW_MODEL_POINTS:
+        n_layers, d_model = _lm_structure(model_name)
+        assert n_layers > 0 and d_model > 0, model_name
+
+
 @pytest.mark.slow
 def test_stream_main_emits_parseable_lines():
     """hwbench --stream (the subprocess mode bench.py drives) emits one

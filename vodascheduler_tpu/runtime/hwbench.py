@@ -145,6 +145,7 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
     from vodascheduler_tpu.models import bert, llama, mixtral, vit
     table = {
         "llama3_8b": (llama.LLAMA3_8B.num_layers, llama.LLAMA3_8B.dim),
+        "llama_1b": (llama.LLAMA_1B.num_layers, llama.LLAMA_1B.dim),
         "llama_350m": (llama.LLAMA_350M.num_layers, llama.LLAMA_350M.dim),
         "llama_350m_8k": (llama.LLAMA_350M_8K.num_layers,
                           llama.LLAMA_350M_8K.dim),
@@ -391,9 +392,15 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
                                f"({type(e).__name__}: {e}); XLA attention")
                 out["models"].append(res)
             except Exception as e2:  # noqa: BLE001
+                # Both paths failed: keep BOTH errors (truncated — an
+                # XLA OOM str() is a multi-KB compile log) — the retry's
+                # OOM can otherwise mask a trivial flash-path bug (r5: a
+                # missing _lm_structure entry surfaced as an XLA OOM).
                 out["models"].append({
                     "model": model_name, "batch": bsz,
-                    "error": f"{type(e2).__name__}: {e2}"})
+                    "error": f"{type(e2).__name__}: {str(e2)[:300]}",
+                    "flash_path_error": f"{type(e).__name__}: "
+                                        f"{str(e)[:300]}"})
             finally:
                 os.environ.pop("VODA_FLASH_ATTENTION", None)
         emit("model", out["models"][-1])
