@@ -180,6 +180,16 @@ def write_last_good(repo_dir: str, hardware: dict) -> None:
     hardware = dict(hardware)
     hardware["models"] = [m for m in hardware.get("models", [])
                           if "error" not in m]
+    hardware["attention"] = [a for a in hardware.get("attention", [])
+                             if "error" not in a]
+    if "error" in (hardware.get("moe") or {}):
+        hardware.pop("moe", None)
+    hardware["resize"] = [r for r in hardware.get("resize", [])
+                          if "error" not in r]
+    if not hardware["models"]:
+        # Every model point errored per-row: overwriting the cache would
+        # destroy previously measured fallback data with an empty list.
+        return
     payload = {
         "note": ("Last successful hardware-bench capture; bench.py emits "
                  "this (tagged cached_from) when the accelerator tunnel is "

@@ -299,3 +299,34 @@ def test_successful_run_writes_last_good_cache(tmp_path, monkeypatch):
     assert cache["hardware"]["models"] == [{"model": "m1",
                                             "step_time_ms": 1.0}]
     assert cache["captured_at"]
+
+
+def test_cache_write_drops_error_rows_and_keeps_prior_on_empty(tmp_path):
+    """Per-row failures in ANY section (models/attention/moe/resize) must
+    not become fallback evidence, and a run where every model point
+    errored must not clobber a previously good cache with models: []."""
+    import json
+
+    bench = _bench_module()
+    good = {"models": [{"model": "m1", "mfu": 0.4},
+                       {"model": "m2", "error": "OOM"}],
+            "attention": [{"batch": 8, "seq": 1024, "flash_ms": 1.0},
+                          {"batch": 1, "seq": 8192, "error": "boom"}],
+            "moe": {"error": "RESOURCE_EXHAUSTED: " + "x" * 50},
+            "resize": [{"model": "m1", "resize_cost_seconds": 9.0},
+                       {"model": "m2", "error": "died"}]}
+    bench.write_last_good(str(tmp_path), good)
+    cache = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    hw = cache["hardware"]
+    assert hw["models"] == [{"model": "m1", "mfu": 0.4}]
+    assert hw["attention"] == [{"batch": 8, "seq": 1024, "flash_ms": 1.0}]
+    assert "moe" not in hw
+    assert hw["resize"] == [{"model": "m1", "resize_cost_seconds": 9.0}]
+
+    all_bad = {"models": [{"model": "m1", "error": "regression"}],
+               "attention": [{"batch": 8, "seq": 1024, "flash_ms": 2.0}]}
+    bench.write_last_good(str(tmp_path), all_bad)
+    cache2 = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    assert cache2["hardware"]["models"] == [{"model": "m1", "mfu": 0.4}]

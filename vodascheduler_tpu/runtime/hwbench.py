@@ -389,7 +389,8 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
             try:
                 res = bench_model_step(model_name, bsz).as_dict()
                 res["note"] = (f"flash path failed "
-                               f"({type(e).__name__}: {e}); XLA attention")
+                               f"({type(e).__name__}: {str(e)[:300]}); "
+                               f"XLA attention")
                 out["models"].append(res)
             except Exception as e2:  # noqa: BLE001
                 # Both paths failed: keep BOTH errors (truncated — an
