@@ -12,17 +12,21 @@ metrics-feedback loop) is the production code path; only the cluster and
 clock are simulated, so the replay number reflects real scheduling
 behavior. The hardware section is never simulated.
 
-Knob choice (rate_limit=30s, scale_out_hysteresis=1.5, resize_cooldown=300s)
+Knob choice (rate_limit=15s, scale_out_hysteresis=1.0, resize_cooldown=60s)
 is the knee of the r5 rate x hysteresis x cooldown sweep
-(scripts/replay_sweep.py, doc/replay_sweep_r5.json) — the first sweep run
-on the TRUE workload: r5 fixed a profile-registration race that had let
-29/64 trace jobs simulate the default 60 s-epoch toy profile, so every
-earlier sweep (and r1-r4's headline numbers) ran a far lighter trace than
-intended. On the honest heavy-tailed workload the knee gives 0.9689
-steady-state utilization / avg JCT 9,337 s / p95 17,530 s on the pinned
-seed, and >= 0.95 utilization on all 8 panel seeds. BASELINE.json's
-metric is "avg JCT + cluster util"; the sweep maximizes util with an
-avg+p95 tiebreak within 1% of the best util.
+(scripts/replay_sweep.py, doc/replay_sweep_r5.json) re-derived under
+MEASURED restart pricing (doc/resize_measured.json, captured on-chip by
+runtime/resize_bench.py): restarts cost 97-513 s per family — not the
+10-60 s assumed through r4 — and at those prices the sweep favors
+reacting fast, because idle chips cost more than the restarts that fill
+them. This is also the first sweep on the TRUE workload: r5 fixed a
+profile-registration race that had let 29/64 trace jobs simulate the
+default 60 s-epoch toy profile. On the honest heavy-tailed workload with
+measured pricing the knee gives 0.8804 steady-state utilization /
+avg JCT 8,690 s / p95 19,318 s on the pinned seed, and >= 0.88
+utilization on all 8 panel seeds. BASELINE.json's metric is "avg JCT +
+cluster util"; the sweep maximizes util with an avg+p95 tiebreak within
+1% of the best util.
 """
 
 import json
@@ -32,17 +36,18 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TARGET_UTILIZATION = 0.85  # BASELINE.json north star
-# First honest-workload measurement (r5 knee, pinned seed) — the JCT
-# regression reference. Earlier rounds' 3195 s target was measured on
-# the corrupted-trace replay and is not comparable.
-JCT_TARGET_SECONDS = 9340.0
+# First measurement at measured restart pricing (r5 knee, pinned seed) —
+# the JCT regression reference. The earlier 9,340 s target was measured
+# at assumed 10-60 s restart costs; 3195 s before that was on the
+# corrupted-trace replay. Neither is comparable.
+JCT_TARGET_SECONDS = 8690.0
 # The r5 sweep knee (see module docstring); used by the run AND the
-# report. Hysteresis/cooldown come from config — the single source the
+# report. All three knobs come from config — the single source the
 # production Scheduler defaults also read — so the bench always measures
 # the shipped policy.
 from vodascheduler_tpu import config as _config  # noqa: E402
 
-RATE_LIMIT_SECONDS = 30.0
+RATE_LIMIT_SECONDS = _config.RATE_LIMIT_SECONDS
 SCALE_OUT_HYSTERESIS = _config.SCALE_OUT_HYSTERESIS
 RESIZE_COOLDOWN_SECONDS = _config.RESIZE_COOLDOWN_SECONDS
 

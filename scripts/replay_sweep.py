@@ -84,7 +84,7 @@ def panel(rate: float, hyst: float, cooldown: float) -> list:
 
 # The shipped headline configuration (bench.py) — the panel's knobs when
 # run standalone, and _best's fallback when no sweep cell qualifies.
-SHIPPED_KNEE = dict(rate=30.0, hyst=1.5, cooldown=300.0)
+SHIPPED_KNEE = dict(rate=15.0, hyst=1.0, cooldown=60.0)
 
 
 def _write(out: dict) -> None:

@@ -103,24 +103,28 @@ class TestBenchScript:
 
 def test_bench_scenario_meets_targets():
     """Regression guard for the headline bench (bench.py): the r5 knee
-    knobs (rate 30s / hysteresis 1.5 / cooldown 300s) with the headline
-    spot-preemption schedule must clear BOTH halves of the BASELINE
-    metric. Guard values are the first HONEST-workload measurements:
-    r5's profile-registration race fix (simulator._submit) revealed
-    29/64 trace jobs had been simulating the default 60 s-epoch toy
-    profile, so r1-r4 guard values (avg 3195 s, p95 10.5 ks...) are not
-    comparable — the true heavy-tailed trace is ~3.4x heavier. Sweep
-    provenance: scripts/replay_sweep.py, doc/replay_sweep_r5.json."""
+    knobs (rate 15s / hysteresis 1.0 / cooldown 60s, config.py) with the
+    headline spot-preemption schedule must clear BOTH halves of the
+    BASELINE metric. Guard values are the first measurements with
+    restarts priced at their MEASURED cost (doc/resize_measured.json:
+    97-513 s per family, not the 10-60 s assumed through r4) on the
+    honest workload (r5's profile-registration race fix). Earlier guard
+    values (util 0.9689 / avg 9,337 s at assumed pricing; avg 3195 s on
+    the corrupted trace) are not comparable. Sweep provenance:
+    scripts/replay_sweep.py, doc/replay_sweep_r5.json."""
     _, h = _headline_harness(64, (4, 4, 4))
     r = h.run()
     assert r.completed == 64
     assert r.failed == 0, r                       # preemption kills no job
-    assert r.steady_state_utilization >= 0.96, r  # measured 0.9689
-    assert r.avg_jct_seconds <= 9_600.0, r        # measured 9,337.5 s
-    assert r.p95_jct_seconds <= 18_000.0, r       # measured 17,530 s
+    assert r.steady_state_utilization >= 0.87, r  # measured 0.8804
+    assert r.avg_jct_seconds <= 9_000.0, r        # measured 8,690.3 s
+    assert r.p95_jct_seconds <= 19_900.0, r       # measured 19,318 s; the
+    # pinned-seed physics floor is ~11.4 ks (2-chip-capped ResNets,
+    # doc/benchmarks.md floor analysis) — the 3% headroom is determinism
+    # slack over the measured value, not cushion over the floor.
     assert r.steady_state_seconds > 0.5 * r.makespan_seconds, r
-    assert r.restarts_total <= 200, r             # measured 164
-    assert r.attainable_utilization >= 0.96, r
+    assert r.restarts_total <= 230, r             # measured 194
+    assert r.attainable_utilization >= 0.87, r    # measured 0.8788
 
 
 def _headline_harness(num_jobs: int, torus_dims: tuple,
@@ -133,14 +137,17 @@ def _headline_harness(num_jobs: int, torus_dims: tuple,
     from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
     from vodascheduler_tpu.replay.simulator import config5_preemptions
 
+    from vodascheduler_tpu import config
+
     trace = philly_like_trace(num_jobs=num_jobs, seed=20260729,
                               max_job_chips=64,
                               failure_fraction=failure_fraction)
     topo = PoolTopology(torus_dims=torus_dims, host_block=(2, 2, 1))
     return trace, ReplayHarness(
         trace, algorithm=algorithm, topology=topo,
-        rate_limit_seconds=30.0, scale_out_hysteresis=1.5,
-        resize_cooldown_seconds=300.0,
+        rate_limit_seconds=config.RATE_LIMIT_SECONDS,
+        scale_out_hysteresis=config.SCALE_OUT_HYSTERESIS,
+        resize_cooldown_seconds=config.RESIZE_COOLDOWN_SECONDS,
         preemptions=config5_preemptions(topo))
 
 
@@ -148,17 +155,17 @@ def test_v5p128_scale_replay():
     """BASELINE config 5 names v5p-128: double the pool and the job
     count (+ the spot dip) and the whole control plane must still clear
     the north-star bars. Simulated time — runs in under a second.
-    True-workload measurements (r5): util 0.9521 / avg 7,648 s /
-    p95 17,055 s. The steady-state window is only ~27% of makespan at
+    Measured-pricing measurements (r5): util 0.8509 / avg 8,182 s /
+    p95 18,176 s. The steady-state window is only ~30% of makespan at
     this scale (the heavy tail drains long after arrivals stop), so no
     ss_frac assertion here — the 64-job guard carries it."""
     _, h = _headline_harness(128, (4, 4, 8))
     r = h.run()
     assert r.completed == 128
     assert r.failed == 0, r
-    assert r.steady_state_utilization >= 0.94, r
-    assert r.avg_jct_seconds <= 8_000.0, r
-    assert r.p95_jct_seconds <= 17_800.0, r
+    assert r.steady_state_utilization >= 0.84, r
+    assert r.avg_jct_seconds <= 8_500.0, r
+    assert r.p95_jct_seconds <= 18_800.0, r
 
 
 def test_algorithm_compare_runs_all_registered():

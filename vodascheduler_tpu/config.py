@@ -42,11 +42,18 @@ def _env_float(name: str, default: str) -> float:
 # ~free; checkpoint-restart resizes are not). The ONE source of truth for
 # the shipped values: Scheduler ctor defaults and ReplayHarness both read
 # these, so replay evidence and production policy cannot drift. Defaults
-# are the r5 sweep knee (scripts/replay_sweep.py,
-# doc/replay_sweep_r5.json); the env overrides exist for operators
-# re-tuning on their own workload.
-SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.5")
-RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "300")
+# are the r5 sweep knee re-derived under MEASURED restart pricing
+# (scripts/replay_sweep.py over doc/resize_measured.json →
+# doc/replay_sweep_r5.json): with restarts priced at their measured
+# 97–513 s the sweep favors reacting fast (rate 15 s, no scale-out
+# hysteresis, 60 s cooldown) — idle chips now cost more than the
+# restarts that fill them. The env overrides exist for operators
+# re-tuning on their own workload. The rate limit lives here too since
+# r5: the measured knee (15 s) no longer coincides with the reference
+# scheduler's 30 s default (scheduler.go:212).
+RATE_LIMIT_SECONDS = _env_float("VODA_RATE_LIMIT_SECONDS", "15")
+SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.0")
+RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "60")
 
 # How long a preempted worker gets between SIGTERM and SIGKILL — it must
 # cover a full synchronous checkpoint save (the SIGTERM→save→PREEMPTED

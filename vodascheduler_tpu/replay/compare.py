@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Sequence
 
+from vodascheduler_tpu import config
 from vodascheduler_tpu.algorithms import ALGORITHM_NAMES
 from vodascheduler_tpu.placement import PoolTopology
 from vodascheduler_tpu.replay.simulator import (
@@ -31,7 +32,7 @@ def compare_algorithms(
     num_jobs: int = 64,
     seed: int = 20260729,
     algorithms: Optional[Sequence[str]] = None,
-    rate_limit_seconds: float = 30.0,
+    rate_limit_seconds: float = config.RATE_LIMIT_SECONDS,
     # None -> the production defaults (config, the r5 sweep knee) via
     # ReplayHarness's own resolution — one source of truth.
     scale_out_hysteresis: Optional[float] = None,

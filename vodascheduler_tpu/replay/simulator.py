@@ -94,7 +94,7 @@ class ReplayHarness:
         # backend fallback for jobs without a per-job profile cost —
         # trace jobs all carry their family's measured/assumed value).
         restart_overhead_seconds: Optional[float] = None,
-        rate_limit_seconds: float = 30.0,
+        rate_limit_seconds: float = config.RATE_LIMIT_SECONDS,
         # None -> the production defaults (config.SCALE_OUT_HYSTERESIS /
         # RESIZE_COOLDOWN_SECONDS, the r5 sweep knee): replay evidence
         # and deployed policy must not drift. 1.0 restores reference

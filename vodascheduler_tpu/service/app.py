@@ -97,7 +97,7 @@ class VodaApp:
                  service_port: int = config.SERVICE_PORT,
                  scheduler_port: int = config.SCHEDULER_PORT,
                  allocator_port: int = config.ALLOCATOR_PORT,
-                 rate_limit_seconds: float = 30.0,
+                 rate_limit_seconds: float = config.RATE_LIMIT_SECONDS,
                  collector_interval_seconds: float = 60.0,
                  resume: bool = False,
                  pools: Union[None, str, List[PoolSpec]] = None,
