@@ -56,10 +56,12 @@ log = logging.getLogger(__name__)
 # from config (one source of truth, env-overridable).
 DEFAULT_RATE_LIMIT_SECONDS = config.RATE_LIMIT_SECONDS
 DEFAULT_TICKER_SECONDS = 5.0        # reference: rateLimitTimeMetricsSeconds
-# TPU-delta knobs at the r5 sweep knee: every resize is a checkpoint-
-# restart, so sub-1.5x scale-outs within a 300 s cooldown are suppressed.
-# Values live in config (one source of truth, env-overridable); the
-# replay guards (tests/test_replay.py) pin the same values.
+# TPU-delta knobs at the r5 sweep knee (re-derived under measured
+# restart pricing): every resize is a checkpoint-restart, and at
+# measured costs the sweep favors reacting fast over suppressing
+# resizes. Values live in config (one source of truth,
+# env-overridable); the replay guards (tests/test_replay.py) pin the
+# same values.
 DEFAULT_SCALE_OUT_HYSTERESIS = config.SCALE_OUT_HYSTERESIS
 DEFAULT_RESIZE_COOLDOWN_SECONDS = config.RESIZE_COOLDOWN_SECONDS
 
