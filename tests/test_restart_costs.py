@@ -102,3 +102,30 @@ class TestTraceWiring:
         assert jobs
         for j in jobs:
             assert j.restart_overhead_seconds == costs[j.model].restart_s
+
+
+class TestCheckedInArtifact:
+    """The r5 measured artifact is checked in (doc/resize_measured.json)
+    and every headline number in doc/benchmarks.md / BASELINE.md quotes
+    the family costs derived from it. Pin those costs so silent drift
+    between the artifact, the derivation, and the documented economics
+    cannot happen."""
+
+    def test_artifact_derives_documented_costs(self):
+        costs = family_restart_costs()  # default path = the repo artifact
+        documented = {"resnet50": 96.9, "bert": 99.0, "vitl": 105.7,
+                      "llama8b": 166.3, "mixtral": 513.5}
+        for fam, expect in documented.items():
+            assert costs[fam].restart_s == pytest.approx(expect, abs=0.05), fam
+            assert costs[fam].provenance.startswith("scaled:"), fam
+            assert "measured on llama_350m,mixtral_small" in (
+                costs[fam].provenance), fam
+        assert default_restart_seconds() == pytest.approx(151.3, abs=0.05)
+
+    def test_artifact_points_are_complete(self):
+        from vodascheduler_tpu.replay.restart_costs import (
+            MEASURED_PATH, load_measured)
+        points = load_measured()
+        assert points is not None and len(points) == 2, MEASURED_PATH
+        assert {p["model"] for p in points} == {"llama_350m",
+                                                "mixtral_small"}
