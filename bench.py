@@ -77,7 +77,8 @@ def run_replay():
 # chip (adafactor bundle) is the most OOM-prone point, and the stream
 # salvages earlier points if it dies.
 HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m", 16],
-                   ["llama_350m_8k", 2], ["llama_1b", 4]]
+                   ["llama_350m_af", 8], ["llama_350m_8k", 2],
+                   ["llama_1b", 4]]
 # Attention points inherit the child's DEFAULT_ATTENTION_POINTS
 # (runtime/hwbench.py) — one canonical sweep definition, no drift.
 # Elastic-resize cost points (runtime/resize_bench.py): the models whose

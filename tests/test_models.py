@@ -503,9 +503,28 @@ class TestScaleFeasibility:
             params = sum(l.size for l in
                          jax.tree.leaves(setup.eval_shape_state["params"]))
             cfg = bundle.module.cfg
-            acts = cfg.num_layers * batch * cfg.max_seq_len * cfg.dim * 2
+            tokens = batch * cfg.max_seq_len
+            acts = cfg.num_layers * tokens * cfg.dim * 2  # remat boundary
+            cap = 0.80 * 16e9
+            if getattr(cfg, "remat_policy", None) == "dots_attn":
+                # The saved matmul outputs per layer (bf16): q, kv pair,
+                # attention-kernel out, attn out-proj, gate+up, down —
+                # the HBM this policy trades for its recompute savings
+                # (the down-proj dot is a distinct buffer from the
+                # post-residual boundary carry counted above).
+                kv_dim = cfg.dim * cfg.num_kv_heads // cfg.num_heads
+                per_layer = tokens * (4 * cfg.dim + 2 * kv_dim
+                                      + 2 * cfg.mlp_hidden) * 2
+                acts += cfg.num_layers * per_layer
+                # This sum is an upper bound — XLA's live-range peak
+                # never holds every saved dot at once the way it holds
+                # full-remat boundaries — so the gate runs at 85%,
+                # calibrated by the measured point: llama_350m_af B=8
+                # estimates ~12.9 GB here and runs green on the chip
+                # (526 ms, doc/benchmarks.md).
+                cap = 0.85 * 16e9
             est = state + 4 * params + 2 * params + acts
-            assert est < 0.80 * 16e9, (name, batch, est / 1e9)
+            assert est < cap, (name, batch, est / 1e9)
 
     @pytest.mark.slow
     def test_llama3_8b_state_shards_within_v5p_hbm(self):
@@ -558,3 +577,43 @@ print('OK')
                               env=env)
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "OK" in proc.stdout, proc.stdout
+
+
+class TestLlama350mAf:
+    """llama_350m_af: the measured memory-for-FLOPs flagship variant
+    (Adafactor + dots_attn selective remat; doc/benchmarks.md "Remat
+    policy sweep" r5 follow-up). Same arithmetic as llama_350m — only
+    the optimizer state and the remat save-set differ."""
+
+    def test_bundle_shape_and_knobs(self):
+        from vodascheduler_tpu.models.llama import LLAMA_350M, LLAMA_350M_AF
+
+        bundle = get_model("llama_350m_af")
+        assert bundle.optimizer == "adafactor"
+        assert bundle.module.cfg.remat_policy == "dots_attn"
+        assert LLAMA_350M_AF.param_count == LLAMA_350M.param_count
+
+    def test_tiny_twin_trains(self):
+        """The exact knob combination (adafactor + dots_attn + scan)
+        steps on tiny shapes — guards the policy name and the optimizer
+        wiring without full-size compile cost."""
+        import dataclasses
+
+        from vodascheduler_tpu.models import llama
+        from vodascheduler_tpu.models.registry import (
+            TRANSFORMER_RULES, ModelBundle, _lm_batch, _lm_fused_loss)
+        from vodascheduler_tpu.runtime.train import make_train_setup
+
+        cfg = dataclasses.replace(llama.LLAMA_TINY_SCAN, remat_layers=True,
+                                  remat_policy="dots_attn")
+        bundle = ModelBundle(
+            name="tiny_af", module=llama.Llama(cfg),
+            make_batch=_lm_batch(cfg.vocab_size, 64),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64,
+            optimizer="adafactor")
+        setup = make_train_setup(bundle, 1, devices=jax.devices()[:1],
+                                 global_batch_size=2)
+        state = setup.init_fn(jax.random.PRNGKey(0))
+        batch = setup.make_batch(2, jax.random.PRNGKey(1))
+        state, loss = setup.train_step(state, batch)
+        assert float(loss) > 0

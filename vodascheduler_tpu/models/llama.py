@@ -81,6 +81,16 @@ LLAMA_350M_BYTES = dataclasses.replace(LLAMA_350M, vocab_size=256)
 # flash kernel's O(S²) advantage over the XLA lowering is largest —
 # the measured long-context point (doc/benchmarks.md, SURVEY §5.7).
 LLAMA_350M_8K = dataclasses.replace(LLAMA_350M, max_seq_len=8192)
+# Memory-for-FLOPs variant of the flagship, measured on the r5 chip
+# session: pairing Adafactor (frees AdamW's extra ~8 B/param of
+# optimizer HBM) with the dots_attn selective-remat policy (saves every
+# matmul + attention output, ~350 MB/layer at B=8 — OOMs next to AdamW
+# state, fits next to Adafactor's) buys back most of full remat's ~1/3
+# recompute: 526.0 ms/step vs 576.6, 0.4263 MFU vs 0.3889
+# (doc/benchmarks.md "Remat policy sweep"). Same arithmetic, same
+# numerics (tests pin policy identity); the AdamW flagship remains
+# llama_350m for family-comparable training curves.
+LLAMA_350M_AF = dataclasses.replace(LLAMA_350M, remat_policy="dots_attn")
 # ~1.0B single-chip config (BASELINE configs 4-5 direction): dim 2048 x
 # 16 layers x GQA 32/8 x mlp 7168 ≈ 1.00B params. Adam's 12 B/param
 # (f32 params + 2 moments ≈ 12 GB, doubled transiently by the f32 grad

@@ -176,6 +176,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(llama.LLAMA_350M.vocab_size, 2048),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
             seq_len=2048),
+        "llama_350m_af": lambda: ModelBundle(
+            name="llama_350m_af",
+            module=llama.Llama(llama.LLAMA_350M_AF),
+            make_batch=_lm_batch(llama.LLAMA_350M_AF.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=2048, optimizer="adafactor"),
         "llama_350m_8k": lambda: ModelBundle(
             name="llama_350m_8k",
             module=llama.Llama(llama.LLAMA_350M_8K),

@@ -147,6 +147,8 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
         "llama3_8b": (llama.LLAMA3_8B.num_layers, llama.LLAMA3_8B.dim),
         "llama_1b": (llama.LLAMA_1B.num_layers, llama.LLAMA_1B.dim),
         "llama_350m": (llama.LLAMA_350M.num_layers, llama.LLAMA_350M.dim),
+        "llama_350m_af": (llama.LLAMA_350M_AF.num_layers,
+                          llama.LLAMA_350M_AF.dim),
         "llama_350m_8k": (llama.LLAMA_350M_8K.num_layers,
                           llama.LLAMA_350M_8K.dim),
         "llama_tiny": (llama.LLAMA_TINY.num_layers, llama.LLAMA_TINY.dim),
