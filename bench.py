@@ -78,7 +78,7 @@ def run_replay():
 # salvages earlier points if it dies.
 HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m", 16],
                    ["llama_350m_af", 8], ["llama_350m_8k", 2],
-                   ["llama_1b", 4]]
+                   ["llama_350m_8k_af", 2], ["llama_1b", 4]]
 # Attention points inherit the child's DEFAULT_ATTENTION_POINTS
 # (runtime/hwbench.py) — one canonical sweep definition, no drift.
 # Elastic-resize cost points (runtime/resize_bench.py): the models whose

@@ -593,6 +593,16 @@ class TestLlama350mAf:
         assert bundle.module.cfg.remat_policy == "dots_attn"
         assert LLAMA_350M_AF.param_count == LLAMA_350M.param_count
 
+    def test_8k_twin_knobs(self):
+        from vodascheduler_tpu.models.llama import LLAMA_350M_8K_AF
+
+        bundle = get_model("llama_350m_8k_af")
+        assert bundle.optimizer == "adafactor"
+        assert bundle.module.cfg.remat_policy == "dots_attn"
+        assert bundle.module.cfg.max_seq_len == 8192
+        assert bundle.seq_len == 8192
+        assert LLAMA_350M_8K_AF.max_seq_len == 8192
+
     def test_tiny_twin_trains(self):
         """The exact knob combination (adafactor + dots_attn + scan)
         steps on tiny shapes — guards the policy name and the optimizer

@@ -91,6 +91,10 @@ LLAMA_350M_8K = dataclasses.replace(LLAMA_350M, max_seq_len=8192)
 # numerics (tests pin policy identity); the AdamW flagship remains
 # llama_350m for family-comparable training curves.
 LLAMA_350M_AF = dataclasses.replace(LLAMA_350M, remat_policy="dots_attn")
+# Long-context twin of the af variant (same token count per step as the
+# B=8 flagship, so the same save-set fits): measured 931.6 ms vs the
+# full-remat 8k point's 972.8 ms — 0.4025 MFU at 8k context.
+LLAMA_350M_8K_AF = dataclasses.replace(LLAMA_350M_AF, max_seq_len=8192)
 # ~1.0B single-chip config (BASELINE configs 4-5 direction): dim 2048 x
 # 16 layers x GQA 32/8 x mlp 7168 ≈ 1.00B params. Adam's 12 B/param
 # (f32 params + 2 moments ≈ 12 GB, doubled transiently by the f32 grad

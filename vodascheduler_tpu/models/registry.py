@@ -182,6 +182,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             make_batch=_lm_batch(llama.LLAMA_350M_AF.vocab_size, 2048),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
             seq_len=2048, optimizer="adafactor"),
+        "llama_350m_8k_af": lambda: ModelBundle(
+            name="llama_350m_8k_af",
+            module=llama.Llama(llama.LLAMA_350M_8K_AF),
+            make_batch=_lm_batch(llama.LLAMA_350M_8K_AF.vocab_size, 8192),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.35,
+            seq_len=8192, optimizer="adafactor"),
         "llama_350m_8k": lambda: ModelBundle(
             name="llama_350m_8k",
             module=llama.Llama(llama.LLAMA_350M_8K),

@@ -151,6 +151,8 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
                           llama.LLAMA_350M_AF.dim),
         "llama_350m_8k": (llama.LLAMA_350M_8K.num_layers,
                           llama.LLAMA_350M_8K.dim),
+        "llama_350m_8k_af": (llama.LLAMA_350M_8K_AF.num_layers,
+                             llama.LLAMA_350M_8K_AF.dim),
         "llama_tiny": (llama.LLAMA_TINY.num_layers, llama.LLAMA_TINY.dim),
         "bert_base": (bert.BERT_BASE.num_layers, bert.BERT_BASE.dim),
         "bert_tiny": (bert.BERT_TINY.num_layers, bert.BERT_TINY.dim),
