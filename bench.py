@@ -190,6 +190,14 @@ def write_last_good(repo_dir: str, hardware: dict) -> None:
                              if "error" not in a]
     if "error" in (hardware.get("moe") or {}):
         hardware.pop("moe", None)
+    elif isinstance(hardware.get("moe"), dict):
+        # Per-variant failures inside the moe section (e.g. gather_af)
+        # must not become fallback evidence either; if NOTHING measured,
+        # drop the section like the whole-section-error branch does.
+        hardware["moe"] = {k: v for k, v in hardware["moe"].items()
+                           if not (isinstance(v, dict) and "error" in v)}
+        if not hardware["moe"]:
+            hardware.pop("moe", None)
     hardware["resize"] = [r for r in hardware.get("resize", [])
                           if "error" not in r]
     if not hardware["models"]:

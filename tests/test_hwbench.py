@@ -48,6 +48,8 @@ def test_moe_dispatch_compare_hermetic():
     assert out["gather_speedup_vs_dense"] > 0
     # MoE convention: active < total params (top_k=2 of 4 experts).
     assert 0 < out["gather"]["num_params_active"] < out["gather"]["num_params"]
+    # The af tuning row (adafactor + dots_attn on gather dispatch).
+    assert out["gather_af"]["step_time_ms"] > 0, out["gather_af"]
 
 
 def test_refuses_cpu_without_escape_hatch(monkeypatch):
@@ -323,6 +325,28 @@ def test_cache_write_drops_error_rows_and_keeps_prior_on_empty(tmp_path):
     assert hw["attention"] == [{"batch": 8, "seq": 1024, "flash_ms": 1.0}]
     assert "moe" not in hw
     assert hw["resize"] == [{"model": "m1", "resize_cost_seconds": 9.0}]
+
+    # Per-variant failure INSIDE the moe dict (e.g. gather_af) is
+    # stripped while the measured variants stay.
+    mixed_moe = {"models": [{"model": "m1", "mfu": 0.4}],
+                 "moe": {"gather": {"step_time_ms": 1.0},
+                         "dense_step_ms": 2.0,
+                         "gather_af": {"error": "OOM"}}}
+    bench.write_last_good(str(tmp_path), mixed_moe)
+    cache_moe = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    assert cache_moe["hardware"]["moe"] == {"gather": {"step_time_ms": 1.0},
+                                            "dense_step_ms": 2.0}
+
+    # Every moe variant errored per-variant: the section is dropped, not
+    # cached as an empty dict masquerading as a successful capture.
+    all_moe_bad = {"models": [{"model": "m1", "mfu": 0.4}],
+                   "moe": {"gather": {"error": "OOM"},
+                           "gather_af": {"error": "OOM"}}}
+    bench.write_last_good(str(tmp_path), all_moe_bad)
+    cache_bad = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    assert "moe" not in cache_bad["hardware"]
 
     all_bad = {"models": [{"model": "m1", "error": "regression"}],
                "attention": [{"batch": 8, "seq": 1024, "flash_ms": 2.0}]}

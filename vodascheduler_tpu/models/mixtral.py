@@ -64,6 +64,13 @@ MIXTRAL_SMALL = MixtralConfig(dim=640, num_layers=12, num_heads=10,
                               num_kv_heads=5, mlp_hidden=1792,
                               num_experts=8, top_k=2, dispatch="gather",
                               scan_layers=True, remat_layers=True)
+# Memory-for-FLOPs tuning measured on the r5 chip (same recipe as
+# llama.LLAMA_350M_AF): Adafactor + dots_attn selective remat —
+# 293.4 ms/step vs the AdamW flagship's 323.5, 0.2889 active-param
+# MFU vs 0.262 (doc/benchmarks.md MoE section). Pairs with the
+# adafactor bundle (registry "mixtral_small_af").
+MIXTRAL_SMALL_AF = dataclasses.replace(MIXTRAL_SMALL,
+                                       remat_policy="dots_attn")
 MIXTRAL_TINY = MixtralConfig(vocab_size=256, dim=64, num_layers=2,
                              num_heads=4, num_kv_heads=2, mlp_hidden=128,
                              num_experts=4, top_k=2, rope_base=10000.0)

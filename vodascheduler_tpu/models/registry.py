@@ -203,6 +203,12 @@ def _bundles() -> Dict[str, Callable[[], ModelBundle]]:
             name="llama_tiny", module=llama.Llama(llama.LLAMA_TINY),
             make_batch=_lm_batch(llama.LLAMA_TINY.vocab_size, 64),
             loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, seq_len=64),
+        "mixtral_small_af": lambda: ModelBundle(
+            name="mixtral_small_af",
+            module=mixtral.Mixtral(mixtral.MIXTRAL_SMALL_AF),
+            make_batch=_lm_batch(mixtral.MIXTRAL_SMALL_AF.vocab_size, 2048),
+            loss_fn=_lm_fused_loss, rules=TRANSFORMER_RULES, params_b=0.39,
+            seq_len=2048, num_experts=8, optimizer="adafactor"),
         "mixtral_8x7b": lambda: ModelBundle(
             name="mixtral_8x7b", module=mixtral.Mixtral(mixtral.MIXTRAL_8X7B_LIKE),
             make_batch=_lm_batch(mixtral.MIXTRAL_8X7B_LIKE.vocab_size, 4096),

@@ -160,6 +160,8 @@ def _lm_structure(model_name: str) -> Tuple[int, int]:
                          mixtral.MIXTRAL_8X7B_LIKE.dim),
         "mixtral_small": (mixtral.MIXTRAL_SMALL.num_layers,
                           mixtral.MIXTRAL_SMALL.dim),
+        "mixtral_small_af": (mixtral.MIXTRAL_SMALL_AF.num_layers,
+                             mixtral.MIXTRAL_SMALL_AF.dim),
         "mixtral_tiny": (mixtral.MIXTRAL_TINY.num_layers,
                          mixtral.MIXTRAL_TINY.dim),
         "vit_l16": (vit.VIT_L16.num_layers, vit.VIT_L16.dim),
@@ -338,6 +340,25 @@ def bench_moe_dispatch(global_batch_size: int = 8,
             out[dispatch if dispatch == "gather"
                 else f"{dispatch}_step_ms"] = {
                 "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    # The af tuning on the winning gather dispatch — measured 9.3%
+    # faster than the AdamW flagship in r5. The knobs come from the
+    # SHIPPED config/bundle (mixtral.MIXTRAL_SMALL_AF + the registry's
+    # "mixtral_small_af" optimizer), not a hand-rebuilt copy, so the
+    # published number always describes what ships. base_cfg overrides
+    # (the hermetic tiny-config test) inherit the same deltas.
+    try:
+        af_ship = get_model("mixtral_small_af")
+        af_cfg = _dc.replace(
+            base_cfg,
+            dispatch=mixtral.MIXTRAL_SMALL_AF.dispatch,
+            remat_policy=mixtral.MIXTRAL_SMALL_AF.remat_policy)
+        bundle = get_model(model_name)
+        bundle.module = mixtral.Mixtral(af_cfg)
+        bundle.optimizer = af_ship.optimizer
+        out["gather_af"] = bench_model_step(model_name, global_batch_size,
+                                            bundle=bundle).as_dict()
+    except Exception as e:  # noqa: BLE001
+        out["gather_af"] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     gather_ms = (out.get("gather") or {}).get("step_time_ms")
     dense_ms = out.get("dense_step_ms")
     if isinstance(gather_ms, (int, float)) and isinstance(dense_ms,
