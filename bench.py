@@ -283,7 +283,7 @@ def maybe_hardware():
     still print either way.
 
     The whole hardware section runs in a SUBPROCESS (hwbench --stream)
-    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 2400s) AND a
+    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 3600s) AND a
     per-point stall watchdog (VODA_BENCH_HW_STALL_TIMEOUT, default 600s
     between streamed lines): a wedged remote compile blocks inside
     native code holding the GIL, where no in-process signal can
@@ -311,7 +311,10 @@ def maybe_hardware():
         # 2400s: the r5 point list grew (llama_350m B=16 candidate +
         # llama_1b); at ~2-4 min/point plus the attention and MoE sweeps
         # the old 1800s budget had no headroom left.
-        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "2400"))
+        # 3600s: the r5 point list (6 model points incl. two af
+        # compiles + 4 moe variants + attention sweep) measures
+        # ~38 min over the tunnel — 2400s would kill the tail.
+        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "3600"))
         stall = int(os.environ.get("VODA_BENCH_HW_STALL_TIMEOUT", "600"))
         cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
                "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]
