@@ -113,19 +113,22 @@ class TestCheckedInArtifact:
 
     def test_artifact_derives_documented_costs(self):
         costs = family_restart_costs()  # default path = the repo artifact
-        documented = {"resnet50": 96.9, "bert": 99.0, "vitl": 105.7,
-                      "llama8b": 166.3, "mixtral": 513.5}
+        documented = {"resnet50": 94.7, "bert": 96.7, "vitl": 103.3,
+                      "llama8b": 162.3, "mixtral": 500.7}
         for fam, expect in documented.items():
             assert costs[fam].restart_s == pytest.approx(expect, abs=0.05), fam
             assert costs[fam].provenance.startswith("scaled:"), fam
             assert "measured on llama_350m,mixtral_small" in (
                 costs[fam].provenance), fam
-        assert default_restart_seconds() == pytest.approx(151.3, abs=0.05)
+        assert default_restart_seconds() == pytest.approx(147.7, abs=0.05)
 
     def test_artifact_points_are_complete(self):
         from vodascheduler_tpu.replay.restart_costs import (
             MEASURED_PATH, load_measured)
         points = load_measured()
-        assert points is not None and len(points) == 2, MEASURED_PATH
+        # Two capture sessions pooled, two models each: per-session I/O
+        # varies ~30% over the tunnel but the pooled derivation agrees
+        # within 5% across sessions (artifact note).
+        assert points is not None and len(points) == 4, MEASURED_PATH
         assert {p["model"] for p in points} == {"llama_350m",
                                                 "mixtral_small"}

@@ -111,7 +111,9 @@ def derive_costs(points: List[Dict[str, Any]]) -> Dict[str, FamilyCost]:
                        + restored_ms) / 1000.0
     fixed_s = sum(fixed_samples) / len(fixed_samples)
     io_rate = io_bytes / io_seconds if io_seconds > 0 else float("inf")
-    measured_models = ",".join(str(p.get("model")) for p in points)
+    # Dedupe (ordered): pooled multi-session artifacts repeat models.
+    measured_models = ",".join(dict.fromkeys(
+        str(p.get("model")) for p in points))
 
     out: Dict[str, FamilyCost] = {}
     for fam, fp in FAMILY_FOOTPRINT.items():

@@ -52,16 +52,15 @@ from vodascheduler_tpu.placement import PlacementManager
 log = logging.getLogger(__name__)
 
 # Reference default is 30 s (scheduler.go:212); under measured restart
-# pricing the r5 sweep knee moved to 15 s, so the shipped value comes
-# from config (one source of truth, env-overridable).
+# pricing the r5 sweep pick is 45 s (flat surface, util-first tiebreak
+# — config.py), so the shipped value comes from config (one source of
+# truth, env-overridable).
 DEFAULT_RATE_LIMIT_SECONDS = config.RATE_LIMIT_SECONDS
 DEFAULT_TICKER_SECONDS = 5.0        # reference: rateLimitTimeMetricsSeconds
-# TPU-delta knobs at the r5 sweep knee (re-derived under measured
-# restart pricing): every resize is a checkpoint-restart, and at
-# measured costs the sweep favors reacting fast over suppressing
-# resizes. Values live in config (one source of truth,
-# env-overridable); the replay guards (tests/test_replay.py) pin the
-# same values.
+# TPU-delta knobs at the r5 sweep pick (re-derived under measured
+# restart pricing; the surface is flat — config.py narrative). Values
+# live in config (one source of truth, env-overridable); the replay
+# guards (tests/test_replay.py) pin the same values.
 DEFAULT_SCALE_OUT_HYSTERESIS = config.SCALE_OUT_HYSTERESIS
 DEFAULT_RESIZE_COOLDOWN_SECONDS = config.RESIZE_COOLDOWN_SECONDS
 
