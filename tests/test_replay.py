@@ -203,3 +203,21 @@ def test_failure_matrix_exact_accounting_all_algorithms():
         r = h.run()
         assert r.completed == 64 - injected, (algo, r)
         assert r.failed == injected, (algo, r)
+
+
+def test_shipped_knobs_match_sweep_artifact():
+    """config.py's resize knobs are documented as the pick of the
+    checked-in sweep (doc/replay_sweep_r5.json panel_knobs) — pin that
+    so a re-sweep that forgets to update config (or vice versa) fails
+    fast instead of shipping knobs the evidence doesn't describe."""
+    import os
+
+    from vodascheduler_tpu import config
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "doc", "replay_sweep_r5.json")
+    with open(path) as f:
+        knobs = json.load(f)["panel_knobs"]
+    assert config.RATE_LIMIT_SECONDS == knobs["rate"]
+    assert config.SCALE_OUT_HYSTERESIS == knobs["hyst"]
+    assert config.RESIZE_COOLDOWN_SECONDS == knobs["cooldown"]
