@@ -3,8 +3,12 @@
 # docker images, GKE apply.
 
 PY ?= python
+CXX ?= g++
 
-.PHONY: test test-all test-fast bench native docker deploy-gke clean
+NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
+NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
+
+.PHONY: test test-all test-fast bench bench-dryrun native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -22,10 +26,23 @@ test-fast: test
 bench:
 	$(PY) bench.py
 
-# Build the C++ resched kernels explicitly (they also build lazily on
-# first use).
-native:
-	$(PY) -c "from vodascheduler_tpu import native; native.get_lib(); print('native kernels OK')"
+# Benchrunner evidence-plane dryrun on the fake (no-TPU) backend: real
+# subprocess workers, real watchdog/journal/cache, a deliberately
+# wedged point — fails on any untagged gap in the artifact. Fast (~3s);
+# also wired into the tier-1 suite (tests/test_benchrunner.py).
+bench-dryrun:
+	$(PY) -m vodascheduler_tpu.benchrunner.dryrun
+
+# Build the C++ resched kernels from source. The binary is a build
+# artifact (never checked into git — .gitignore covers *.so); CI and
+# deploy images run this target, and native/__init__.py keeps the
+# on-demand lazy build as fallback for source checkouts.
+$(NATIVE_SO): $(NATIVE_SRC)
+	$(CXX) -O2 -shared -fPIC -std=c++17 -o $@.tmp $<
+	mv $@.tmp $@
+
+native: $(NATIVE_SO)
+	$(PY) -c "from vodascheduler_tpu import native; assert native.get_lib() is not None; print('native kernels OK')"
 
 docker:
 	docker build -f deploy/docker/Dockerfile.controlplane -t voda-controlplane:latest .

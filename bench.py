@@ -2,7 +2,12 @@
 """Headline benchmark: 64-job Philly-style trace replay WITH spot
 preemption on a simulated v5p-64 pool under Elastic-Tiresias, plus — when
 an accelerator is present — measured hardware numbers (model step time /
-MFU and flash-vs-XLA attention) from runtime/hwbench.py.
+MFU, flash-vs-XLA attention, MoE dispatch, elastic-resize cost) captured
+through the benchrunner orchestration plane (vodascheduler_tpu/
+benchrunner/): every point in its own killable subprocess, risk-ordered,
+provenance-tagged per row (measured / cached_from / skipped), resumable
+via a crash-safe journal. See doc/benchmarks.md "Benchrunner evidence
+format".
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
@@ -73,70 +78,41 @@ def run_replay():
     return harness.run()
 
 
-# llama_350m at B=16: the r4 state-donation fix halved in-step HBM, so
-# double the r3 batch may now fit — streamed AFTER the known-good B=8
-# point so an OOM costs nothing. llama_1b last: ≥1B params on one 16 GB
-# chip (adafactor bundle) is the most OOM-prone point, and the stream
-# salvages earlier points if it dies.
+# The model point set for the hardware section. Order here no longer
+# matters: the benchrunner registry risk-orders points (riskiest
+# compiles last) and every point runs in its own killable subprocess, so
+# an OOM or wedge costs exactly one row (r5 lost _af, llama_1b,
+# attention, MoE and resize to one wedged compile in the old monolithic
+# stream).
 HW_MODEL_POINTS = [["llama_350m", 8], ["llama_350m", 16],
                    ["llama_350m_af", 8], ["llama_350m_8k", 2],
                    ["llama_350m_8k_af", 2], ["llama_1b", 4]]
-# Attention points inherit the child's DEFAULT_ATTENTION_POINTS
-# (runtime/hwbench.py) — one canonical sweep definition, no drift.
+# Attention points inherit hwbench.DEFAULT_ATTENTION_POINTS via the
+# registry — one canonical sweep definition, no drift.
 # Elastic-resize cost points (runtime/resize_bench.py): the models whose
 # restart economics the replay's restart_overhead_seconds prices.
 RESIZE_POINTS = [["llama_350m", 8], ["mixtral_small", 8]]
 
+# Benchrunner persistence (relative to the repo root): the per-point
+# result cache that back-fills gaps with `cached_from` rows, and the
+# crash-safe journal that makes an interrupted capture resumable.
+BENCHRUNNER_CACHE = os.path.join("doc", "benchrunner_cache.json")
+BENCHRUNNER_JOURNAL = os.path.join("doc", "benchrunner_journal.jsonl")
 
-def _run_streamed_child(cmd, repo_dir, timeout, stall):
-    """Run a line-streaming child under the wedge watchdog.
 
-    Returns (stdout, stderr_tail, timed_out, stalled, returncode). cwd
-    pins the child's import root (the package runs from the source tree);
-    binary pipes + errors="replace" because SIGKILL can cut the stream
-    mid-byte; reader threads (not communicate()) because subprocess.run
-    on POSIX discards already-flushed output on timeout."""
-    import subprocess
-    import threading
-    import time
-    child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.PIPE, cwd=repo_dir)
-    chunks = {"out": [], "err": []}
-    last_line = [time.monotonic()]
-
-    def _drain(pipe, key, bump):
-        for raw in iter(pipe.readline, b""):
-            chunks[key].append(raw)
-            if bump:
-                last_line[0] = time.monotonic()
-
-    readers = [
-        threading.Thread(target=_drain, args=(child.stdout, "out", True),
-                         daemon=True),
-        threading.Thread(target=_drain, args=(child.stderr, "err", False),
-                         daemon=True),
-    ]
-    for t in readers:
-        t.start()
-    start = time.monotonic()
-    timed_out = stalled = False
-    while child.poll() is None:
-        now = time.monotonic()
-        if now - start > timeout:
-            timed_out = True
-        elif now - last_line[0] > stall:
-            timed_out = stalled = True
-        if timed_out:
-            child.kill()
-            break
-        time.sleep(0.2)
-    child.wait()
-    for t in readers:
-        t.join(timeout=5)
-    stdout = b"".join(chunks["out"]).decode("utf-8", errors="replace")
-    stderr_tail = b"".join(chunks["err"]).decode(
-        "utf-8", errors="replace").strip()[-300:]
-    return stdout, stderr_tail, timed_out, stalled, child.returncode
+def _benchrunner_paths(repo_dir: str):
+    """(cache_path, journal_path). CPU escape-hatch runs get their own
+    namespace: a smoke run's cpu-platform rows must never back-fill (or
+    journal-resume into) a real accelerator capture. Absolute overrides
+    (tests pin tmp paths) are taken verbatim — the caller owns isolation
+    there."""
+    cache, journal = BENCHRUNNER_CACHE, BENCHRUNNER_JOURNAL
+    if os.environ.get("VODA_HWBENCH_ON_CPU"):
+        if not os.path.isabs(cache):
+            cache = cache.replace(".json", ".cpu.json")
+        if not os.path.isabs(journal):
+            journal = journal.replace(".jsonl", ".cpu.jsonl")
+    return os.path.join(repo_dir, cache), os.path.join(repo_dir, journal)
 
 
 def parse_hw_stream(stdout: str) -> dict:
@@ -178,6 +154,15 @@ def read_last_good(repo_dir: str):
         return None
 
 
+def _is_live_row(row) -> bool:
+    """A row eligible for the last-good cache: measured THIS run and
+    error-free. Benchrunner rows carry explicit provenance; a
+    `cached_from:` row must never be re-cached as fresh (its timestamp
+    would renew forever) and a `skipped:` row is not evidence at all."""
+    return ("error" not in row
+            and row.get("provenance", "measured") == "measured")
+
+
 def write_last_good(repo_dir: str, hardware: dict) -> None:
     import time
     # Per-row failures must not become fallback "evidence": a cached
@@ -187,10 +172,10 @@ def write_last_good(repo_dir: str, hardware: dict) -> None:
     # cache keeps only measured points.
     hardware = dict(hardware)
     hardware["models"] = [m for m in hardware.get("models", [])
-                          if "error" not in m]
+                          if _is_live_row(m)]
     hardware["attention"] = [a for a in hardware.get("attention", [])
-                             if "error" not in a]
-    if "error" in (hardware.get("moe") or {}):
+                             if _is_live_row(a)]
+    if not _is_live_row(hardware.get("moe") or {"error": "absent"}):
         hardware.pop("moe", None)
     elif isinstance(hardware.get("moe"), dict):
         # Per-variant failures inside the moe section (e.g. gather_af)
@@ -201,7 +186,7 @@ def write_last_good(repo_dir: str, hardware: dict) -> None:
         if not hardware["moe"]:
             hardware.pop("moe", None)
     hardware["resize"] = [r for r in hardware.get("resize", [])
-                          if "error" not in r]
+                          if _is_live_row(r)]
     if not hardware["models"]:
         # Every model point errored per-row: overwriting the cache would
         # destroy previously measured fallback data with an empty list.
@@ -277,6 +262,23 @@ def _probe_backend(repo_dir: str):
     return None, err
 
 
+def _registered_points():
+    """The benchmark point registry for this run.
+
+    VODA_BENCH_POINTS_JSON (a JSON list of point dicts) overrides the
+    default registry — targeted re-captures and the hermetic tests use
+    it; production runs take the canonical HW_MODEL_POINTS /
+    DEFAULT_ATTENTION_POINTS / MoE / RESIZE_POINTS set."""
+    from vodascheduler_tpu.benchrunner import default_registry, point_from_dict
+    points_json = os.environ.get("VODA_BENCH_POINTS_JSON")
+    if points_json:
+        return [point_from_dict(d) for d in json.loads(points_json)]
+    resize = (RESIZE_POINTS
+              if os.environ.get("VODA_BENCH_RESIZE") != "0" else ())
+    return default_registry(model_points=HW_MODEL_POINTS,
+                            resize_points=resize)
+
+
 def maybe_hardware():
     """Measured numbers from the real chip; None off-accelerator (or when
     VODA_BENCH_HW=0 skips it). If the accelerator is present but
@@ -284,23 +286,25 @@ def maybe_hardware():
     `cached_from` instead of a bare error — the replay headline must
     still print either way.
 
-    The whole hardware section runs in a SUBPROCESS (hwbench --stream)
-    with a hard deadline (VODA_BENCH_HW_TIMEOUT, default 3600s) AND a
-    per-point stall watchdog (VODA_BENCH_HW_STALL_TIMEOUT, default 600s
-    between streamed lines): a wedged remote compile blocks inside
-    native code holding the GIL, where no in-process signal can
-    interrupt it (observed live in r3 — a SIGALRM watchdog sailed
-    straight past its deadline). Killing the child from outside always
-    works, and the streamed per-point JSON lines mean every point
-    completed before the wedge is kept. The reader thread (not
-    communicate()) is load-bearing: subprocess.run() on POSIX discards
-    already-flushed child output on timeout."""
+    The hardware section runs through the benchrunner orchestration
+    plane (vodascheduler_tpu/benchrunner/): every point in its own
+    killable subprocess under a per-point watchdog. A wedged remote
+    compile blocks inside native code holding the GIL, where no
+    in-process signal can interrupt it (observed live in r3 — a SIGALRM
+    watchdog sailed straight past its deadline); killing the point's
+    child from outside always works, and — unlike the r3–r5 monolithic
+    `hwbench --stream` child, where one wedge forfeited every later
+    point — the stream simply continues with the next point. Still-
+    missing points back-fill from the per-point cache with an explicit
+    `cached_from` tag; every registered row comes back `measured`,
+    `cached_from:<ts>`, or `skipped:<reason>` — no silent gaps.
+
+    VODA_BENCH_HW_TIMEOUT (default 3600s) bounds the measurement budget
+    (+VODA_BENCH_RESIZE_TIMEOUT, default 2400s, when resize points are
+    registered); risk ordering means budget exhaustion eats the
+    speculative tail, not the flagship rows."""
     if os.environ.get("VODA_BENCH_HW") == "0":
         return None
-    import subprocess
-    import sys
-    import threading
-    import time
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
         backend, probe_err = _probe_backend(repo_dir)
@@ -310,63 +314,35 @@ def maybe_hardware():
                 "VODA_HWBENCH_ON_CPU"):  # tests drive the full path on CPU
             return None
 
-        # 2400s: the r5 point list grew (llama_350m B=16 candidate +
-        # llama_1b); at ~2-4 min/point plus the attention and MoE sweeps
-        # the old 1800s budget had no headroom left.
-        # 3600s: the r5 point list (6 model points incl. two af
-        # compiles + 4 moe variants + attention sweep) measures
-        # ~38 min over the tunnel — 2400s would kill the tail.
-        timeout = int(os.environ.get("VODA_BENCH_HW_TIMEOUT", "3600"))
-        stall = int(os.environ.get("VODA_BENCH_HW_STALL_TIMEOUT", "600"))
-        cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.hwbench",
-               "--stream", json.dumps({"model_points": HW_MODEL_POINTS})]
-        stdout, stderr_tail, timed_out, stalled, rc = _run_streamed_child(
-            cmd, repo_dir, timeout, stall)
-        failed = timed_out or rc != 0
-
-        out = parse_hw_stream(stdout)
-        if stalled:
-            out["error"] = (f"hardware bench stalled: no completed point "
-                            f"for {stall}s (deadline exceeded); points "
-                            "above completed before the stall")
-        elif timed_out:
-            out["error"] = (f"hardware bench exceeded {timeout}s and was "
-                            "killed; points above completed before the "
-                            "deadline")
-        elif failed:
-            out["error"] = f"hardware bench subprocess failed: {stderr_tail}"
-        if "error" in out and os.environ.get("VODA_BENCH_RESIZE") != "0":
-            # Absence must be distinguishable from "not configured":
-            # record WHY the resize sweep did not run.
-            out["resize_error"] = ("skipped: hardware bench did not "
-                                   "complete cleanly")
-        elif os.environ.get("VODA_BENCH_RESIZE") != "0":
-            # Elastic-resize cost (save / cold start / restore / first
-            # step): runs AFTER the hwbench child has exited — its
-            # measurement children must be able to take the chip.
-            rz_timeout = int(os.environ.get("VODA_BENCH_RESIZE_TIMEOUT",
-                                            "2400"))
-            rz_cmd = [sys.executable, "-m",
-                      "vodascheduler_tpu.runtime.resize_bench",
-                      json.dumps({"stream": True,
-                                  "points": RESIZE_POINTS})]
-            rz_out, rz_err, rz_to, _rz_stall, rz_rc = _run_streamed_child(
-                rz_cmd, repo_dir, rz_timeout, rz_timeout)
-            rz = parse_hw_stream(rz_out).get("resize", [])
-            if rz:
-                out["resize"] = rz
-            if rz_to or rz_rc != 0:
-                out["resize_error"] = (
-                    f"resize bench {'timed out' if rz_to else 'failed'}: "
-                    f"{rz_err}")
-        if not out["models"] and not out["attention"]:
+        from vodascheduler_tpu.benchrunner import (
+            BenchOrchestrator,
+            to_hardware_section,
+        )
+        points = _registered_points()
+        # 3600s: the r5 point list (6 model points incl. two af compiles
+        # + 4 moe variants + attention sweep) measures ~38 min over the
+        # tunnel. Resize adds its own budget — it runs last and must not
+        # be squeezed out by a slow measurement phase.
+        budget = float(os.environ.get("VODA_BENCH_HW_TIMEOUT", "3600"))
+        if any(p.kind == "resize" for p in points):
+            budget += float(os.environ.get("VODA_BENCH_RESIZE_TIMEOUT",
+                                           "2400"))
+        cache_path, journal_path = _benchrunner_paths(repo_dir)
+        orch = BenchOrchestrator(
+            points, repo_dir=repo_dir,
+            cache_path=cache_path, journal_path=journal_path,
+            total_budget_seconds=budget)
+        summary = orch.run()
+        out = to_hardware_section(summary)
+        if summary["stats"]["measured"] == 0:
             # Nothing measured at all: a flaked tunnel, not a slow point.
-            # The cached last-good numbers are strictly more informative.
+            # The whole-section last-good fallback is strictly more
+            # informative than a sheet of skipped rows.
+            reasons = sorted({r["provenance"] for r in summary["rows"]
+                              if not r["provenance"].startswith("measured")})
             return _cached_fallback(
-                repo_dir, out.get("error", "hardware bench produced "
-                                           "no points"))
-        if "error" not in out:
-            write_last_good(repo_dir, out)
+                repo_dir, f"no point measured ({'; '.join(reasons)[:300]})")
+        write_last_good(repo_dir, out)
         return out
     except Exception as e:  # noqa: BLE001 - report, don't die
         return _cached_fallback(repo_dir, f"{type(e).__name__}: {e}")

@@ -146,76 +146,90 @@ def _bench_module():
     return bench
 
 
-def _install_fake_hwbench(tmp_path, tail: str) -> None:
-    """Stand in for the hwbench module under tmp_path: emit two points,
-    then run `tail` (the scenario under test)."""
-    import textwrap
-    fake_pkg = tmp_path / "vodascheduler_tpu" / "runtime"
-    fake_pkg.mkdir(parents=True)
-    (tmp_path / "vodascheduler_tpu" / "__init__.py").write_text("")
-    (fake_pkg / "__init__.py").write_text("")
-    (fake_pkg / "hwbench.py").write_text(textwrap.dedent("""
-        import json, sys, time
-        print(json.dumps({"kind": "meta", "data": {"backend": "fake"}}),
-              flush=True)
-        print(json.dumps({"kind": "model", "data": {"model": "m1",
-              "step_time_ms": 1.0}}), flush=True)
-    """) + textwrap.dedent(tail))
-
-
-def _watchdog_env(monkeypatch, timeout: str, stall: str) -> None:
-    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+def _debug_points(monkeypatch, bench, tmp_path, points):
+    """Route maybe_hardware through the benchrunner with an injected
+    point registry and tmp-path persistence (cache/journal/last-good),
+    with the accelerator probe stubbed out."""
+    import json
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
-    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", timeout)
-    monkeypatch.setenv("VODA_BENCH_HW_STALL_TIMEOUT", stall)
-    monkeypatch.setenv("VODA_BENCH_HW_PROBE_TIMEOUT", "120")
+    monkeypatch.setenv("VODA_BENCH_POINTS_JSON", json.dumps(points))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda repo_dir: ("cpu", None))
+    # Absolute paths win the os.path.join(repo_dir, ...) inside
+    # maybe_hardware, so persistence lands in tmp_path while the workers
+    # keep the real repo as cwd (they import the real package).
+    monkeypatch.setattr(bench, "BENCHRUNNER_CACHE",
+                        os.fspath(tmp_path / "cache.json"))
+    monkeypatch.setattr(bench, "BENCHRUNNER_JOURNAL",
+                        os.fspath(tmp_path / "journal.jsonl"))
+    monkeypatch.setattr(bench, "LAST_GOOD_CACHE",
+                        os.fspath(tmp_path / "doc" / "last_good.json"))
 
 
-def test_timeout_salvage_drains_flushed_lines(tmp_path, monkeypatch):
-    """The wedge scenario end-to-end: the hwbench child flushes points,
-    then hangs; maybe_hardware must kill it and keep every flushed point
-    (Popen + post-kill drain — subprocess.run() discards the pipe on
-    POSIX timeouts). Killed via the STALL watchdog with a 12s window:
-    the stall clock does still run during child startup (last_line is
-    initialized at Popen), so this is a margin bump, not immunity — the
-    original 5s hard deadline flaked when slow startup under host load
-    (a concurrent chip-attached capture) ate the whole budget before
-    the two points landed; 12s of pure startup is far past anything
-    observed."""
+def test_wedged_point_is_skipped_and_stream_continues(tmp_path, monkeypatch):
+    """The acceptance scenario end-to-end through bench.maybe_hardware:
+    a wedged point (hang in its own subprocess — on the real chip a
+    compile blocked in native code no signal can interrupt) is killed by
+    the per-point watchdog, every OTHER point still measures, and the
+    emitted section tags every registered row with no whole-stream stall
+    error — the failure mode that cost r5 its _af/llama_1b/attention/
+    MoE/resize rows."""
     bench = _bench_module()
-    _install_fake_hwbench(tmp_path, "time.sleep(600)  # the wedge\n")
-    _watchdog_env(monkeypatch, timeout="300", stall="12")
-    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    _debug_points(monkeypatch, bench, tmp_path, [
+        {"point_id": "meta", "kind": "debug", "section": "meta", "risk": -1,
+         "spec": {"behavior": "ok", "data": {"backend": "fake"}}},
+        {"point_id": "model:m1:b8", "kind": "debug", "section": "model",
+         "spec": {"behavior": "ok",
+                  "data": {"model": "m1", "step_time_ms": 1.0}}},
+        {"point_id": "model:wedge:b16", "kind": "debug", "section": "model",
+         "risk": 5, "timeout_seconds": 2,
+         "spec": {"behavior": "hang", "seconds": 600}},
+        {"point_id": "resize:m1:b8", "kind": "debug", "section": "resize",
+         "risk": 9,
+         "spec": {"behavior": "ok",
+                  "data": {"model": "m1", "resize_cost_seconds": 4.0}}},
+    ])
     out = bench.maybe_hardware()
-    assert out is not None
-    assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
+    assert out is not None and "error" not in out, out
     assert out["backend"] == "fake"
-    # Specifically the STALL watchdog's message — the hard-deadline
-    # branch has its own test below.
-    assert "stalled" in out.get("error", ""), out
+    by_model = {m["model"]: m for m in out["models"] if "model" in m}
+    assert by_model["m1"]["provenance"] == "measured"
+    wedge = [m for m in out["models"] if m.get("point_id")
+             == "model:wedge:b16"][0]
+    assert wedge["provenance"].startswith("skipped:watchdog_timeout")
+    # The wedge did NOT take the later (riskier) resize point with it.
+    assert out["resize"][0]["provenance"] == "measured"
+    assert out["benchrunner"]["stats"] == {"total": 4, "measured": 3,
+                                           "cached": 0, "skipped": 1}
 
 
-def test_hard_deadline_kills_still_streaming_child(tmp_path, monkeypatch):
-    """The other watchdog: a child that never stalls (keeps flushing
-    heartbeat lines) but runs past VODA_BENCH_HW_TIMEOUT must be killed
-    by the hard deadline, keeping completed points. The 0.25s heartbeats
-    pin the stall clock, so only the hard-deadline branch can fire — and
-    the 15s deadline leaves 3× the startup margin that flaked at 5s."""
+def test_budget_exhaustion_tags_tail_and_keeps_head(tmp_path, monkeypatch):
+    """The overall VODA_BENCH_HW_TIMEOUT budget: when a slow point eats
+    it, the riskier tail points are tagged budget_exhausted (or killed by
+    the clamped watchdog) — completed points are kept, nothing is
+    silently absent."""
     bench = _bench_module()
-    _install_fake_hwbench(tmp_path, """
-        while True:  # never stalls, never finishes
-            print(json.dumps({"kind": "tick", "data": {}}), flush=True)
-            time.sleep(0.25)
-    """)
-    _watchdog_env(monkeypatch, timeout="15", stall="300")
-    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    _debug_points(monkeypatch, bench, tmp_path, [
+        {"point_id": "model:fast:b8", "kind": "debug", "section": "model",
+         "spec": {"behavior": "ok", "data": {"model": "fast",
+                                             "step_time_ms": 1.0}}},
+        {"point_id": "model:hog:b8", "kind": "debug", "section": "model",
+         "risk": 5, "spec": {"behavior": "hang", "seconds": 600}},
+        {"point_id": "model:tail:b8", "kind": "debug", "section": "model",
+         "risk": 9, "spec": {"behavior": "ok", "data": {"model": "tail"}}},
+    ])
+    # 6s total: the hog's own 60s debug timeout is clamped to the
+    # remaining budget, so it dies at ~5.5s and the tail point finds
+    # less than the 5s spawn floor left.
+    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "6")
     out = bench.maybe_hardware()
-    assert out is not None
-    assert out["models"] == [{"model": "m1", "step_time_ms": 1.0}]
-    assert out["backend"] == "fake"
-    err = out.get("error", "")
-    assert "exceeded 15s" in err and "killed" in err, out
-    assert "stalled" not in err, out
+    assert out is not None and "error" not in out, out
+    rows = {m.get("model") or m.get("point_id"): m for m in out["models"]}
+    assert rows["fast"]["provenance"] == "measured"
+    assert rows["model:hog:b8"]["provenance"].startswith(
+        "skipped:watchdog_timeout")
+    assert rows["model:tail:b8"]["provenance"].startswith(
+        "skipped:budget_exhausted")
 
 
 def _redirect_repo_dir(monkeypatch, bench, tmp_path):
@@ -283,24 +297,54 @@ def test_probe_retries_then_succeeds(monkeypatch, tmp_path):
 
 
 def test_successful_run_writes_last_good_cache(tmp_path, monkeypatch):
-    """A clean hardware run must refresh doc/benchmarks_last_good.json so
-    the NEXT flaked round has something to fall back on."""
+    """A clean hardware run must refresh the last-good cache so the NEXT
+    dead-tunnel round has something to fall back on — measured rows only
+    (a skipped row is not evidence)."""
     import json
 
     bench = _bench_module()
-    _install_fake_hwbench(tmp_path, "")  # clean exit after the points
-    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
-    monkeypatch.setenv("VODA_BENCH_HW_TIMEOUT", "60")
-    monkeypatch.setenv("VODA_BENCH_RESIZE", "0")  # fake tree has no module
-    _redirect_repo_dir(monkeypatch, bench, tmp_path)
+    _debug_points(monkeypatch, bench, tmp_path, [
+        {"point_id": "model:m1:b8", "kind": "debug", "section": "model",
+         "spec": {"behavior": "ok",
+                  "data": {"model": "m1", "step_time_ms": 1.0}}},
+        {"point_id": "model:bad:b8", "kind": "debug", "section": "model",
+         "risk": 5, "spec": {"behavior": "fail", "message": "boom"}},
+    ])
     out = bench.maybe_hardware()
     assert "error" not in out, out
-    cache = json.loads(
-        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    cache = json.loads((tmp_path / "doc" / "last_good.json").read_text())
     assert cache["hardware"]["models"] == [{"model": "m1",
-                                            "step_time_ms": 1.0}]
+                                            "step_time_ms": 1.0,
+                                            "provenance": "measured"}]
     assert cache["captured_at"]
+
+
+def test_cached_backfill_rows_do_not_refresh_last_good(tmp_path, monkeypatch):
+    """A row back-filled from the benchrunner cache (cached_from tag)
+    must NOT be re-cached as fresh last-good evidence — its timestamp
+    would renew forever."""
+    from vodascheduler_tpu.benchrunner import point_from_dict
+    from vodascheduler_tpu.benchrunner.cache import ResultCache
+
+    bench = _bench_module()
+    flaky = {"point_id": "model:flaky:b8", "kind": "debug",
+             "section": "model", "risk": 5,
+             "spec": {"behavior": "fail", "message": "transient"}}
+    _debug_points(monkeypatch, bench, tmp_path, [
+        {"point_id": "model:m1:b8", "kind": "debug", "section": "model",
+         "spec": {"behavior": "ok",
+                  "data": {"model": "m1", "step_time_ms": 1.0}}},
+        flaky,
+    ])
+    seed = ResultCache(os.fspath(tmp_path / "cache.json"))
+    seed.put("model:flaky:b8", point_from_dict(flaky).config_hash(),
+             {"model": "flaky", "step_time_ms": 9.0})
+    out = bench.maybe_hardware()
+    by_model = {m.get("model"): m for m in out["models"]}
+    assert by_model["flaky"]["provenance"].startswith("cached_from:")
+    import json
+    cache = json.loads((tmp_path / "doc" / "last_good.json").read_text())
+    assert [m["model"] for m in cache["hardware"]["models"]] == ["m1"]
 
 
 def test_cache_write_drops_error_rows_and_keeps_prior_on_empty(tmp_path):
@@ -354,3 +398,25 @@ def test_cache_write_drops_error_rows_and_keeps_prior_on_empty(tmp_path):
     cache2 = json.loads(
         (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
     assert cache2["hardware"]["models"] == [{"model": "m1", "mfu": 0.4}]
+
+    # Provenance-tagged rows: cached_from/skipped moe + resize rows must
+    # not become last-good evidence either (a cached row carries its
+    # live failure under live_error, not error — the key filter alone
+    # would let it renew its timestamp forever).
+    tagged = {"models": [{"model": "m1", "mfu": 0.4,
+                          "provenance": "measured"}],
+              "moe": {"gather": {"step_time_ms": 1.0},
+                      "provenance": "cached_from:2026-07-30T05:30:00Z",
+                      "live_error": "watchdog"},
+              "resize": [{"model": "m1", "resize_cost_seconds": 9.0,
+                          "provenance": "measured"},
+                         {"model": "m2", "resize_cost_seconds": 8.0,
+                          "provenance": "cached_from:2026-07-30T05:30:00Z",
+                          "live_error": "watchdog"},
+                         {"model": "m3",
+                          "provenance": "skipped:budget_exhausted"}]}
+    bench.write_last_good(str(tmp_path), tagged)
+    cache3 = json.loads(
+        (tmp_path / "doc" / "benchmarks_last_good.json").read_text())
+    assert "moe" not in cache3["hardware"]
+    assert [r["model"] for r in cache3["hardware"]["resize"]] == ["m1"]

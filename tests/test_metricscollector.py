@@ -36,6 +36,9 @@ class TestCsvLogger:
         rows = src.rows("job-a")
         assert [r.epoch for r in rows] == [0, 1, 2]
         assert rows[2].workers == 8
+        # The real step_time_sec column round-trips (collector ingests it
+        # into info.step_seconds, not a derived alias).
+        assert [r.step_time_sec for r in rows] == [0.125, 0.110, 0.06]
 
 
 class TestCollectorMath:
@@ -95,6 +98,57 @@ class TestCollectorMath:
         collector = MetricsCollector(store, self._source(rows))
         assert collector.collect_all() == 1
         assert collector.collect_all() == 0  # same newest epoch -> skip
+
+    def test_step_times_ingested_and_curves_diverge(self):
+        """The CSV's real step_time_sec feeds info.step_seconds (reference
+        metrics_collector.py:131-141 ingests both columns). Epoch time
+        carries a fixed per-epoch overhead (eval/checkpoint) of 10s here,
+        so the epoch curve shows sublinear speedup while the pure step
+        curve scales perfectly — the two must diverge."""
+        store, name = self._store_with_job()
+        # 100 steps/epoch at 1 worker: step 1.0s -> compute 100s + 10s
+        # fixed = 110s. At 4 workers: step 0.25s -> 25s + 10s = 35s.
+        rows = [
+            MetricsRow(name, 0, 110.0, 1, 0, step_time_sec=1.0),
+            MetricsRow(name, 1, 35.0, 4, 0, step_time_sec=0.25),
+        ]
+        collector = MetricsCollector(store, self._source(rows))
+        assert collector.collect_all() == 1
+        info = store.get_job_info(name)
+        assert info.step_seconds[1] == 1.0
+        assert info.step_seconds[4] == 0.25
+        assert info.epoch_seconds[4] == 35.0
+        step_speedup = info.step_seconds[1] / info.step_seconds[4]
+        epoch_speedup = info.epoch_seconds[1] / info.epoch_seconds[4]
+        assert abs(step_speedup - 4.0) < 1e-9
+        assert abs(epoch_speedup - 110.0 / 35.0) < 1e-9
+        assert step_speedup > epoch_speedup + 0.5  # genuinely diverged
+
+    def test_step_times_fall_back_to_epoch_when_unreported(self):
+        """Rows without a step measurement (step_time_sec 0.0 — e.g. the
+        fake backend's simulated telemetry) keep the derived behavior."""
+        store, name = self._store_with_job()
+        rows = [MetricsRow(name, 0, 40.0, 2, 0)]
+        collector = MetricsCollector(store, self._source(rows))
+        collector.collect_all()
+        info = store.get_job_info(name)
+        assert info.step_seconds[2] == info.epoch_seconds[2] == 40.0
+
+    def test_mixed_reported_and_unreported_step_rows(self):
+        """A count with SOME step measurements averages only those; a
+        count with none falls back — per-count, not all-or-nothing."""
+        store, name = self._store_with_job()
+        rows = [
+            MetricsRow(name, 0, 50.0, 2, 0, step_time_sec=0.5),
+            MetricsRow(name, 1, 54.0, 2, 0),             # sensor gap
+            MetricsRow(name, 2, 30.0, 4, 0),             # no step source
+        ]
+        collector = MetricsCollector(store, self._source(rows))
+        collector.collect_all()
+        info = store.get_job_info(name)
+        assert info.step_seconds[2] == 0.5     # mean of reported only
+        assert info.epoch_seconds[2] == 52.0
+        assert info.step_seconds[4] == 30.0    # fallback to epoch
 
 
 class TestClosedLoop:

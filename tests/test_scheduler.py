@@ -233,6 +233,140 @@ class TestResume:
         assert a in backend.running_jobs()
 
 
+class SimulatedCrash(BaseException):
+    """kill -9 stand-in: a BaseException sails past every `except
+    Exception` isolation layer in the scheduler, so the process dies with
+    whatever the backend and store had durably absorbed — exactly the
+    state a real SIGKILL leaves behind."""
+
+
+def _assert_no_double_booking(backend, sched):
+    """Backend truth: per-host booked chips never exceed capacity, and
+    the scheduler's books match the backend's live view."""
+    hosts = backend.list_hosts()
+    booked = {h: 0 for h in hosts}
+    live = backend.running_jobs()
+    for handle in live.values():
+        for host, workers in handle.placements:
+            if host in booked:
+                booked[host] += workers
+    for host, used in booked.items():
+        assert used <= hosts[host], (
+            f"host {host} double-booked: {used}/{hosts[host]}")
+    total = sum(hosts.values())
+    assert sum(sched.job_num_chips.values()) <= total
+    for name, handle in live.items():
+        if name in sched.job_num_chips:
+            assert sched.job_num_chips[name] == handle.num_workers, (
+                f"{name}: booked {sched.job_num_chips[name]} vs live "
+                f"{handle.num_workers}")
+
+
+@pytest.mark.slow
+class TestCrashConsistency:
+    def test_kill_mid_resched_under_event_storm_then_resume(self, tmp_path):
+        """Crash-consistency proof for the single-replica control plane
+        (reference: constructStatusOnRestart, scheduler.go:1009-1072 +
+        helm resumeEnabled): the scheduler is killed MID-RESCHED — after
+        the backend realized some of the pass's starts but before the
+        rest — under an event storm (job churn + host churn). A fresh
+        scheduler resuming from the durable store and the backend's live
+        view must come back with no double-booked chips and no stranded
+        jobs: every job still runs to completion."""
+        from vodascheduler_tpu.common.store import FileJobStore
+
+        clock = VirtualClock(start=1753760000.0)
+        store_path = str(tmp_path / "jobs.json")
+        store = FileJobStore(store_path)  # autoflush: durable per update
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=2.0)
+        for i in range(4):
+            backend.add_host(f"host-{i}", 4, announce=False)
+        backend.register_profile("j", WorkloadProfile(epoch_seconds_at_1=50.0))
+        clock2, store2, bus, backend2, sched, admission = build_world(
+            store=store, backend=backend, clock=clock, rate_limit=5.0)
+        assert backend2 is backend and clock2 is clock
+
+        # Arm the crash: the 12th start/scale the backend REALIZES kills
+        # the control plane right after the pods exist — the classic
+        # torn-apply window (bookkeeping for later starts never happens).
+        # By call 12 the storm has seen arrivals, elastic resizes AND the
+        # host-churn events below.
+        calls = {"n": 0}
+        real_start, real_scale = backend.start_job, backend.scale_job
+
+        def crashing_start(spec, n, placements=None):
+            real_start(spec, n, placements)
+            calls["n"] += 1
+            if calls["n"] == 12:
+                raise SimulatedCrash()
+
+        def crashing_scale(name, n, placements=None):
+            real_scale(name, n, placements)
+            calls["n"] += 1
+            if calls["n"] == 12:
+                raise SimulatedCrash()
+
+        backend.start_job = crashing_start
+        backend.scale_job = crashing_scale
+
+        # The event storm: a dozen jobs arriving in waves while a host
+        # dies and returns — every wave triggers rescheds.
+        crashed = False
+        created = []
+        try:
+            for i in range(12):
+                created.append(admission.create_training_job(spec(
+                    f"j-{i:02d}", min_chips=1, max_chips=4, epochs=3)))
+                clock.advance(3.0)
+                if i == 2:
+                    backend.remove_host("host-3")
+                if i == 4:
+                    backend.add_host("host-3", 4)
+        except SimulatedCrash:
+            crashed = True
+        assert crashed, "the storm never reached the crash point"
+        sched.stop()  # the dead process runs no more timers
+        backend.start_job, backend.scale_job = real_start, real_scale
+
+        # Workers keep training while the control plane is down (pods
+        # don't die with the scheduler); time passes before the restart.
+        clock.advance(30.0)
+
+        # Resume: fresh store loaded from disk, fresh placement manager
+        # rebuilt from the backend's live placements, same cluster.
+        store_resumed = FileJobStore(store_path)
+        pm = PlacementManager("pool")
+        for h, c in backend.list_hosts().items():
+            pm.add_host(h, c)
+        sched2 = Scheduler("pool", backend, store_resumed,
+                           ResourceAllocator(store_resumed), clock,
+                           placement_manager=pm, algorithm="ElasticFIFO",
+                           rate_limit_seconds=5.0, resume=True)
+
+        # Every job admitted before the crash is durably known (the jobs
+        # after the crash point were never submitted — the client died
+        # with the process) and accounted for — ready or done, never
+        # lost.
+        known = {j.name for j in store_resumed.list_jobs(pool="pool")}
+        assert known == set(created)
+        tracked = set(sched2.ready_jobs) | set(sched2.done_jobs)
+        assert known == tracked
+        _assert_no_double_booking(backend, sched2)
+
+        # No stranded jobs: everything runs to completion under the
+        # resumed scheduler, with the booking invariant held throughout.
+        for _ in range(80):
+            clock.advance(50.0)
+            _assert_no_double_booking(backend, sched2)
+            jobs = store_resumed.list_jobs(pool="pool")
+            if all(j.status == JobStatus.COMPLETED for j in jobs):
+                break
+        jobs = store_resumed.list_jobs(pool="pool")
+        incomplete = [j.name for j in jobs if j.status != JobStatus.COMPLETED]
+        assert not incomplete, f"stranded jobs after resume: {incomplete}"
+        assert len(jobs) == len(created) >= 5
+
+
 class TestMetricsAccounting:
     def test_waiting_and_running_seconds_accrue(self):
         clock, store, bus, backend, sched, admission = build_world(

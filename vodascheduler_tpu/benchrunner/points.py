@@ -1,0 +1,160 @@
+"""Benchmark point registry: what the orchestrator measures, and in what
+order.
+
+A *point* is one self-contained measurement (one model row, one attention
+shape, the MoE dispatch sweep, one resize breakdown, or the device meta
+probe). Each point runs in its own killable subprocess (worker.py), so the
+unit of failure is the point — a wedged XLA compile costs exactly one row,
+never the stream (r5 lost llama_350m_af, llama_1b, attention, MoE and
+resize to a single wedge in the monolithic `hwbench --stream` child).
+
+Risk ordering: points are scheduled cheapest-to-riskiest, so when the
+overall budget runs out — or a wedge eats a point's whole timeout — the
+points already measured are the well-understood ones and the casualties
+are the speculative compiles at the tail. The risk model is a small
+heuristic over the registry names the rounds have burned chips on:
+adafactor/dots_attn recompiles, long-context, ≥1B-param OOM candidates,
+and past-saturation batch probes are all riskier than the known-good
+flagship row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+# Worker wire protocol: the one prefixed JSON result line a point's
+# subprocess prints (lives here, not in worker.py, so importing the
+# package never preloads the `-m`-executed worker module — runpy warns
+# about that).
+RESULT_PREFIX = "VODA_BENCHPOINT_RESULT "
+
+# Per-kind default watchdog budgets (seconds). Overridable per point.
+DEFAULT_TIMEOUTS: Dict[str, float] = {
+    "meta": 300.0,       # jax import + backend init over the tunnel
+    "model": 900.0,      # one compile + the two-point scan measurement
+    "attention": 900.0,  # two kernels (flash + XLA), fwd+bwd each
+    "moe": 1800.0,       # four dispatch-variant compiles in one point
+    "resize": 2400.0,    # two sequential children incl. a cold start
+    "debug": 60.0,       # test scaffolding
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchPoint:
+    """One isolated benchmark measurement.
+
+    `spec` must be JSON-serializable: it crosses the process boundary to
+    worker.py verbatim, and its canonical serialization is the cache key
+    (a cached row may only back-fill a point measured under the *same*
+    configuration).
+    """
+
+    point_id: str
+    kind: str                      # meta | model | attention | moe | resize | debug
+    spec: Mapping[str, Any]
+    risk: int = 0                  # higher = riskier; riskiest run LAST
+    timeout_seconds: Optional[float] = None
+    # Which artifact section the row lands in (to_hardware_section);
+    # defaults to the kind. Debug points use it to emulate production
+    # rows — the dryrun's artifact has the production shape without
+    # touching jax. Presentation only: not part of the config hash.
+    section: Optional[str] = None
+
+    @property
+    def timeout(self) -> float:
+        if self.timeout_seconds is not None:
+            return self.timeout_seconds
+        return DEFAULT_TIMEOUTS.get(self.kind, 900.0)
+
+    def config_hash(self) -> str:
+        """Cache key half: identical (kind, spec) ⇒ identical hash."""
+        payload = json.dumps({"kind": self.kind, "spec": dict(self.spec)},
+                             sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    @property
+    def effective_section(self) -> str:
+        return self.section or self.kind
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"point_id": self.point_id, "kind": self.kind,
+                "spec": dict(self.spec), "risk": self.risk,
+                "timeout_seconds": self.timeout_seconds,
+                "section": self.section}
+
+
+def point_from_dict(d: Mapping[str, Any]) -> BenchPoint:
+    return BenchPoint(point_id=d["point_id"], kind=d["kind"],
+                      spec=dict(d.get("spec", {})),
+                      risk=int(d.get("risk", 0)),
+                      timeout_seconds=d.get("timeout_seconds"),
+                      section=d.get("section"))
+
+
+def ordered(points: Sequence[BenchPoint]) -> List[BenchPoint]:
+    """Risk-ascending, registration-order stable within a risk tier."""
+    return [p for _, _, p in sorted(
+        (p.risk, i, p) for i, p in enumerate(points))]
+
+
+def model_risk(model_name: str, batch: int) -> int:
+    """Heuristic compile/OOM risk for a model point (see module doc)."""
+    risk = 10
+    if model_name.endswith("_af"):
+        risk += 10       # adafactor + dots_attn save-set: fresh compile
+    if "8k" in model_name:
+        risk += 15       # long context: flash kernel at S=8192
+    if "1b" in model_name or "8b" in model_name:
+        risk += 25       # ≥1B params on a 16 GB chip: the OOM magnet
+    if batch >= 16:
+        risk += 10       # past-saturation batch probe
+    return risk
+
+
+def attention_risk(batch: int, seq: int) -> int:
+    return 15 + (10 if seq >= 8192 else 0)
+
+
+def default_registry(
+        model_points: Sequence[Tuple[str, int]] = (),
+        attention_points: Optional[Sequence[Tuple[int, int]]] = None,
+        moe_batch: Optional[int] = 8,
+        resize_points: Sequence[Tuple[str, int]] = (),
+) -> List[BenchPoint]:
+    """The production point set for bench.py's hardware section.
+
+    attention_points=None inherits hwbench.DEFAULT_ATTENTION_POINTS — one
+    canonical sweep definition, no drift (the import is deferred so debug
+    registries never pay for jax).
+    """
+    points: List[BenchPoint] = [
+        BenchPoint("meta", "meta", {}, risk=-100),
+    ]
+    for model, batch in model_points:
+        points.append(BenchPoint(
+            f"model:{model}:b{batch}", "model",
+            {"model_name": model, "global_batch_size": batch},
+            risk=model_risk(model, batch)))
+    if attention_points is None:
+        from vodascheduler_tpu.runtime.hwbench import DEFAULT_ATTENTION_POINTS
+        attention_points = DEFAULT_ATTENTION_POINTS
+    for batch, seq in attention_points:
+        points.append(BenchPoint(
+            f"attention:b{batch}:s{seq}", "attention",
+            {"batch": batch, "seq": seq},
+            risk=attention_risk(batch, seq)))
+    if moe_batch:
+        points.append(BenchPoint(
+            f"moe:b{moe_batch}", "moe", {"global_batch_size": moe_batch},
+            risk=40))
+    for model, batch in resize_points:
+        # Resize spawns its own chip-claiming children; it must run after
+        # every in-process measurement has exited, i.e. last.
+        points.append(BenchPoint(
+            f"resize:{model}:b{batch}", "resize",
+            {"model_name": model, "global_batch_size": batch},
+            risk=60))
+    return ordered(points)

@@ -1,0 +1,193 @@
+"""One benchmark point in one killable subprocess.
+
+The orchestrator spawns
+    python -m vodascheduler_tpu.benchrunner.worker '<point json>'
+per point. The contract is one prefixed JSON result line on stdout:
+
+    VODA_BENCHPOINT_RESULT {"point_id": ..., "data": {...}}        (success)
+    VODA_BENCHPOINT_RESULT {"point_id": ..., "error": "..."}       (ran, failed)
+
+A wedged point prints nothing — the parent's watchdog kills it and tags
+the row `skipped:watchdog_timeout`. Running in a child is the whole
+design: a wedged remote XLA compile blocks inside native code holding the
+GIL where no in-process signal can interrupt it (observed live in r3),
+but SIGKILL from outside always works, and the blast radius is one point.
+
+Heavy imports (jax, the model zoo) happen inside per-kind handlers, never
+at module scope: debug points (test scaffolding and the fake-backend
+dryrun) must cost only interpreter startup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Mapping, Optional
+
+from vodascheduler_tpu.benchrunner.points import RESULT_PREFIX
+
+
+def _configure_jax_platform() -> None:
+    """Honor JAX_PLATFORMS=cpu even when a TPU plugin registered itself
+    eagerly (the axon tunnel does) — the config API call wins over the
+    env var alone. Same workaround as __graft_entry__.py."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _require_accelerator() -> str:
+    """The hardware bench must never silently 'measure' a CPU; the tests'
+    escape hatch is explicit (same contract as run_hardware_bench)."""
+    import jax
+    backend = jax.default_backend()
+    if backend not in ("tpu", "gpu") and not os.environ.get(
+            "VODA_HWBENCH_ON_CPU"):
+        raise RuntimeError(
+            f"hardware bench point requires an accelerator "
+            f"(backend={backend}); set VODA_HWBENCH_ON_CPU=1 to "
+            "smoke-test on CPU")
+    return backend
+
+
+def _telemetry() -> Optional[Dict[str, Any]]:
+    """Per-point chip telemetry. Because each point is its own process,
+    `peak_bytes_in_use` here IS the point's peak HBM — telemetry scoped
+    to the measurement, not smeared across the whole stream."""
+    if os.environ.get("VODA_BENCH_TELEMETRY", "1") == "0":
+        return None
+    try:
+        from vodascheduler_tpu.runtime.tpu_monitor import telemetry_snapshot
+        snap = telemetry_snapshot()
+        return snap or None
+    except Exception:  # noqa: BLE001 - telemetry must never fail a point
+        return None
+
+
+def _run_meta(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    _configure_jax_platform()
+    backend = _require_accelerator()
+    import jax
+    from vodascheduler_tpu.runtime.hwbench import peak_flops_per_device
+    return {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": backend,
+        "peak_bf16_tflops_per_chip": peak_flops_per_device() / 1e12,
+    }
+
+
+def _run_model(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    _configure_jax_platform()
+    _require_accelerator()
+    from vodascheduler_tpu.runtime.hwbench import bench_model_step
+    try:
+        return bench_model_step(**spec).as_dict()
+    except Exception as e:  # noqa: BLE001
+        # Retry on the XLA attention path: a Pallas-kernel failure should
+        # still yield a measured MFU number (same salvage as the old
+        # run_hardware_bench loop). Both errors are kept — the retry's
+        # OOM can otherwise mask a trivial flash-path bug (r5).
+        os.environ["VODA_FLASH_ATTENTION"] = "0"
+        try:
+            res = bench_model_step(**spec).as_dict()
+            res["note"] = (f"flash path failed "
+                           f"({type(e).__name__}: {str(e)[:300]}); "
+                           f"XLA attention")
+            return res
+        except Exception as e2:  # noqa: BLE001
+            raise RuntimeError(
+                f"{type(e2).__name__}: {str(e2)[:300]} "
+                f"[flash path: {type(e).__name__}: {str(e)[:300]}]"
+            ) from e2
+        finally:
+            os.environ.pop("VODA_FLASH_ATTENTION", None)
+
+
+def _run_attention(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    _configure_jax_platform()
+    _require_accelerator()
+    from vodascheduler_tpu.runtime.hwbench import bench_attention_point
+    return bench_attention_point(**spec)
+
+
+def _run_moe(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    _configure_jax_platform()
+    _require_accelerator()
+    from vodascheduler_tpu.runtime.hwbench import bench_moe_dispatch
+    out = bench_moe_dispatch(**spec)
+    # bench_moe_dispatch isolates per-variant failures internally; if NO
+    # variant measured, the point must not masquerade as `measured` —
+    # surface the first variant error so the orchestrator tags it
+    # skipped:point_error (and cache back-fill can kick in).
+    errors = [v for v in out.values()
+              if isinstance(v, dict) and "error" in v]
+    if errors and len(errors) == len(out):
+        raise RuntimeError(f"every moe variant failed: {errors[0]['error']}")
+    return out
+
+
+def _run_resize(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    # resize_bench spawns its own measurement children (a restart IS a
+    # fresh process); they enforce the accelerator contract themselves.
+    from vodascheduler_tpu.runtime.resize_bench import bench_resize_cost
+    return bench_resize_cost(**spec)
+
+
+def _run_debug(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    """Test scaffolding: behaviors that exercise every orchestrator path
+    without importing jax. `hang` emulates the wedged-compile scenario —
+    a sleep the watchdog must kill from outside."""
+    behavior = spec.get("behavior", "ok")
+    if behavior == "ok":
+        return dict(spec.get("data", {"ok": True}))
+    if behavior == "slow":
+        time.sleep(float(spec.get("seconds", 1.0)))
+        return dict(spec.get("data", {"ok": True}))
+    if behavior == "hang":
+        time.sleep(float(spec.get("seconds", 3600.0)))
+        return {"unreachable": True}
+    if behavior == "fail":
+        raise RuntimeError(spec.get("message", "injected point failure"))
+    raise ValueError(f"unknown debug behavior {behavior!r}")
+
+
+_HANDLERS = {
+    "meta": _run_meta,
+    "model": _run_model,
+    "attention": _run_attention,
+    "moe": _run_moe,
+    "resize": _run_resize,
+    "debug": _run_debug,
+}
+
+
+def run_point(kind: str, spec: Mapping[str, Any]) -> Dict[str, Any]:
+    handler = _HANDLERS.get(kind)
+    if handler is None:
+        raise ValueError(f"unknown point kind {kind!r}")
+    return handler(spec)
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 1:
+        print("usage: python -m vodascheduler_tpu.benchrunner.worker "
+              "'<point json>'", file=sys.stderr)
+        raise SystemExit(2)
+    point = json.loads(args[0])
+    out: Dict[str, Any] = {"point_id": point.get("point_id", "?")}
+    try:
+        out["data"] = run_point(point["kind"], point.get("spec", {}))
+        if point["kind"] not in ("debug", "meta"):
+            telem = _telemetry()
+            if telem:
+                out["telemetry"] = telem
+    except Exception as e:  # noqa: BLE001 - report, don't die silently
+        out["error"] = f"{type(e).__name__}: {str(e)[:500]}"
+    print(f"{RESULT_PREFIX}{json.dumps(out)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -55,13 +55,18 @@ class WorkloadProfile:
 
 @dataclasses.dataclass
 class MetricsRow:
-    """One epoch's telemetry (reference CSV columns, callbacks.py:104-154)."""
+    """One epoch's telemetry (reference CSV columns, callbacks.py:104-154).
+
+    step_time_sec is the trainer-reported mean step time for the epoch
+    (CSV column `step_time_sec`); 0.0 means "not reported" and the
+    collector falls back to deriving step curves from epoch time."""
 
     job: str
     epoch: int
     epoch_time_sec: float
     workers: int
     timestamp: float
+    step_time_sec: float = 0.0
 
 
 @dataclasses.dataclass

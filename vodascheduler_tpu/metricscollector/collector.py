@@ -74,6 +74,7 @@ class CsvDirRowSource:
                 epoch_time_sec=float(r["epoch_time_sec"]),
                 workers=int(r["workers"]),
                 timestamp=0.0,
+                step_time_sec=float(r.get("step_time_sec") or 0.0),
             ))
         return out
 
@@ -129,14 +130,29 @@ class MetricsCollector:
         if info.current_epoch == newest_epoch:
             return False  # same epoch, skip (reference :86-88)
 
-        # Mean epoch time per observed worker count (reference :131-141).
+        # Mean epoch AND step time per observed worker count (reference
+        # :131-141 ingests both columns). Step time comes from the CSV's
+        # `step_time_sec` when the trainer reports it; the curves can
+        # legitimately diverge — epoch time carries per-epoch fixed costs
+        # (eval, checkpointing, input-pipeline restarts) that step time
+        # excludes, so step speedup is the honest compute-scaling signal.
+        # Rows without a step measurement (step_time_sec == 0) fall back
+        # to the epoch-derived value for that count.
         by_workers: Dict[int, List[float]] = {}
+        by_workers_step: Dict[int, List[float]] = {}
         for r in rows:
             if r.workers > 0:
                 by_workers.setdefault(r.workers, []).append(r.epoch_time_sec)
+                step = getattr(r, "step_time_sec", 0.0)
+                if step and step > 0:
+                    by_workers_step.setdefault(r.workers, []).append(step)
         for n, times in by_workers.items():
             info.epoch_seconds[n] = sum(times) / len(times)
-            info.step_seconds[n] = info.epoch_seconds[n]  # step source optional
+            steps = by_workers_step.get(n)
+            if steps:
+                info.step_seconds[n] = sum(steps) / len(steps)
+            else:
+                info.step_seconds[n] = info.epoch_seconds[n]
 
         epoch1 = self._epoch_seconds_at_1(info)
         if epoch1 is not None:

@@ -22,8 +22,11 @@ MFU convention: analytic model FLOPs (PaLM appendix B):
 the standard convention, so numbers are comparable to published MFU
 figures). Peak chip FLOP/s comes from the device kind; bf16 peak.
 
-These functions are imported by bench.py (the driver's entry point) and
-runnable standalone:  python -m vodascheduler_tpu.runtime.hwbench
+These measurement functions are driven per-point by the benchrunner
+subsystem (vodascheduler_tpu/benchrunner/worker.py — one killable
+subprocess per point, which is how bench.py consumes them), and the
+module stays runnable standalone:
+    python -m vodascheduler_tpu.runtime.hwbench
 """
 
 from __future__ import annotations
@@ -377,13 +380,15 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         moe_batch: Optional[int] = 8,
         emit: Optional[Callable[[str, Any], None]] = None,
         ) -> Dict[str, Any]:
-    """The full hardware section for bench.py.
+    """The full hardware section in ONE process (standalone mode).
 
     Never simulated: raises off-accelerator unless VODA_HWBENCH_ON_CPU=1
     (tests use that escape hatch with tiny shapes). `emit(kind, payload)`
-    is called after each completed item — the --stream mode bench.py's
-    subprocess isolation relies on (completed points survive even if a
-    later remote compile wedges and the process is killed).
+    is called after each completed item so --stream keeps completed
+    points even if a later remote compile wedges and the process is
+    killed. bench.py no longer drives this loop — it runs each point in
+    its own subprocess via vodascheduler_tpu/benchrunner/, where a wedge
+    costs one point instead of the stream's tail.
     """
     import os
     backend = jax.default_backend()
@@ -451,12 +456,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     """`python -m vodascheduler_tpu.runtime.hwbench [--stream] [args...]`
 
     --stream prints one JSON line per completed item ({"kind", "data"})
-    instead of one pretty dict at the end — bench.py runs this module as
-    a subprocess in stream mode so a wedged remote compile (which blocks
-    in native code where no signal can interrupt) costs only the
-    unfinished points: the parent kills the child at its deadline and
-    keeps every line already flushed. Extra args are a JSON object of
-    run_hardware_bench kwargs (model_points etc.).
+    instead of one pretty dict at the end, so a parent that kills this
+    process at a deadline keeps every line already flushed. Extra args
+    are a JSON object of run_hardware_bench kwargs (model_points etc.).
+    Standalone/diagnostic use only — bench.py captures its hardware
+    section through the per-point benchrunner orchestrator instead.
     """
     import json
     import os

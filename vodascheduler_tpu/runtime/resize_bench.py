@@ -28,6 +28,11 @@ print mark lines, and segments are differences between marks.
 Run: python -m vodascheduler_tpu.runtime.resize_bench '{"points": [["llama_350m", 8]]}'
 Each child honors VODA_HWBENCH_ON_CPU=1 + JAX_PLATFORMS=cpu for hermetic
 tests (tiny models on the CPU platform).
+
+bench.py consumes bench_resize_cost per point through the benchrunner
+orchestrator (one killable subprocess per resize point, provenance-
+tagged rows); the multi-point main() below stays for standalone and
+diagnostic runs.
 """
 
 from __future__ import annotations

@@ -94,6 +94,41 @@ def _read_sdk_metrics() -> dict:
     return out
 
 
+def telemetry_snapshot() -> dict:
+    """One-shot, registry-free telemetry for per-point scoping.
+
+    The benchrunner worker calls this at the end of each benchmark point.
+    Because every point is its own process, the process-lifetime counters
+    (notably `peak_bytes_in_use`) are scoped to exactly that point's
+    measurement — per-point peak HBM, not a peak smeared across a whole
+    monolithic bench stream. Returns {} when nothing is available (no
+    backend, chips owned elsewhere), never raises.
+    """
+    out: dict = {}
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - no backend at all
+        return out
+    mem = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001
+            continue
+        row = {key: float(stats[key]) for key, _ in _STAT_SERIES
+               if key in stats}
+        if row:
+            mem[str(d.id)] = row
+    if mem:
+        out["memory"] = mem
+    sdk = _read_sdk_metrics()
+    if sdk:
+        out["sdk"] = sdk
+    return out
+
+
 class TpuMonitor:
     """Polls local device memory stats into labeled gauges."""
 
