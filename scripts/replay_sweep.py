@@ -2,15 +2,21 @@
 
 Re-derives the rate_limit x hysteresis x cooldown knee and the 8-seed
 robustness panel (doc/benchmarks.md methodology) — required after any
-change to replay pricing or workload simulation. r5's trigger: the
-profile-registration race fix (simulator._submit on_admitted) revealed
-29/64 headline-trace jobs had been simulating the default 60 s-epoch
-toy profile; every earlier sweep ran on that corrupted workload.
+change to replay pricing or workload simulation. r6's trigger: two-tier
+resize pricing (doc/elastic-resize.md) — same-host resizes are now
+in-place live reshards at a fraction of the cold checkpoint-restart
+cost, and in-place resizes no longer re-arm the preemption lease; with
+reconfiguration cheaper, the knee moves to a much faster rate limit
+(45 s -> 15 s: the scheduler can afford to act more often — the
+compounding the motivating reconfiguration-cost papers predict). r5's
+trigger was the profile-registration race fix (simulator._submit
+on_admitted), which revealed 29/64 headline-trace jobs had been
+simulating the default 60 s-epoch toy profile.
 
 Usage:
   python scripts/replay_sweep.py knee    # pinned-seed knob sweep
   python scripts/replay_sweep.py panel   # 8-seed panel at chosen knobs
-  python scripts/replay_sweep.py all     # both; writes doc/replay_sweep_r5.json
+  python scripts/replay_sweep.py all     # both; writes doc/replay_sweep_r6.json
 """
 
 from __future__ import annotations
@@ -84,12 +90,12 @@ def panel(rate: float, hyst: float, cooldown: float) -> list:
 
 # The shipped headline configuration (bench.py) — the panel's knobs when
 # run standalone, and _best's fallback when no sweep cell qualifies.
-SHIPPED_KNEE = dict(rate=15.0, hyst=1.0, cooldown=60.0)
+SHIPPED_KNEE = dict(rate=15.0, hyst=1.5, cooldown=60.0)
 
 
 def _write(out: dict) -> None:
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "doc", "replay_sweep_r5.json")
+        os.path.abspath(__file__))), "doc", "replay_sweep_r6.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", path)

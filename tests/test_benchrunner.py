@@ -262,6 +262,66 @@ class TestSummaryContract:
         assert hw["benchrunner"]["stats"]["skipped"] == 1
 
 
+class TestSilentEmptyAttentionRegression:
+    """BENCH_r05 shipped `attention: []` when the stream wedged — absence
+    indistinguishable from not-configured. The contract now: a wedged
+    point costs its row (skipped:watchdog_timeout), budget exhaustion
+    tags the rest, and EVERY registered attention shape appears in the
+    artifact with its reason, even with no cache to fall back on."""
+
+    SHAPES = [(8, 1024), (8, 4096), (1, 8192)]
+
+    def _attention_points(self, wedge_first: bool):
+        pts = []
+        for i, (b, s) in enumerate(self.SHAPES):
+            # batch/seq ride in the spec like real attention points —
+            # that spec is what identifies a skipped row in the artifact.
+            spec = ({"behavior": "hang", "seconds": 600,
+                     "batch": b, "seq": s}
+                    if (wedge_first and i == 0) else
+                    {"behavior": "ok", "batch": b, "seq": s,
+                     "data": {"batch": b, "seq": s, "flash_ms": 1.0}})
+            pts.append(BenchPoint(f"attention:b{b}:s{s}", "debug", spec,
+                                  risk=i, section="attention",
+                                  timeout_seconds=2.0))
+        return pts
+
+    def test_wedged_point_leaves_skipped_rows_for_every_shape(self, tmp_path):
+        """The injected wedge eats the whole budget: its row is a
+        watchdog kill, the remaining shapes are budget_exhausted — and
+        the artifact carries all three, none silently absent."""
+        points = self._attention_points(wedge_first=True)
+        summary = orch(points, tmp_path, total_budget_seconds=6.0).run()
+        assert validate_summary(summary, points) == []
+        hw = to_hardware_section(summary)
+        assert len(hw["attention"]) == len(self.SHAPES)
+        by_shape = {(a.get("batch"), a.get("seq")): a
+                    for a in hw["attention"]}
+        assert set(by_shape) == set(self.SHAPES)
+        for shape, row in by_shape.items():
+            assert row["provenance"].startswith("skipped:"), (shape, row)
+        assert by_shape[self.SHAPES[0]]["provenance"].startswith(
+            "skipped:watchdog_timeout")
+
+    def test_bench_fallback_keeps_skipped_rows_without_cache(self, tmp_path):
+        """bench.py's nothing-measured path: with no last-good cache the
+        artifact must still be the summary's provenance-tagged rows plus
+        the error — never a bare error with an empty attention list."""
+        import sys
+        sys.path.insert(0, REPO)
+        from bench import _cached_fallback
+
+        points = self._attention_points(wedge_first=True)
+        summary = orch(points, tmp_path, total_budget_seconds=6.0).run()
+        assert summary["stats"]["measured"] == 0
+        out = _cached_fallback(os.fspath(tmp_path / "no-cache-here"),
+                               "no point measured", summary=summary)
+        assert out["error"] == "no point measured"
+        assert len(out["attention"]) == len(self.SHAPES)
+        assert all(a["provenance"].startswith("skipped:")
+                   for a in out["attention"])
+
+
 def test_bench_dryrun_end_to_end(tmp_path):
     """`make bench-dryrun`, in-process: the orchestrator runs end-to-end
     on the fake backend (real subprocess workers, a real watchdog kill)

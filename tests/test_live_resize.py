@@ -1,0 +1,245 @@
+"""The elastic-resize fast path (doc/elastic-resize.md), hermetically.
+
+Tier A: reshard_state()/TrainSession.resize() round-trips (state must be
+bit-identical across grow/shrink, including uneven chip counts), and the
+scheduler driving a live in-place resize end-to-end on the fake backend
+through the real-time pump() path — counted as a resize, not a restart,
+with the preemption lease left alone. Tier B: the VODA_COMPILE_CACHE_DIR
+env knob (set → jax persistent cache configured; unset → jax untouched),
+checked in subprocesses because the configuration is process-global.
+"""
+
+import heapq
+import itertools
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.backend import ResizePath
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+from vodascheduler_tpu.common.clock import Clock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.models import get_model
+from vodascheduler_tpu.parallel.mesh import MeshPlan
+from vodascheduler_tpu.placement import PlacementManager
+from vodascheduler_tpu.runtime.train import TrainSession
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _host_state(session):
+    return jax.tree.map(np.asarray, session.state)
+
+
+def _assert_bitwise_equal(a, b, context):
+    eq = jax.tree.map(np.array_equal, a, b)
+    bad = [p for p, ok in jax.tree_util.tree_flatten_with_path(eq)[0]
+           if not ok]
+    assert not bad, f"{context}: leaves changed across resize: {bad}"
+
+
+class TestReshardRoundTrip:
+    """Satellite: grow-then-shrink round trips. A live resize is pure
+    data movement — every param and optimizer-state leaf must survive
+    bit-exactly, and the resized session must still train."""
+
+    def test_grow_then_shrink_bitwise(self):
+        # Explicit fsdp/tp plans so real (non-replicated) resharding
+        # happens, not just mesh relabeling.
+        s = TrainSession(get_model("mnist_mlp"), 2, global_batch_size=8,
+                         devices=jax.devices()[:2],
+                         plan=MeshPlan(dp=1, fsdp=2))
+        s.run_steps(2)
+        step_before = s.step
+
+        snap = _host_state(s)
+        s.resize(8, plan=MeshPlan(dp=2, fsdp=2, tp=2))
+        _assert_bitwise_equal(snap, _host_state(s), "grow 2->8")
+        assert s.num_chips == 8 and s.step == step_before
+
+        snap = _host_state(s)
+        s.resize(4, plan=MeshPlan(dp=1, fsdp=4))
+        _assert_bitwise_equal(snap, _host_state(s), "shrink 8->4")
+
+        # Still trains at the new size (jitted step rebuilt and usable).
+        loss = s.run_steps(1)
+        assert np.isfinite(loss)
+        assert s.step == step_before + 1
+
+    def test_uneven_chip_counts(self):
+        """Non-power-of-two targets: axes that stop dividing fall back to
+        replication (sharding._fit_spec) — values still bit-identical."""
+        s = TrainSession(get_model("mnist_mlp"), 2, global_batch_size=12,
+                         devices=jax.devices()[:2],
+                         plan=MeshPlan(dp=1, fsdp=2))
+        s.run_steps(1)
+        for target in (3, 6, 4):  # 3 divides nothing in the model dims
+            snap = _host_state(s)
+            s.resize(target)
+            _assert_bitwise_equal(snap, _host_state(s), f"resize->{target}")
+            assert np.isfinite(s.run_steps(1))
+
+    def test_resize_beyond_devices_raises(self):
+        s = TrainSession(get_model("mnist_mlp"), 1, global_batch_size=8,
+                         devices=jax.devices()[:1])
+        try:
+            s.resize(99)
+        except ValueError as e:
+            assert "checkpoint-restart" in str(e)
+        else:
+            raise AssertionError("resize past visible devices must raise")
+
+
+class _ManualClock(Clock):
+    """Wall-clock stand-in the test advances by hand. Deliberately NOT a
+    VirtualClock: the scheduler then runs in real-time mode, where the
+    service daemon's pump() is what executes pending rescheds — the path
+    this test must drive."""
+
+    def __init__(self, start: float = 1753760000.0):
+        self._now = start
+        self._timers = []
+        self._seq = itertools.count()
+
+    def now(self) -> float:
+        return self._now
+
+    def call_at(self, when, fn) -> None:
+        heapq.heappush(self._timers, (when, next(self._seq), fn))
+
+    def call_later(self, delay, fn) -> None:
+        self.call_at(self._now + delay, fn)
+
+    def tick(self, seconds: float) -> None:
+        target = self._now + seconds
+        while self._timers and self._timers[0][0] <= target:
+            when, _, fn = heapq.heappop(self._timers)
+            self._now = max(self._now, when)
+            fn()
+        self._now = target
+
+
+class TestSchedulerInplaceResizeE2E:
+    """Satellite: a live in-place resize end-to-end through
+    Scheduler.pump() on the fake backend — same-host shrink reshards in
+    place: new counter, no restart counted, lease not re-armed, and the
+    job's simulated incarnation never restarts (the fake-backend
+    equivalent of 'no checkpoint written')."""
+
+    def _world(self):
+        clock = _ManualClock()
+        store = JobStore()
+        bus = EventBus()
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=50.0,
+                                     inplace_overhead_seconds=2.0)
+        backend.add_host("host-0", 8, announce=False)
+        pm = PlacementManager("pool")
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, placement_manager=pm,
+                          algorithm="ElasticFIFO", rate_limit_seconds=5.0)
+        admission = AdmissionService(store, bus, clock)
+        return clock, store, backend, sched, admission
+
+    def test_pump_drives_inplace_resize(self):
+        clock, store, backend, sched, admission = self._world()
+        a = admission.create_training_job(JobSpec(
+            name="stretchy", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=8, epochs=100)))
+        assert sched.job_num_chips[a] == 8  # started with the whole host
+        sim = backend.jobs[a]
+        assert sim.restarts == 1 and sim.resizes_inplace == 0
+
+        # A lease the resize must NOT re-arm.
+        job = store.get_job(a)
+        job.metrics.seconds_since_restart = 777.0
+
+        # Second submission inside the rate window: resched goes pending;
+        # in real-time mode only pump() may run it.
+        b = admission.create_training_job(JobSpec(
+            name="newcomer", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=4, epochs=100)))
+        assert sched.resched_pending
+        assert sched.job_num_chips[a] == 8  # nothing applied yet
+
+        clock.tick(6.0)  # open the rate-limit window
+        sched.pump()
+
+        # a shrank on its own host -> in-place; b started (a restart).
+        assert sched.job_num_chips[a] == 4
+        assert sched.job_num_chips[b] == 4
+        assert backend.resizes_inplace_total == 1
+        assert backend.cold_resizes_total == 0
+        assert sim.resizes_inplace == 1
+        assert sim.restarts == 1  # the original start only: never restarted
+        assert sched.m_job_resizes_inplace.value() == 1
+        assert sched.m_job_restarts.value() == 2  # two starts, no resize
+        # The in-place pause is the fast-path cost, not the 50 s restart.
+        assert 0 < sim.busy_until - clock.now() <= 2.0
+        # Lease untouched: still counting from the last COLD restart.
+        assert store.get_job(a).metrics.seconds_since_restart >= 777.0
+
+    def test_migration_stays_cold(self):
+        """A host-set change is a process-group change: the fake must
+        price it as a cold restart and the scheduler must count it as
+        one (lease re-armed)."""
+        clock, store, backend, sched, admission = self._world()
+        backend.add_host("host-1", 8, announce=False)
+        a = admission.create_training_job(JobSpec(
+            name="mover", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=8, epochs=100)))
+        sim = backend.jobs[a]
+        path = backend.scale_job(a, 8, [("host-1", 8)])
+        assert path == ResizePath.RESTART
+        assert backend.cold_resizes_total == 1
+        assert backend.resizes_inplace_total == 0
+        assert sim.restarts == 2
+
+
+class TestCompileCacheEnvKnob:
+    """Satellite: VODA_COMPILE_CACHE_DIR set → the supervisor-side helper
+    points jax_compilation_cache_dir at it (and entries actually land on
+    the CPU backend); unset → jax's configuration is untouched. Run in
+    subprocesses: the jax config is process-global."""
+
+    CODE = (
+        "import os, json, jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from vodascheduler_tpu.runtime.compile_cache import ("
+        "configure_compilation_cache)\n"
+        "before = jax.config.jax_compilation_cache_dir\n"
+        "ret = configure_compilation_cache()\n"
+        "jax.jit(lambda x: x * 3)(jax.numpy.ones(()))\n"
+        "print(json.dumps({'before': before, 'ret': ret,\n"
+        "    'after': jax.config.jax_compilation_cache_dir}))\n"
+    )
+
+    def _run(self, env):
+        env = dict(env, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", self.CODE],
+                           capture_output=True, text=True, timeout=120,
+                           env=env, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-800:]
+        import json
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    def test_set_configures_and_populates(self, tmp_path):
+        cache_dir = os.fspath(tmp_path / "xla-cache")
+        out = self._run(dict(os.environ, VODA_COMPILE_CACHE_DIR=cache_dir))
+        assert out["ret"] == cache_dir
+        assert out["after"] == cache_dir
+        assert os.listdir(cache_dir), "no persistent cache entries written"
+
+    def test_unset_leaves_jax_untouched(self, tmp_path):
+        env = {k: v for k, v in os.environ.items()
+               if k != "VODA_COMPILE_CACHE_DIR"}
+        out = self._run(env)
+        assert out["ret"] is None
+        assert out["after"] == out["before"]  # untouched, whatever default

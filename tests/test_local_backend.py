@@ -104,6 +104,82 @@ def test_scale_restarts_with_checkpoint(backend, tmp_path):
     assert 4 in workers_seen  # finished at the new size
 
 
+def test_inplace_resize_no_restart_no_checkpoint(tmp_path, monkeypatch):
+    """Tier-A fast path end-to-end on a real supervisor: scale_job
+    reshards the RUNNING process (same pid, ResizePath.INPLACE), writes
+    no checkpoint for the resize, logs the greppable in-place line, and
+    the job then finishes at the new size. Tier-B rides along: the
+    supervisor child populates the persistent compile cache."""
+    from vodascheduler_tpu.cluster.backend import ResizePath
+
+    cache_dir = tmp_path / "xla-cache"
+    monkeypatch.setenv("VODA_COMPILE_CACHE_DIR", os.fspath(cache_dir))
+    backend = LocalBackend(str(tmp_path), hermetic_devices=4,
+                           stop_grace_seconds=60.0)
+    try:
+        events = []
+        backend.set_event_callback(events.append)
+        # One epoch spanning the whole job: the per-epoch save happens
+        # only at the very end, so any checkpoint seen at resize-ack
+        # time could only have come from the resize path itself.
+        backend.start_job(_spec("job-live", epochs=1, steps=12000),
+                          num_workers=2)
+        pid = backend._procs["job-live"].popen.pid
+        ckpt_dir = str(tmp_path / "job-live" / "ckpt")
+        metrics_csv = os.path.join(backend.metrics_dir, "job-live.csv")
+        log_path = tmp_path / "job-live" / "supervisor.log"
+
+        # Wait until the supervisor is actually training (compile cache
+        # entries appear once the first step compiled).
+        assert _wait(lambda: cache_dir.is_dir() and any(cache_dir.iterdir())), \
+            log_path.read_text() if log_path.exists() else "no log"
+
+        path = backend.scale_job("job-live", 4)
+        assert path == ResizePath.INPLACE
+        assert backend._procs["job-live"].popen.pid == pid  # same process
+        assert backend._procs["job-live"].num_chips == 4
+        assert latest_step(ckpt_dir) is None  # fast path checkpointed nothing
+        assert "resized in-place 2 -> 4 chips" in log_path.read_text()
+
+        assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                                 for e in events), timeout=300.0), \
+            log_path.read_text()
+        rows = read_epoch_csv(metrics_csv)
+        assert int(rows[-1]["workers"]) == 4  # finished at the new size
+        assert latest_step(ckpt_dir) == 12000  # final save still happened
+    finally:
+        backend.close()
+
+
+def test_inplace_infeasible_falls_back_to_restart(tmp_path):
+    """A target beyond the process's virtual mesh must take the cold
+    path: new process, checkpoint-restart semantics preserved."""
+    from vodascheduler_tpu.cluster.backend import ResizePath
+
+    backend = LocalBackend(str(tmp_path), hermetic_devices=2,
+                           stop_grace_seconds=60.0)
+    try:
+        events = []
+        backend.set_event_callback(events.append)
+        backend.start_job(_spec("job-cold", epochs=25, steps=10),
+                          num_workers=2)
+        ckpt_dir = str(tmp_path / "job-cold" / "ckpt")
+        assert _wait(lambda: latest_step(ckpt_dir) is not None), \
+            open(tmp_path / "job-cold" / "supervisor.log").read()
+        pid = backend._procs["job-cold"].popen.pid
+        path = backend.scale_job("job-cold", 4)  # 4 > 2 visible devices
+        assert path == ResizePath.RESTART
+        assert backend._procs["job-cold"].popen.pid != pid
+        assert _wait(lambda: any(e.kind == ClusterEventKind.JOB_COMPLETED
+                                 for e in events)), \
+            open(tmp_path / "job-cold" / "supervisor.log").read()
+        rows = read_epoch_csv(os.path.join(backend.metrics_dir,
+                                           "job-cold.csv"))
+        assert 4 in {int(r["workers"]) for r in rows}
+    finally:
+        backend.close()
+
+
 def test_stop_preserves_checkpoint_and_no_failure_event(backend, tmp_path):
     events = []
     backend.set_event_callback(events.append)

@@ -7,8 +7,10 @@ import json
 import pytest
 
 from vodascheduler_tpu.replay.restart_costs import (
+    ASSUMED_INPLACE_S,
     ASSUMED_RESTART_S,
     FAMILY_FOOTPRINT,
+    default_inplace_seconds,
     default_restart_seconds,
     derive_costs,
     family_restart_costs,
@@ -94,6 +96,38 @@ class TestSource:
             path=str(tmp_path / "absent.json")) == 23.5
 
 
+class TestInplaceCosts:
+    """Tier-A (in-place) resize pricing: measured fast/cold ratio when
+    the artifact carries fast-path points, assumed table otherwise —
+    always strictly below the cold cost."""
+
+    def test_assumed_fallback_without_fast_points(self):
+        costs = derive_costs([_point()])  # no fast_resize_ms in the point
+        for fam, c in costs.items():
+            assert c.inplace_s == ASSUMED_INPLACE_S[fam]
+            assert c.inplace_provenance == "assumed"
+
+    def test_measured_ratio_scales_inplace(self):
+        fast = _point()
+        fast["fast_resize_ms"] = 3000.0  # 3 s of 12 s restart -> ratio .25
+        costs = derive_costs([fast])
+        for fam, c in costs.items():
+            assert c.inplace_s == pytest.approx(
+                max(0.5, 0.25 * c.restart_s), abs=0.06), fam
+            assert c.inplace_provenance.startswith("scaled:0.25x cold")
+
+    def test_inplace_always_below_cold(self, tmp_path):
+        for costs in (family_restart_costs(path=str(tmp_path / "absent")),
+                      family_restart_costs()):  # assumed AND repo artifact
+            for fam, c in costs.items():
+                assert 0 < c.inplace_s < c.restart_s, fam
+
+    def test_default_inplace_is_weighted_mean(self, tmp_path):
+        # weights .30/.25/.20/.15/.10 over 3/4/6/15/20 s -> 7.3 s
+        assert default_inplace_seconds(
+            path=str(tmp_path / "absent.json")) == 7.3
+
+
 class TestTraceWiring:
     def test_trace_jobs_price_family_costs(self):
         from vodascheduler_tpu.replay.trace import philly_like_trace
@@ -102,6 +136,7 @@ class TestTraceWiring:
         assert jobs
         for j in jobs:
             assert j.restart_overhead_seconds == costs[j.model].restart_s
+            assert j.inplace_overhead_seconds == costs[j.model].inplace_s
 
 
 class TestCheckedInArtifact:

@@ -32,10 +32,17 @@ from vodascheduler_tpu.benchrunner.points import RESULT_PREFIX
 def _configure_jax_platform() -> None:
     """Honor JAX_PLATFORMS=cpu even when a TPU plugin registered itself
     eagerly (the axon tunnel does) — the config API call wins over the
-    env var alone. Same workaround as __graft_entry__.py."""
+    env var alone. Same workaround as __graft_entry__.py. Also applies
+    the Tier-B persistent compile cache (VODA_COMPILE_CACHE_DIR) so a
+    re-run bench point skips compiles the same way production restarts
+    do."""
     import jax
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from vodascheduler_tpu.runtime.compile_cache import (
+        configure_compilation_cache,
+    )
+    configure_compilation_cache()
 
 
 def _require_accelerator() -> str:

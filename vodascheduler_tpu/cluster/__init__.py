@@ -16,7 +16,12 @@ interface:
   substrate (hermetic multi-process CPU emulation of a TPU pod).
 """
 
-from vodascheduler_tpu.cluster.backend import ClusterBackend, JobHandle, ClusterEvent
+from vodascheduler_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterEvent,
+    JobHandle,
+    ResizePath,
+)
 from vodascheduler_tpu.cluster.gke import GkeBackend, InClusterKube
 from vodascheduler_tpu.cluster.local import LocalBackend
 from vodascheduler_tpu.cluster.multihost import MultiHostBackend

@@ -6,10 +6,16 @@ MPIJob watchers, scheduler.go:169-242). The backend absorbs both directions:
 the scheduler calls start/scale/stop, and the backend reports job and host
 events back through a callback.
 
-On TPU, "scale" is not an in-place ring rebuild: the backend's contract is
-that scale_job(job, n) checkpoint-restarts the job's worker processes at
-the new size (runtime/supervisor.py for the real one; the fake backend
-models the restart cost).
+On TPU, "scale" is two-tiered (the elastic-resize fast path): when the
+job's process group is unchanged (same hosts, single-process or
+membership-stable), the backend asks the RUNNING supervisor to reshard in
+place over its control channel (runtime/supervisor.py) — no checkpoint,
+no process exit. Only when the process group actually changes (migration,
+multihost membership change, or the supervisor nacks) does scale_job fall
+back to the checkpoint-restart path. scale_job reports which tier fired
+via its ResizePath return value so the scheduler can price the two very
+differently (an in-place resize is not a "restart" for lease or metric
+purposes).
 """
 
 from __future__ import annotations
@@ -20,6 +26,17 @@ import enum
 from typing import Callable, Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.common.job import JobSpec
+
+
+class ResizePath(str, enum.Enum):
+    """Which tier a scale_job took. INPLACE = live reshard inside the
+    running process(es); RESTART = checkpoint-restart (the only path when
+    the process group changes). Backends that can't resize in place
+    always return RESTART; a None return is treated as RESTART for
+    backward compatibility."""
+
+    INPLACE = "inplace"
+    RESTART = "restart"
 
 
 class ClusterEventKind(str, enum.Enum):
@@ -49,6 +66,12 @@ class JobHandle:
 class ClusterBackend(abc.ABC):
     """What the scheduler needs from an execution substrate."""
 
+    # Whether this backend can ever take the Tier-A in-place path. The
+    # scheduler's fast-path-aware policies (hysteresis bypass) consult
+    # this so they never bypass a cost gate for a backend whose every
+    # resize is a cold restart (gke, multihost today).
+    supports_inplace_resize: bool = False
+
     @abc.abstractmethod
     def list_hosts(self) -> Dict[str, int]:
         """host name -> chip count for every live host in the pool."""
@@ -60,9 +83,12 @@ class ClusterBackend(abc.ABC):
 
     @abc.abstractmethod
     def scale_job(self, name: str, num_workers: int,
-                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        """Resize a running job — checkpoint-restart at the new size
-        (reference: update MPIJob Worker.Replicas :542)."""
+                  placements: Optional[List[Tuple[str, int]]] = None
+                  ) -> Optional[ResizePath]:
+        """Resize a running job. Tries the in-place live reshard when the
+        process group is unchanged; falls back to checkpoint-restart at
+        the new size (reference: update MPIJob Worker.Replicas :542).
+        Returns the ResizePath taken (None == RESTART)."""
 
     @abc.abstractmethod
     def stop_job(self, name: str) -> None:

@@ -9,11 +9,20 @@ milliseconds.
 Execution model: each job is an amount of *serial work*
 (epochs × epoch_seconds at 1 chip). Running at n chips, work completes at
 `speedup(n)` serial-seconds per second — speedup comes from a per-workload
-profile (the same curves the metrics collector learns). Every (re)start,
-resize, or migration pauses the job for `restart_overhead_seconds`,
-modeling the TPU elastic-resize cost: checkpoint, process restart,
-recompile, resharded restore. Epoch completions emit metrics rows exactly
-like the reference's training-side CSV logger (examples/.../callbacks.py).
+profile (the same curves the metrics collector learns). Every (re)start or
+migration pauses the job for `restart_overhead_seconds`, modeling the TPU
+cold-resize cost: checkpoint, process restart, recompile, resharded
+restore. A resize of a SINGLE-HOST job staying on its host models the
+Tier-A in-place live reshard instead (doc/elastic-resize.md) — the only
+case the real feasibility gate (one process, target within its devices)
+admits: the pause is the much smaller `inplace_overhead_seconds` (reshard
++ recompile, no process exit, no checkpoint round-trip), it does not
+count as a restart, and scale_job reports ResizePath.INPLACE — mirroring
+what the real backends' supervisor control channel does. Multi-host
+resizes are always cold (one process per host: any size change is a
+membership change). Epoch completions emit metrics
+rows exactly like the reference's training-side CSV logger
+(examples/.../callbacks.py).
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEvent,
     ClusterEventKind,
     JobHandle,
+    ResizePath,
 )
 from vodascheduler_tpu.common.clock import VirtualClock
 from vodascheduler_tpu.common.job import JobSpec, category_of
@@ -44,6 +54,9 @@ class WorkloadProfile:
     # default): restore + recompile scales with model size, so a ResNet
     # resize is far cheaper than a Mixtral resize.
     restart_overhead_seconds: Optional[float] = None
+    # In-place (Tier-A) resize pause for this workload: reshard +
+    # recompile only. None falls back to the backend default.
+    inplace_overhead_seconds: Optional[float] = None
 
     def speedup_at(self, n: int) -> float:
         if n <= 0:
@@ -84,6 +97,7 @@ class _SimJob:
     epoch_started_at: float = 0.0
     generation: int = 0               # invalidates stale timers
     restarts: int = 0
+    resizes_inplace: int = 0
 
     @property
     def total_serial(self) -> float:
@@ -91,10 +105,22 @@ class _SimJob:
 
 
 class FakeClusterBackend(ClusterBackend):
+    supports_inplace_resize = True
+
     def __init__(self, clock: VirtualClock,
-                 restart_overhead_seconds: float = 10.0):
+                 restart_overhead_seconds: float = 10.0,
+                 inplace_overhead_seconds: Optional[float] = None):
         self.clock = clock
         self.restart_overhead_seconds = restart_overhead_seconds
+        # Tier-A pause default: reshard + recompile, no process lifecycle
+        # and no checkpoint round-trip. When not measured (replay passes
+        # restart_costs.default_inplace_seconds), a tenth of the cold
+        # cost is the documented heuristic — compile-dominated, see
+        # doc/elastic-resize.md.
+        self.inplace_overhead_seconds = (
+            restart_overhead_seconds / 10.0
+            if inplace_overhead_seconds is None
+            else inplace_overhead_seconds)
         self.hosts: Dict[str, int] = {}
         self.jobs: Dict[str, _SimJob] = {}
         self.profiles: Dict[str, WorkloadProfile] = {}
@@ -106,6 +132,11 @@ class FakeClusterBackend(ClusterBackend):
         # jobs vs capacity)
         self.busy_chip_seconds: float = 0.0
         self.restarts_total: int = 0  # cumulative across all jobs, ever
+        # Resize-path mix (bench.py reports it): in-place live reshards
+        # vs cold checkpoint-restart resizes. restarts_total counts cold
+        # resizes (and starts/migrations) but never in-place ones.
+        self.resizes_inplace_total: int = 0
+        self.cold_resizes_total: int = 0
         # (timestamp, total_chips) after each fleet change — lets callers
         # integrate capacity over time (preemption changes the denominator)
         self.capacity_history: List[Tuple[float, int]] = []
@@ -187,23 +218,48 @@ class FakeClusterBackend(ClusterBackend):
         self._schedule_next_event(sim)
 
     def scale_job(self, name: str, num_workers: int,
-                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+                  placements: Optional[List[Tuple[str, int]]] = None
+                  ) -> Optional[ResizePath]:
         sim = self.jobs.get(name)
         if sim is None:
-            return
+            return None
         self._accrue(sim)
+        # Tier decision, mirroring the REAL feasibility gate
+        # (runtime/supervisor.py: single process, target within its
+        # devices): the job must sit on ONE host before and after, and
+        # it must be the same host — that is the only case where the
+        # process group provably survives. Multi-host jobs model one
+        # process per host (cluster/multihost.py), so any multi-host
+        # resize is a membership change → cold, even with the host set
+        # unchanged. No placements on either side = can't prove
+        # stability (direct scale_job callers without a placement
+        # manager) — conservative cold path.
+        old_hosts = ({h for h, _ in sim.placements}
+                     if sim.placements else None)
+        new_hosts = ({h for h, _ in placements}
+                     if placements is not None else None)
+        inplace = (sim.num_workers > 0 and num_workers > 0
+                   and old_hosts is not None and new_hosts is not None
+                   and len(old_hosts) == 1 and old_hosts == new_hosts)
         sim.num_workers = num_workers
         if placements is not None:
             sim.placements = placements
-        sim.restarts += 1
-        self.restarts_total += 1
+        if inplace:
+            sim.resizes_inplace += 1
+            self.resizes_inplace_total += 1
+        else:
+            sim.restarts += 1
+            self.restarts_total += 1
+            self.cold_resizes_total += 1
         now = self.clock.now()
-        sim.busy_until = now + self._overhead(sim)
+        sim.busy_until = now + (self._inplace_overhead(sim) if inplace
+                                else self._overhead(sim))
         sim.epoch_started_at = now
         sim.epoch_started_serial = sim.progress_serial
         sim.epoch_started_workers = num_workers
         sim.generation += 1
         self._schedule_next_event(sim)
+        return ResizePath.INPLACE if inplace else ResizePath.RESTART
 
     def stop_job(self, name: str) -> None:
         """Halt: remove from running set; progress (checkpoint) is kept in
@@ -233,6 +289,11 @@ class FakeClusterBackend(ClusterBackend):
         if sim.profile.restart_overhead_seconds is not None:
             return sim.profile.restart_overhead_seconds
         return self.restart_overhead_seconds
+
+    def _inplace_overhead(self, sim: _SimJob) -> float:
+        if sim.profile.inplace_overhead_seconds is not None:
+            return sim.profile.inplace_overhead_seconds
+        return self.inplace_overhead_seconds
 
     # ---- simulation engine -----------------------------------------------
 

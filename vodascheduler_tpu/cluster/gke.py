@@ -60,6 +60,7 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEvent,
     ClusterEventKind,
     JobHandle,
+    ResizePath,
 )
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
@@ -337,7 +338,14 @@ class GkeBackend(ClusterBackend):
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
-                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+                  placements: Optional[List[Tuple[str, int]]] = None
+                  ) -> ResizePath:
+        """Always the cold path today: a pod-set resize changes the
+        process group (new pods, new jax.distributed membership), which
+        is exactly the case the Tier-A in-place reshard excludes
+        (doc/elastic-resize.md). A future same-pod-set fast path would
+        relay the supervisor control channel over the job's shared
+        volume and return ResizePath.INPLACE on ack."""
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
@@ -390,6 +398,7 @@ class GkeBackend(ClusterBackend):
             with self._lock:
                 self._resizing.discard(name)
         self._ensure_monitor()
+        return ResizePath.RESTART
 
     def stop_job(self, name: str) -> None:
         self._delete_pods(name)

@@ -6,10 +6,16 @@ edits MPIJob specs and the operator launches/kills worker pods
 (SURVEY.md §7: "no MPI-Operator dependency"): each job is a supervisor
 subprocess (runtime/supervisor.py) training a JAX GSPMD program.
 
-Resize/halt/migrate all take the same path — SIGTERM (supervisor
-checkpoints and exits with PREEMPTED_EXIT_CODE), then for resize a fresh
-process at the new chip count restores with resharding. That is the
-TPU-native shape of the reference's kill-pod-and-let-it-recover design
+Resize is two-tiered (doc/elastic-resize.md): scale_job first asks the
+RUNNING supervisor to reshard in place over its control channel
+(runtime/supervisor.py request_resize/read_resize_ack) — feasible
+whenever the target chip count fits the devices the process already owns
+— and only falls back to the cold path when the supervisor nacks, dies,
+or times out. Halt/migrate and the cold resize path keep the original
+shape — SIGTERM (supervisor checkpoints and exits with
+PREEMPTED_EXIT_CODE), then for resize a fresh process at the new chip
+count restores with resharding: the TPU-native shape of the reference's
+kill-pod-and-let-it-recover design
 (doc/design/placement-management.md:31-33).
 
 Hermetic by default off: pass hermetic_devices=N to give every job an
@@ -33,20 +39,31 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEvent,
     ClusterEventKind,
     JobHandle,
+    ResizePath,
 )
 from vodascheduler_tpu import config
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+from vodascheduler_tpu.runtime.supervisor import (
+    read_resize_ack,
+    request_resize,
+)
 
 
 class _Proc:
-    def __init__(self, popen: subprocess.Popen, num_chips: int):
+    def __init__(self, popen: subprocess.Popen, num_chips: int,
+                 devices_visible: int):
         self.popen = popen
         self.num_chips = num_chips
+        # Devices this incarnation can see (its virtual CPU mesh size, or
+        # the host's chips) — the in-place resize feasibility bound.
+        self.devices_visible = devices_visible
         self.expected_stop = False
 
 
 class LocalBackend(ClusterBackend):
+    supports_inplace_resize = True
+
     def __init__(self, workdir: str, chips: Optional[int] = None,
                  hermetic_devices: Optional[int] = None,
                  metrics_dir: Optional[str] = None,
@@ -96,12 +113,33 @@ class LocalBackend(ClusterBackend):
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
-                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        """Checkpoint-restart at the new size (reference: edit
-        Worker.Replicas and let Horovod re-form, scheduler.go:542)."""
+                  placements: Optional[List[Tuple[str, int]]] = None
+                  ) -> ResizePath:
+        """Two-tier resize: in-place live reshard when the running
+        supervisor can satisfy the new count from the devices it already
+        owns, else checkpoint-restart at the new size (reference: edit
+        Worker.Replicas and let Horovod re-form, scheduler.go:542).
+
+        Blocking contract: the in-place attempt waits synchronously for
+        the supervisor's ack (bounded by
+        VODA_INPLACE_RESIZE_TIMEOUT_SECONDS, default 90 s, which covers
+        the resharded step's compile — near-instant when the Tier-B
+        cache is warm). This mirrors the cold path, which already blocks
+        up to stop_grace_seconds (default 120 s) on the SIGTERM
+        checkpoint drain; neither path holds the scheduler longer than a
+        single resize always could. Acking only after the first step at
+        the new size is what lets a failed resize degrade to the cold
+        path instead of crashing the job."""
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
+        if self._try_inplace_resize(name, num_workers):
+            return ResizePath.INPLACE
+        self._restart_at(name, spec, num_workers)
+        return ResizePath.RESTART
+
+    def _restart_at(self, name: str, spec: JobSpec, num_workers: int) -> None:
+        """The cold path: checkpoint-stop, respawn at the new size."""
         self._stop_proc(name)
         with self._lock:
             self._spawn_locked(spec, num_workers)
@@ -115,9 +153,13 @@ class LocalBackend(ClusterBackend):
     def migrate_workers(self, name: str,
                         placements: List[Tuple[str, int]]) -> None:
         # Single-host: a re-placement is a same-size checkpoint-restart.
+        # Deliberately NOT scale_job: the in-place attempt would ack a
+        # same-count resize as a trivial no-op and the re-placement the
+        # caller asked for would silently never happen.
         proc = self._procs.get(name)
-        if proc is not None:
-            self.scale_job(name, proc.num_chips, placements)
+        spec = self._specs.get(name)
+        if proc is not None and spec is not None:
+            self._restart_at(name, spec, proc.num_chips)
 
     def running_jobs(self) -> Dict[str, JobHandle]:
         with self._lock:
@@ -153,7 +195,36 @@ class LocalBackend(ClusterBackend):
         popen = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f,
                                  start_new_session=True)
         log_f.close()
-        self._procs[spec.name] = _Proc(popen, num_chips)
+        devices_visible = (max(self.hermetic_devices, num_chips)
+                           if self.hermetic_devices else self.chips)
+        self._procs[spec.name] = _Proc(popen, num_chips, devices_visible)
+
+    def _try_inplace_resize(self, name: str, num_chips: int) -> bool:
+        """Tier A: ask the running supervisor to reshard in place. True on
+        an acked resize; False (caller falls back to checkpoint-restart)
+        when the target exceeds the process's visible devices, the
+        supervisor nacks, dies, or the ack times out."""
+        with self._lock:
+            proc = self._procs.get(name)
+        if (proc is None or proc.popen.poll() is not None
+                or num_chips > proc.devices_visible):
+            return False
+        job_dir = self._job_dir(name)
+        seq = request_resize(job_dir, num_chips)
+        deadline = (time.monotonic()
+                    + config.INPLACE_RESIZE_TIMEOUT_SECONDS)
+        while time.monotonic() < deadline:
+            ack = read_resize_ack(job_dir, seq)
+            if ack is not None:
+                if ack.get("ok"):
+                    with self._lock:
+                        proc.num_chips = num_chips
+                    return True
+                return False
+            if proc.popen.poll() is not None:
+                return False  # died mid-request: cold path handles it
+            time.sleep(min(0.05, self.poll_interval_seconds))
+        return False
 
     def _stop_proc(self, name: str) -> None:
         with self._lock:

@@ -48,6 +48,7 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEvent,
     ClusterEventKind,
     JobHandle,
+    ResizePath,
 )
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
@@ -114,10 +115,14 @@ class MultiHostBackend(ClusterBackend):
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
-                  placements: Optional[List[Tuple[str, int]]] = None) -> None:
+                  placements: Optional[List[Tuple[str, int]]] = None
+                  ) -> "ResizePath":
         """Restart the whole process set at the new size. The reference
         edits Worker.Replicas and lets Horovod re-form (scheduler.go:542);
-        on TPU the new topology means new processes + resharded restore."""
+        on TPU the new topology means new processes + resharded restore.
+        Always the cold path: any multi-host resize changes
+        jax.distributed membership, the case the Tier-A in-place reshard
+        excludes by contract (doc/elastic-resize.md)."""
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
@@ -125,6 +130,7 @@ class MultiHostBackend(ClusterBackend):
         with self._lock:
             self._spawn_locked(spec, num_workers, placements)
         self._ensure_monitor()
+        return ResizePath.RESTART
 
     def stop_job(self, name: str) -> None:
         self._stop_set(name)

@@ -42,20 +42,22 @@ def _env_float(name: str, default: str) -> float:
 # ~free; checkpoint-restart resizes are not). The ONE source of truth for
 # the shipped values: Scheduler ctor defaults and ReplayHarness both read
 # these, so replay evidence and production policy cannot drift. Defaults
-# are the r5 sweep knee under MEASURED restart pricing — two pooled
-# chip-session captures, doc/resize_measured.json →
-# scripts/replay_sweep.py → doc/replay_sweep_r5.json. The honest
-# finding is that the knob SURFACE IS FLAT at measured pricing (top
-# sweep cells sit within ~1 pt of utilization), so the shipped values
-# are the sweep's util-first/avg+p95-tiebreak pick (45 s / 2.0 / 120 s),
-# which also had the best p95 and fewest restarts of the near-tied
-# cells — not a sharply identified optimum. The env overrides exist for
-# operators re-tuning on their own workload. The rate limit lives here
-# too since r5: the measured pick no longer coincides with the
-# reference scheduler's 30 s default (scheduler.go:212).
-RATE_LIMIT_SECONDS = _env_float("VODA_RATE_LIMIT_SECONDS", "45")
-SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "2.0")
-RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "120")
+# are the r6 sweep knee under TWO-TIER resize pricing
+# (doc/elastic-resize.md): cold restarts at their measured cost
+# (doc/resize_measured.json), same-host resizes at the in-place
+# fast-path cost — scripts/replay_sweep.py → doc/replay_sweep_r6.json.
+# Making reconfiguration cheaper moved the knee to a much faster rate
+# limit (45 s → 15 s — the scheduler can afford to act more often, the
+# compounding the reconfiguration-cost literature predicts) and a softer
+# hysteresis (2.0 → 1.5, since same-host grows now bypass suppression
+# entirely). The surface stays flat near the knee (top cells within
+# ~1 pt of utilization); the shipped values are the sweep's util-first/
+# avg+p95-tiebreak pick. Env overrides exist for operators re-tuning on
+# their own workload. (r5 history: 45 s / 2.0 / 120 s under
+# cold-only measured pricing, doc/replay_sweep_r5.json.)
+RATE_LIMIT_SECONDS = _env_float("VODA_RATE_LIMIT_SECONDS", "15")
+SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.5")
+RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "60")
 
 # How long a preempted worker gets between SIGTERM and SIGKILL — it must
 # cover a full synchronous checkpoint save (the SIGTERM→save→PREEMPTED
@@ -66,6 +68,15 @@ RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "120")
 # llama_350m's ~4.2 GB AdamW state needs ~300 s, i.e. this MUST be
 # raised on tunnel-attached or slow-NFS deployments.
 STOP_GRACE_SECONDS = _env_float("VODA_STOP_GRACE_SECONDS", "120")
+
+# How long a backend waits for a running supervisor to ack an in-place
+# resize (Tier A of the resize fast path) before falling back to the
+# checkpoint-restart path. Must cover the resharded step's XLA compile
+# (20-40 s on TPU, near-instant when the Tier-B persistent compile cache
+# is warm); the fallback makes a too-small value a performance bug, never
+# a correctness one.
+INPLACE_RESIZE_TIMEOUT_SECONDS = _env_float(
+    "VODA_INPLACE_RESIZE_TIMEOUT_SECONDS", "90")
 
 
 def stop_grace_seconds(override=None) -> float:

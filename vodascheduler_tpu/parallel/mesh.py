@@ -116,6 +116,31 @@ def plan_mesh(num_chips: int,
     return MeshPlan(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep)
 
 
+def remesh(num_chips: int,
+           devices: Optional[Sequence[jax.Device]] = None,
+           model_params_b: float = 0.0,
+           seq_len: int = 0,
+           num_experts: int = 0,
+           topology: Optional["PoolTopology"] = None,
+           plan: Optional[MeshPlan] = None) -> Tuple[MeshPlan, Mesh]:
+    """Plan + build the mesh for a (new) chip count in one call — the
+    mesh half of the Tier-A live-reshard fast path (TrainSession.resize).
+
+    Uses exactly the planning heuristics a cold restart at `num_chips`
+    would use (including the topology's feasibility-rounded slice shape),
+    so an in-place resize lands on the same mesh a checkpoint-restart
+    resize would have built — the two tiers are observationally
+    equivalent apart from cost. Pass `plan` to pin axis sizes explicitly.
+    """
+    if plan is None:
+        slice_shape = (topology.slice_for(num_chips)
+                       if topology is not None else None)
+        plan = plan_mesh(num_chips, model_params_b=model_params_b,
+                         seq_len=seq_len, num_experts=num_experts,
+                         topology=topology, slice_shape=slice_shape)
+    return plan, build_mesh(plan, devices)
+
+
 def build_mesh(plan: MeshPlan,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Materialize the plan over devices (default: all local devices).

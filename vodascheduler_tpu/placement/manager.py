@@ -220,7 +220,8 @@ class PlacementManager:
             my_hosts = [self.host_states[hs.host] for hs in placement.host_slots
                         if hs.host in self.host_states and hs.num_slots > 0]
             while delta > 0:
-                best = self._pick_host(hosts, delta, my_hosts)
+                best = self._pick_host(hosts, delta, my_hosts,
+                                       prefer_own=True)
                 if best is None:
                     break  # tolerated inconsistency: place what fits
                 take = min(best.free_slots, delta)
@@ -327,7 +328,8 @@ class PlacementManager:
         return cross_host, total_contiguity
 
     def _pick_host(self, hosts: List[HostState], requested: int,
-                   my_hosts: List[HostState]) -> Optional[HostState]:
+                   my_hosts: List[HostState],
+                   prefer_own: bool = False) -> Optional[HostState]:
         """Best-fit with ICI tie-breaking.
 
         Reference semantics (:456-480): prefer the host with the *fewest*
@@ -335,7 +337,19 @@ class PlacementManager:
         onto the host with the most free slots. TPU delta: among candidates
         of equal free-slot count, prefer the one closest (torus distance)
         to hosts the job already occupies.
+
+        `prefer_own` (the incremental grow path): when a host the job
+        already occupies can absorb the whole remaining delta, take it —
+        an unchanged host set keeps the process group stable, which is
+        what lets the backend resize in place (Tier A,
+        doc/elastic-resize.md) instead of checkpoint-restarting. The
+        resize-cost saving beats the consolidation a tighter foreign
+        host would buy; defragment() still consolidates explicitly.
         """
+        if prefer_own and my_hosts:
+            own = [h for h in my_hosts if h.free_slots >= requested]
+            if own:
+                return min(own, key=lambda h: h.free_slots)
         fitting = [h for h in hosts if h.free_slots >= requested]
         if fitting:
             best_free = min(h.free_slots for h in fitting)

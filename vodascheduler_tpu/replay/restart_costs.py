@@ -64,11 +64,24 @@ ASSUMED_RESTART_S: Dict[str, float] = {
     "llama8b": 45.0, "mixtral": 60.0,
 }
 
+# Tier-A in-place resize fallback (doc/elastic-resize.md): reshard +
+# recompile only — no process lifecycle, no checkpoint round-trip. The
+# compile dominates and scales with model size; superseded by the
+# measured fast/cold ratio whenever the artifact carries fast-path
+# points (resize_bench `fast_resize_ms`).
+ASSUMED_INPLACE_S: Dict[str, float] = {
+    "resnet50": 3.0, "bert": 4.0, "vitl": 6.0,
+    "llama8b": 15.0, "mixtral": 20.0,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class FamilyCost:
     restart_s: float
     provenance: str  # "measured:<model>" | "scaled:<...>" | "assumed"
+    # Tier-A in-place (fast-path) resize cost for the same family.
+    inplace_s: float = 0.0
+    inplace_provenance: str = "assumed"
 
 
 def load_measured(path: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
@@ -115,17 +128,38 @@ def derive_costs(points: List[Dict[str, Any]]) -> Dict[str, FamilyCost]:
     measured_models = ",".join(dict.fromkeys(
         str(p.get("model")) for p in points))
 
+    # Fast-path (Tier-A) pricing: points carrying a measured
+    # fast_resize_ms yield a pooled fast/cold ratio; a family's in-place
+    # cost is that fraction of its (size-scaled) cold cost — the compile
+    # and reshard scale with the model the same way the cold phases do.
+    # Artifacts predating the fast phase fall back to ASSUMED_INPLACE_S.
+    fast_ratios = [
+        float(p["fast_resize_ms"]) / float(p["restart_total_ms"])
+        for p in points
+        if p.get("fast_resize_ms") and p.get("restart_total_ms")]
+    fast_ratio = (min(1.0, sum(fast_ratios) / len(fast_ratios))
+                  if fast_ratios else None)
+
     out: Dict[str, FamilyCost] = {}
     for fam, fp in FAMILY_FOOTPRINT.items():
         per_chip = (fp["params_b"] * 1e9 * _ADAMW_BYTES_PER_PARAM
                     / fp["typical_chips"])
         cost = fixed_s + per_chip / io_rate
+        if fast_ratio is not None:
+            inplace_s = round(max(0.5, fast_ratio * cost), 1)
+            inplace_prov = (f"scaled:{fast_ratio:.2f}x cold "
+                            f"(measured on {measured_models})")
+        else:
+            inplace_s = ASSUMED_INPLACE_S[fam]
+            inplace_prov = "assumed"
         out[fam] = FamilyCost(
             restart_s=round(cost, 1),
             provenance=(f"scaled:fixed={fixed_s:.1f}s+"
                         f"{per_chip / 1e9:.2f}GB/chip@"
                         f"{io_rate / 1e9:.2f}GB/s "
-                        f"(measured on {measured_models})"))
+                        f"(measured on {measured_models})"),
+            inplace_s=inplace_s,
+            inplace_provenance=inplace_prov)
     return out
 
 
@@ -139,29 +173,43 @@ def family_restart_costs(
     from vodascheduler_tpu.replay.trace import MODEL_FAMILIES
 
     if not (set(MODEL_FAMILIES) == set(FAMILY_FOOTPRINT)
-            == set(ASSUMED_RESTART_S)):
+            == set(ASSUMED_RESTART_S) == set(ASSUMED_INPLACE_S)):
         raise ValueError(
             "replay families out of sync: trace.MODEL_FAMILIES vs "
-            "restart_costs.FAMILY_FOOTPRINT/ASSUMED_RESTART_S — a new "
-            "family needs entries in all three tables")
+            "restart_costs.FAMILY_FOOTPRINT/ASSUMED_RESTART_S/"
+            "ASSUMED_INPLACE_S — a new family needs entries in all four "
+            "tables")
     points = load_measured(path)
     if points:
         return derive_costs(points)
-    return {fam: FamilyCost(restart_s=s, provenance="assumed")
+    return {fam: FamilyCost(restart_s=s, provenance="assumed",
+                            inplace_s=ASSUMED_INPLACE_S[fam],
+                            inplace_provenance="assumed")
             for fam, s in ASSUMED_RESTART_S.items()}
 
 
-def default_restart_seconds(path: Optional[str] = None) -> float:
-    """Family-weighted mean restart cost: the backend fallback for jobs
-    whose profile carries no per-job cost (replay trace jobs all do; this
-    covers ad-hoc jobs). Weighted by trace family mix so the fallback
-    tracks the same provenance as the per-family numbers."""
+def _weighted_mean(path: Optional[str], attr: str) -> float:
     from vodascheduler_tpu.replay.trace import MODEL_FAMILIES
 
     costs = family_restart_costs(path)
     num = den = 0.0
     for fam, spec in MODEL_FAMILIES.items():
         w = float(spec["weight"])
-        num += w * costs[fam].restart_s
+        num += w * getattr(costs[fam], attr)
         den += w
     return round(num / den, 1)
+
+
+def default_restart_seconds(path: Optional[str] = None) -> float:
+    """Family-weighted mean COLD restart cost: the backend fallback for
+    jobs whose profile carries no per-job cost (replay trace jobs all do;
+    this covers ad-hoc jobs). Weighted by trace family mix so the
+    fallback tracks the same provenance as the per-family numbers."""
+    return _weighted_mean(path, "restart_s")
+
+
+def default_inplace_seconds(path: Optional[str] = None) -> float:
+    """Family-weighted mean Tier-A in-place resize cost — the fallback
+    the fake backend charges same-host resizes when a job's profile
+    carries no per-job value."""
+    return _weighted_mean(path, "inplace_s")

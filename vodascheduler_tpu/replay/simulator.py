@@ -51,6 +51,11 @@ class ReplayReport:
     total_chips: int
     restarts_total: int
     rescheds_total: float
+    # Resize-path mix (doc/elastic-resize.md): Tier-A live reshards vs
+    # cold checkpoint-restart resizes (the latter are also in
+    # restarts_total; in-place ones never are).
+    resizes_inplace_total: int = 0
+    cold_resizes_total: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -94,6 +99,9 @@ class ReplayHarness:
         # backend fallback for jobs without a per-job profile cost —
         # trace jobs all carry their family's measured/assumed value).
         restart_overhead_seconds: Optional[float] = None,
+        # Tier-A in-place resize cost fallback; None mirrors the above
+        # via restart_costs.default_inplace_seconds.
+        inplace_overhead_seconds: Optional[float] = None,
         rate_limit_seconds: float = config.RATE_LIMIT_SECONDS,
         # None -> the production defaults (config.SCALE_OUT_HYSTERESIS /
         # RESIZE_COOLDOWN_SECONDS, the r5 sweep knee): replay evidence
@@ -116,8 +124,14 @@ class ReplayHarness:
                 default_restart_seconds,
             )
             restart_overhead_seconds = default_restart_seconds()
+        if inplace_overhead_seconds is None:
+            from vodascheduler_tpu.replay.restart_costs import (
+                default_inplace_seconds,
+            )
+            inplace_overhead_seconds = default_inplace_seconds()
         self.backend = FakeClusterBackend(
-            self.clock, restart_overhead_seconds=restart_overhead_seconds)
+            self.clock, restart_overhead_seconds=restart_overhead_seconds,
+            inplace_overhead_seconds=inplace_overhead_seconds)
 
         self.topology = topology or PoolTopology(torus_dims=(4, 4, 4),
                                                  host_block=(2, 2, 1))
@@ -308,4 +322,6 @@ class ReplayHarness:
             total_chips=self.backend.total_chips(),
             restarts_total=self.backend.restarts_total,
             rescheds_total=self.scheduler.m_resched_total.value(),
+            resizes_inplace_total=self.backend.resizes_inplace_total,
+            cold_resizes_total=self.backend.cold_resizes_total,
         )

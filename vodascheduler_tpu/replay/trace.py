@@ -34,6 +34,9 @@ class TraceJob:
     priority: int = 0
     fail_at_epoch: Optional[int] = None
     restart_overhead_seconds: Optional[float] = None
+    # Tier-A (in-place) resize cost for this job; None falls back to the
+    # backend default (restart_costs.default_inplace_seconds in replay).
+    inplace_overhead_seconds: Optional[float] = None
 
     def job_spec(self, pool: str) -> JobSpec:
         return JobSpec(
@@ -44,10 +47,12 @@ class TraceJob:
                              epochs=self.epochs))
 
     def profile(self) -> WorkloadProfile:
-        return WorkloadProfile(epoch_seconds_at_1=self.epoch_seconds_at_1,
-                               speedup_exponent=self.speedup_exponent,
-                               fail_at_epoch=self.fail_at_epoch,
-                               restart_overhead_seconds=self.restart_overhead_seconds)
+        return WorkloadProfile(
+            epoch_seconds_at_1=self.epoch_seconds_at_1,
+            speedup_exponent=self.speedup_exponent,
+            fail_at_epoch=self.fail_at_epoch,
+            restart_overhead_seconds=self.restart_overhead_seconds,
+            inplace_overhead_seconds=self.inplace_overhead_seconds)
 
 
 # Model families with serial epoch times loosely shaped like the baseline
@@ -130,6 +135,7 @@ def philly_like_trace(
             speedup_exponent=float(fam["exponent"]),
             fail_at_epoch=fail_at,
             restart_overhead_seconds=restart_costs[model].restart_s,
+            inplace_overhead_seconds=restart_costs[model].inplace_s,
         ))
     return jobs
 

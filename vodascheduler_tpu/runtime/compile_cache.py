@@ -1,0 +1,54 @@
+"""Tier B of the elastic-resize fast path: JAX's persistent compilation
+cache, env-configured.
+
+A cold checkpoint-restart resize pays the XLA recompile of the resharded
+train step on its first post-restore step — the dominant share of phase
+(c) in runtime/resize_bench.py's breakdown (20-40 s on TPU per restart,
+multiplied by every restart the scheduler issues). Pointing
+`jax_compilation_cache_dir` at a directory that survives the process
+(job workdir, shared NFS, a GCS bucket on GKE) turns the second and
+every later restart of the same (model, chip count, batch) program into
+a cache read: the unavoidable cold restarts — migrations, multihost
+membership changes, preemption resumes — skip the recompile the Tier-A
+in-place path avoids by never exiting.
+
+One knob: `VODA_COMPILE_CACHE_DIR`. Unset leaves jax's configuration
+completely untouched (hermetic tests pin this). Every process that
+compiles honors it — the supervisor (runtime/supervisor.py), benchmark
+point workers (benchrunner/worker.py), and resize_bench's measurement
+children — so bench evidence and production restarts see the same cache
+behavior.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "VODA_COMPILE_CACHE_DIR"
+
+
+def configure_compilation_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at $VODA_COMPILE_CACHE_DIR.
+
+    Returns the configured directory, or None (and touches nothing) when
+    the env var is unset/empty. Must run before the first compilation;
+    calling it again is harmless. The min-compile-time/entry-size floors
+    drop to zero because restart economics care about *every* compile in
+    the restart path, not just the multi-second ones jax's defaults
+    target.
+    """
+    cache_dir = os.environ.get(ENV_VAR)
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for knob, value in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                        ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # noqa: BLE001 - older jax: dir alone still works
+            pass
+    return cache_dir

@@ -473,6 +473,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # __graft_entry__.py.
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # Tier-B persistent compile cache (doc/elastic-resize.md): standalone
+    # hwbench runs share the cache production restarts warm.
+    from vodascheduler_tpu.runtime.compile_cache import (
+        configure_compilation_cache,
+    )
+    configure_compilation_cache()
     args = list(sys.argv[1:] if argv is None else argv)
     stream = "--stream" in args
     if stream:

@@ -10,7 +10,8 @@ tensorflow2-keras-mnist-elastic.yaml:32-44); the TPU design's
 checkpoint-restart resize is NOT free, so it must be measured, not
 assumed.
 
-What a resize costs end-to-end, as three measured phases:
+What a resize costs end-to-end, per PATH (doc/elastic-resize.md). The
+cold checkpoint-restart path, as three measured phases:
 
   (a) checkpoint save — async initiate (what the running job blocks on),
       async drain, and a synced save for reference; plus checkpoint size.
@@ -19,7 +20,14 @@ What a resize costs end-to-end, as three measured phases:
       handoff is real: each phase runs in its own child so the previous
       owner has exited before the next init.
   (c) restore + first step — Orbax read/device-put, then the first jitted
-      step (which carries the XLA compile).
+      step (which carries the XLA compile; warm when the Tier-B
+      persistent compile cache is configured, VODA_COMPILE_CACHE_DIR).
+
+And the FAST (Tier-A in-place) path, measured in its own child: a live
+TrainSession.resize() — mesh rebuild + donated reshard + the first step
+at the new size — with the process never exiting. The two land in the
+result's `resize_paths` rows (`path: fast|cold`), the numbers the
+scheduler's two-tier pricing consumes (replay/restart_costs.py).
 
 Cross-process stitching uses CLOCK_MONOTONIC (comparable across
 processes on the same host): the parent records spawn time, children
@@ -91,10 +99,12 @@ def bench_resize_cost(model_name: str, global_batch_size: int,
                       workdir: Optional[str] = None) -> Dict[str, Any]:
     """The full resize-cost breakdown for one model at single-chip scale.
 
-    Two sequential children (the chip changes hands exactly like a real
+    Three sequential children (the chip changes hands exactly like a real
     scheduler-driven restart):
       prepare: init -> warm steps -> timed saves -> exit
-      restart: cold start -> restore -> first step
+      restart: cold start -> restore -> first step  (the COLD path)
+      fast:    init -> warm steps -> live resize() -> first step
+               (the FAST path — one process end to end)
     """
     import shutil
     import tempfile
@@ -108,6 +118,17 @@ def bench_resize_cost(model_name: str, global_batch_size: int,
         restart, marks, spawn_t = _run_child(
             "restart", model_name, global_batch_size, ckpt_dir, 1,
             child_timeout)
+        # The fast child is additive evidence: its failure must not
+        # discard the cold measurements the two children above already
+        # produced (per-point resilience — the row ships with
+        # fast_resize_ms=None and the error noted).
+        fast_error = None
+        try:
+            fast, _, _ = _run_child("fast", model_name, global_batch_size,
+                                    ckpt_dir, warm_steps, child_timeout)
+        except Exception as e:  # noqa: BLE001
+            fast = {}
+            fast_error = f"{type(e).__name__}: {str(e)[:300]}"
         t = {m["mark"]: m["t"] for m in marks}
         seg = {}
         prev = spawn_t
@@ -118,6 +139,26 @@ def bench_resize_cost(model_name: str, global_batch_size: int,
                 prev = t[mark]
         total_ms = round((t["first_step_done"] - spawn_t) * 1000.0, 1) \
             if "first_step_done" in t else None
+        # The two-path summary the economics (replay/restart_costs.py)
+        # and the artifact docs key on: `path: fast|cold` rows.
+        cold_seconds = round(
+            ((prep.get("save_sync_ms") or 0.0) + (total_ms or 0.0))
+            / 1000.0, 2)
+        fast_ms = fast.get("fast_resize_ms")
+        fast_row = {"path": "fast",
+                    # None (not 0.0) when unmeasured: a consumer must see
+                    # a missing fast measurement, never a free resize.
+                    "seconds": (round(fast_ms / 1000.0, 2)
+                                if fast_ms else None),
+                    "from_chips": fast.get("fast_from_chips"),
+                    "to_chips": fast.get("fast_to_chips")}
+        if fast_error:
+            fast_row["error"] = fast_error
+        resize_paths = [
+            fast_row,
+            {"path": "cold", "seconds": cold_seconds,
+             "phases": "save_sync + cold restart + restore + first step"},
+        ]
         return {
             "model": model_name,
             "batch": global_batch_size,
@@ -129,12 +170,17 @@ def bench_resize_cost(model_name: str, global_batch_size: int,
             "warm_step_ms": prep.get("warm_step_ms"),
             "restart_segments_ms": seg,
             "restart_total_ms": total_ms,
-            # The number the replay consumes: synced save + full restart
-            # (a preemption-driven resize pays the synchronous save; a
-            # planned resize overlaps the async drain with teardown).
-            "resize_cost_seconds": round(
-                ((prep.get("save_sync_ms") or 0.0) + (total_ms or 0.0))
-                / 1000.0, 2),
+            # Tier-A fast path: live reshard + first step, no process exit.
+            "fast_resize_ms": fast_ms,
+            "fast_from_chips": fast.get("fast_from_chips"),
+            "fast_to_chips": fast.get("fast_to_chips"),
+            **({"fast_error": fast_error} if fast_error else {}),
+            "resize_paths": resize_paths,
+            # The number the replay consumes for COLD resizes: synced save
+            # + full restart (a preemption-driven resize pays the
+            # synchronous save; a planned resize overlaps the async drain
+            # with teardown). Fast resizes are priced from fast_resize_ms.
+            "resize_cost_seconds": cold_seconds,
         }
     finally:
         if own_dir:
@@ -149,6 +195,13 @@ def _child_main(argv: Sequence[str]) -> None:
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    # Tier-B: with VODA_COMPILE_CACHE_DIR set, the restart child's
+    # first-step compile is a persistent-cache read — the bench then
+    # measures the warm-restart cost operators actually pay.
+    from vodascheduler_tpu.runtime.compile_cache import (
+        configure_compilation_cache,
+    )
+    configure_compilation_cache()
     _emit_mark("jax_imported")
     backend = jax.default_backend()
     jax.devices()
@@ -211,6 +264,27 @@ def _child_main(argv: Sequence[str]) -> None:
         session.run_steps(1)
         jax.block_until_ready(session.state)
         _emit_mark("first_step_done")
+    elif phase == "fast":
+        # Tier-A in one process: warm session, then a live resize() +
+        # the first step at the new size — everything the fast path pays
+        # (mesh rebuild, donated reshard, recompile), nothing it doesn't
+        # (no save, no process exit, no restore). Resizes 1 -> 2 when a
+        # second device exists (batch sizes here are even); on a
+        # single-chip host the 1 -> 1 rebuild still prices the
+        # replan+reshard+recompile the fast path pays.
+        devices = jax.devices()
+        target = 2 if len(devices) >= 2 and batch % 2 == 0 else 1
+        session = TrainSession(bundle, 1, devices=devices[:1],
+                               global_batch_size=batch)
+        session.run_steps(steps)
+        jax.block_until_ready(session.state)
+        t0 = time.monotonic()
+        session.resize(target, devices=devices[:target])
+        session.run_steps(1)
+        jax.block_until_ready(session.state)
+        out["fast_resize_ms"] = round((time.monotonic() - t0) * 1000.0, 1)
+        out["fast_from_chips"] = 1
+        out["fast_to_chips"] = target
     else:
         raise ValueError(f"unknown phase {phase!r}")
     print(f"{RESULT_PREFIX}{json.dumps(out)}", flush=True)

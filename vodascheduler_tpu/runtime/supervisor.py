@@ -35,6 +35,91 @@ from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
 # a checkpoint promptly, big enough to amortize dispatch overhead.
 STEPS_PER_CHUNK = 10
 
+# ---- control channel (backend <-> supervisor) ----------------------------
+#
+# The Tier-A resize fast path needs a way for the backend to ask a RUNNING
+# supervisor to change size without killing it. The channel is a command
+# file under <workdir>/control/ (atomic rename writes, monotonically
+# increasing seq) polled between step chunks — the same cadence the
+# SIGTERM stop flag is honored at — plus per-command ack files the backend
+# watches. File-based so it works identically under every transport the
+# backends use (local subprocess, GKE pod with a shared volume,
+# multi-host NFS workdir); commands predating the current incarnation are
+# void, so a checkpoint-restart fallback can never replay the in-place
+# request it replaced.
+
+CONTROL_DIRNAME = "control"
+_CMD_FILE = "cmd.json"
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class ControlChannel:
+    """Supervisor side: poll for commands issued after this process
+    started, and ack them."""
+
+    def __init__(self, workdir: str):
+        self.dir = os.path.join(workdir, CONTROL_DIRNAME)
+        os.makedirs(self.dir, exist_ok=True)
+        stale = _read_json(os.path.join(self.dir, _CMD_FILE))
+        self._last_seq = int(stale.get("seq", 0)) if stale else 0
+
+    def poll(self):
+        """The newest not-yet-seen command, or None."""
+        cmd = _read_json(os.path.join(self.dir, _CMD_FILE))
+        if cmd and int(cmd.get("seq", 0)) > self._last_seq:
+            self._last_seq = int(cmd["seq"])
+            return cmd
+        return None
+
+    def ack(self, seq: int, **fields) -> None:
+        seq = int(seq)
+        _atomic_write_json(os.path.join(self.dir, f"ack_{seq}.json"),
+                           {"seq": seq, **fields})
+        # Prune superseded acks: one resize per rate-limit tick over a
+        # long-lived job would otherwise grow the control dir (shared
+        # volume on gke/multihost) without bound. The backend only ever
+        # reads the ack for the seq it just issued.
+        for name in os.listdir(self.dir):
+            if name.startswith("ack_") and name.endswith(".json"):
+                try:
+                    if int(name[4:-5]) < seq:
+                        os.unlink(os.path.join(self.dir, name))
+                except (ValueError, OSError):
+                    pass
+
+
+def request_resize(workdir: str, num_chips: int) -> int:
+    """Backend side: enqueue an in-place resize; returns the command seq
+    to pass to read_resize_ack."""
+    d = os.path.join(workdir, CONTROL_DIRNAME)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, _CMD_FILE)
+    prev = _read_json(path)
+    seq = (int(prev.get("seq", 0)) if prev else 0) + 1
+    _atomic_write_json(path, {"op": "resize", "num_chips": int(num_chips),
+                              "seq": seq})
+    return seq
+
+
+def read_resize_ack(workdir: str, seq: int):
+    """Backend side: the ack for command `seq`, or None while pending."""
+    return _read_json(os.path.join(workdir, CONTROL_DIRNAME,
+                                   f"ack_{int(seq)}.json"))
+
 
 def _configure_devices() -> None:
     """Hermetic mode: VODA_FORCE_CPU_DEVICES=N gives this process an
@@ -119,8 +204,21 @@ def run_job(workdir: str, num_chips: int,
             metrics_dir: Optional[str] = None) -> int:
     """Train the job described by `<workdir>/spec.json` at num_chips until
     its epoch budget completes, checkpointing every epoch."""
+    # The control channel must exist before ANY slow startup work (jax
+    # import, session build, restore): its ctor snapshots the stale-seq
+    # watermark, and a resize command landing during startup must be
+    # seen as fresh — only commands predating this process are void.
+    control = ControlChannel(workdir)
     _configure_devices()
     _maybe_init_distributed()
+    # Tier-B resize fast path: with VODA_COMPILE_CACHE_DIR set, the
+    # post-restore recompile of a cold restart becomes a persistent-cache
+    # read. Must run before the first compilation; unset leaves jax
+    # untouched.
+    from vodascheduler_tpu.runtime.compile_cache import (
+        configure_compilation_cache,
+    )
+    configure_compilation_cache()
 
     import jax
     from vodascheduler_tpu.common.job import JobSpec
@@ -205,6 +303,9 @@ def run_job(workdir: str, num_chips: int,
                        and jax.process_index() == 0)
     profile_dir = os.path.join(workdir, "profile")
 
+    # In-place resize requests arrive on the control channel (created at
+    # process start, above) and are honored between step chunks — same
+    # cadence as the SIGTERM stop flag.
     warmup_pending = True
     warmup_step_time = 0.0
     last_loss = float("nan")
@@ -229,6 +330,111 @@ def run_job(workdir: str, num_chips: int,
                 session.save(ckpt_dir, wait=True)
                 session.finish_saves()
                 return PREEMPTED_EXIT_CODE
+            cmd = control.poll()
+            if cmd is not None and cmd.get("op") == "resize":
+                seq = int(cmd.get("seq", 0))
+                new_n = int(cmd.get("num_chips", 0))
+                # The Tier-A feasibility gate: the process group must not
+                # change. Any multihost membership change, or a target
+                # beyond this process's visible devices, needs the
+                # checkpoint-restart path — nack and let the backend fall
+                # back (it SIGTERMs and respawns).
+                if not (0 < new_n <= len(jax.devices())
+                        and jax.process_count() == 1):
+                    control.ack(seq, ok=False, path="restart_required",
+                                reason=(f"resize to {new_n} needs a process-"
+                                        f"group change ({len(jax.devices())} "
+                                        f"devices visible across "
+                                        f"{jax.process_count()} processes)"))
+                elif new_n == num_chips:
+                    control.ack(seq, ok=True, path="inplace",
+                                num_chips=num_chips, step=session.step)
+                else:
+                    from vodascheduler_tpu.runtime.train import (
+                        ResizeStateInvalid,
+                    )
+                    t0 = time.monotonic()
+                    try:
+                        session.resize(new_n, devices=jax.devices()[:new_n])
+                    except ResizeStateInvalid as e:
+                        # Donation may have consumed live buffers: nack
+                        # and exit through the preemption protocol — the
+                        # backend's cold fallback restores from the last
+                        # committed checkpoint (step dirs are never
+                        # overwritten in place, so it is intact even if
+                        # the best-effort save below fails).
+                        control.ack(seq, ok=False, path="restart_required",
+                                    reason=str(e)[:300])
+                        print(f"supervisor: {e}; exiting for "
+                              "checkpoint-restart", file=sys.stderr)
+                        try:
+                            session.save(ckpt_dir, wait=True)
+                            session.finish_saves()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return PREEMPTED_EXIT_CODE
+                    except Exception as e:  # noqa: BLE001
+                        # Setup-phase failure (infeasible mesh, batch not
+                        # divisible at the new size, planning error): the
+                        # session was never mutated — nack so the backend
+                        # takes the cold path, and KEEP TRAINING at the
+                        # old size until its SIGTERM arrives.
+                        control.ack(seq, ok=False, path="restart_required",
+                                    reason=f"{type(e).__name__}: "
+                                           f"{str(e)[:300]}")
+                        print(f"supervisor: in-place resize to {new_n} "
+                              f"infeasible ({type(e).__name__}: {e}); "
+                              "continuing at current size",
+                              file=sys.stderr)
+                        continue
+                    old_n, num_chips = num_chips, new_n
+                    try:
+                        # The first step at the new size carries the XLA
+                        # compile (cache-warm when Tier B is configured);
+                        # run it before acking so the ack means "training
+                        # at the new size", and keep it out of the epoch
+                        # telemetry exactly like the startup warmup step.
+                        t_w = time.monotonic()
+                        last_loss = session.run_steps(1)
+                        # Re-anchor the warmup fallback to the NEW size:
+                        # if the resize consumed the epoch's last steps,
+                        # the no-clean-sample fallback must not attribute
+                        # the old size's startup step time to the new
+                        # chip count.
+                        warmup_step_time = time.monotonic() - t_w
+                    except Exception as e:  # noqa: BLE001
+                        # Post-reshard step failure (OOM / compile): the
+                        # state was donated into the failed execution —
+                        # same invalid-state exit as above.
+                        control.ack(seq, ok=False, path="restart_required",
+                                    reason=f"{type(e).__name__}: "
+                                           f"{str(e)[:300]}")
+                        print(f"supervisor: first step after in-place "
+                              f"resize to {new_n} failed "
+                              f"({type(e).__name__}: {e}); exiting for "
+                              "checkpoint-restart", file=sys.stderr)
+                        try:
+                            session.save(ckpt_dir, wait=True)
+                            session.finish_saves()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return PREEMPTED_EXIT_CODE
+                    resize_ms = (time.monotonic() - t0) * 1000.0
+                    control.ack(seq, ok=True, path="inplace",
+                                num_chips=new_n, step=session.step,
+                                resize_ms=round(resize_ms, 1))
+                    # Greppable fast-path evidence (counterpart of the
+                    # cold path's "resumed at step" line).
+                    print(f"resized in-place {old_n} -> {new_n} chips at "
+                          f"step {session.step} ({resize_ms:.0f} ms)",
+                          flush=True)
+                    # The epoch's already-timed steps ran at the old size;
+                    # the row must reflect the size it reports.
+                    timed_steps = 0
+                    timed_time = 0.0
+                    profiled_steps = 0
+                    profiled_time = 0.0
+                continue
             n = min(STEPS_PER_CHUNK, epoch_end_step - session.step)
             if profile_pending:
                 # Profiler calls are best-effort (remote-TPU transports

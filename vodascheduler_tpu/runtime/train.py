@@ -26,11 +26,12 @@ import jax.numpy as jnp
 import optax
 
 from vodascheduler_tpu.models.registry import ModelBundle
-from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
+from vodascheduler_tpu.parallel.mesh import MeshPlan, remesh
 from vodascheduler_tpu.parallel.ring_attention import make_ring_attention
 from vodascheduler_tpu.parallel.sharding import (
     batch_sharding,
     param_shardings,
+    reshard_state,
 )
 
 
@@ -83,19 +84,17 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
                      global_batch_size: int = 8,
                      topology: Optional[Any] = None) -> TrainSetup:
     devices = list(devices if devices is not None else jax.devices())[:num_chips]
-    if plan is None:
-        # The pool topology (PoolTopology via the backend's VODA_TOPOLOGY
-        # env) reshapes planning for the pool's real host block — tp stays
-        # intra-host on v5e-style 1/8-chip hosts as well as the 4-chip
-        # default — and the granted slice shape (the allocator's
-        # feasibility-rounded unit) pins the chip count exactly.
-        slice_shape = (topology.slice_for(num_chips)
-                       if topology is not None else None)
-        plan = plan_mesh(num_chips, model_params_b=bundle.params_b,
-                         seq_len=bundle.seq_len,
-                         num_experts=bundle.num_experts,
-                         topology=topology, slice_shape=slice_shape)
-    mesh = build_mesh(plan, devices)
+    # The pool topology (PoolTopology via the backend's VODA_TOPOLOGY
+    # env) reshapes planning for the pool's real host block — tp stays
+    # intra-host on v5e-style 1/8-chip hosts as well as the 4-chip
+    # default — and the granted slice shape (the allocator's
+    # feasibility-rounded unit) pins the chip count exactly. remesh is
+    # the same entry the live-resize fast path takes, so both resize
+    # tiers build identical meshes for a given chip count.
+    plan, mesh = remesh(num_chips, devices, model_params_b=bundle.params_b,
+                        seq_len=bundle.seq_len,
+                        num_experts=bundle.num_experts,
+                        topology=topology, plan=plan)
     module = bundle.module
 
     # Pipeline parallelism: plan.pp > 1 swaps the forward dataflow for
@@ -254,6 +253,15 @@ def make_train_setup(bundle: ModelBundle, num_chips: int,
                       train_step_raw=train_step)
 
 
+class ResizeStateInvalid(RuntimeError):
+    """An in-place resize failed AFTER the live state may have been
+    consumed by buffer donation: the session must not keep training on
+    it. The caller falls back to checkpoint-restart (the last committed
+    checkpoint is never overwritten in place, so restore is safe).
+    Failures raised as anything else happened before any mutation — the
+    session is intact and may keep training at its old size."""
+
+
 class TrainSession:
     """A live training session at a fixed chip count."""
 
@@ -266,6 +274,8 @@ class TrainSession:
         self.bundle = bundle
         self.num_chips = num_chips
         self.global_batch_size = global_batch_size
+        self.learning_rate = learning_rate
+        self.topology = topology
         self.setup = make_train_setup(bundle, num_chips, devices=devices,
                                       plan=plan, learning_rate=learning_rate,
                                       global_batch_size=global_batch_size,
@@ -296,6 +306,58 @@ class TrainSession:
             batch = self.setup.make_batch(self.global_batch_size, sub)
             self.state, loss = self.setup.train_step(self.state, batch)
         return float(loss)
+
+    def resize(self, new_num_chips: int,
+               devices: Optional[Sequence[jax.Device]] = None,
+               plan: Optional[MeshPlan] = None,
+               learning_rate: Optional[float] = None) -> "TrainSession":
+        """Tier-A elastic resize: live reshard to a new chip count — no
+        checkpoint, no process exit.
+
+        Rebuilds the mesh/shardings/jitted step for `new_num_chips` (the
+        same planning a cold restart would do, runtime/train.py module
+        doc) and moves the live param+optimizer state onto the new layout
+        with one donated collective device_put (sharding.reshard_state).
+        Valid only while the process group is unchanged — the caller
+        (supervisor control channel) falls back to checkpoint-restart
+        when membership actually changes (migration / multihost resize).
+
+        `learning_rate` defaults to the session's current one; pass the
+        rescaled value for linear-LR-scaling policies (the same rescale
+        the cold path applies on restore, TrainSession.resume).
+        """
+        self._require_state()
+        if devices is None:
+            devices = list(jax.devices())[:new_num_chips]
+        if len(devices) < new_num_chips:
+            raise ValueError(
+                f"in-place resize to {new_num_chips} chips needs "
+                f"{new_num_chips} visible devices, have {len(devices)} — "
+                "this resize requires a checkpoint-restart")
+        if learning_rate is None:
+            learning_rate = self.learning_rate
+        # Any in-flight async save already copied device buffers to host
+        # synchronously (checkpoint.py contract), so donating the device
+        # state here cannot corrupt it.
+        # Setup failures (infeasible mesh, planning errors) raise plainly
+        # BEFORE any mutation: the session is untouched and usable.
+        new_setup = make_train_setup(
+            self.bundle, new_num_chips, devices=devices, plan=plan,
+            learning_rate=learning_rate,
+            global_batch_size=self.global_batch_size,
+            topology=self.topology)
+        try:
+            self.state = reshard_state(self.state,
+                                       new_setup.state_shardings)
+        except Exception as e:  # noqa: BLE001
+            # Donation may have consumed source buffers mid-transfer.
+            raise ResizeStateInvalid(
+                f"live reshard to {new_num_chips} chips failed "
+                f"mid-donation: {type(e).__name__}: {e}") from e
+        self.setup = new_setup
+        self.num_chips = new_num_chips
+        self.learning_rate = learning_rate
+        return self
 
     def save(self, ckpt_dir: str, keep_last: int = 2,
              wait: bool = True) -> int:

@@ -36,6 +36,7 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterBackend,
     ClusterEvent,
     ClusterEventKind,
+    ResizePath,
 )
 from vodascheduler_tpu.common.clock import Clock, VirtualClock
 from vodascheduler_tpu.common.events import EventBus, JobEvent
@@ -51,16 +52,16 @@ from vodascheduler_tpu.placement import PlacementManager
 
 log = logging.getLogger(__name__)
 
-# Reference default is 30 s (scheduler.go:212); under measured restart
-# pricing the r5 sweep pick is 45 s (flat surface, util-first tiebreak
-# — config.py), so the shipped value comes from config (one source of
-# truth, env-overridable).
+# Reference default is 30 s (scheduler.go:212); under two-tier resize
+# pricing the r6 sweep pick is 15 s (cheap in-place resizes reward a
+# scheduler that acts more often — config.py), so the shipped value
+# comes from config (one source of truth, env-overridable).
 DEFAULT_RATE_LIMIT_SECONDS = config.RATE_LIMIT_SECONDS
 DEFAULT_TICKER_SECONDS = 5.0        # reference: rateLimitTimeMetricsSeconds
-# TPU-delta knobs at the r5 sweep pick (re-derived under measured
-# restart pricing; the surface is flat — config.py narrative). Values
-# live in config (one source of truth, env-overridable); the replay
-# guards (tests/test_replay.py) pin the same values.
+# TPU-delta knobs at the r6 sweep pick (re-derived under two-tier
+# resize pricing — config.py narrative). Values live in config (one
+# source of truth, env-overridable); the replay guards
+# (tests/test_replay.py) pin the same values.
 DEFAULT_SCALE_OUT_HYSTERESIS = config.SCALE_OUT_HYSTERESIS
 DEFAULT_RESIZE_COOLDOWN_SECONDS = config.RESIZE_COOLDOWN_SECONDS
 
@@ -187,7 +188,15 @@ class Scheduler:
             const_labels=pool_l)
         self.m_job_restarts = registry.counter(
             "voda_scheduler_job_restarts_total",
-            "Checkpoint-restart incarnations (start/scale/migration)",
+            "Checkpoint-restart incarnations (start/cold scale/migration)",
+            const_labels=pool_l)
+        # The resize-path split (doc/elastic-resize.md): an in-place live
+        # reshard never stopped the process, so it is NOT a restart — it
+        # gets its own series and leaves the restart counter (and the
+        # preemption lease) alone.
+        self.m_job_resizes_inplace = registry.counter(
+            "voda_scheduler_job_resizes_inplace_total",
+            "Elastic resizes taken in-place (live reshard, no restart)",
             const_labels=pool_l)
         registry.gauge("voda_scheduler_ready_jobs",
                        "Jobs in the ready queue",
@@ -540,8 +549,16 @@ class Scheduler:
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
         """Suppress small scale-outs of recently-resized running jobs (see
-        ctor comment) — on TPU every resize is a checkpoint-restart, so a
+        ctor comment) — a cold TPU resize is a checkpoint-restart, so a
         +1/-1 oscillation burns two restart windows for negligible speedup.
+
+        Fast-path pricing (doc/elastic-resize.md): a grow that fits the
+        job's CURRENT host set keeps the process group stable, so the
+        backend can apply it as a Tier-A in-place reshard at a fraction
+        of the restart cost — the premise behind suppression doesn't
+        hold, and suppressing would strand cheap speedup. Those grows
+        pass through; only growth that must add hosts (a cold restart
+        for certain) is hysteresis-gated.
 
         Keeping the old (smaller) allocation only shrinks the total, so
         the result stays valid; the cooldown guarantees the growth
@@ -556,9 +573,43 @@ class Scheduler:
             n_old = old.get(job, 0)
             if (n_old > 0 and n_new > n_old
                     and n_new < _math.ceil(n_old * self.scale_out_hysteresis)
+                    and not self._grow_fits_current_hosts(job, n_new)
                     and now - self._last_resize_at.get(job, -float("inf"))
                     < self.resize_cooldown_seconds):
                 new[job] = n_old
+
+    def _grow_fits_current_hosts(self, job: str, n_new: int) -> bool:
+        """Whether growing `job` to n_new chips can plausibly be applied
+        as a Tier-A in-place reshard: the backend must support the fast
+        path at all, the job must occupy exactly ONE host (the real
+        feasibility gate is a single unchanged process — any multi-host
+        resize is a membership change, always cold), and that host's own
+        + FREE slots must cover the target. Slots held by other jobs
+        don't count — growing into them would force a foreign host (a
+        cold restart), exactly what the hysteresis this gates exists to
+        suppress. The bound reads pre-placement free_slots, so it can
+        err in both directions within one pass (a same-pass shrink
+        frees more; a same-pass start can claim the slot first). A
+        wrong wave-through costs one mispriced cold resize and the
+        cooldown gates the next — bounded, and on the measured headline
+        this branch fires rarely (the hysteresis window itself binds
+        only a couple of times per replay)."""
+        if (self.placement_manager is None
+                or not getattr(self.backend, "supports_inplace_resize",
+                               False)):
+            return False
+        placement = self.placement_manager.job_placements.get(job)
+        if placement is None:
+            return False
+        hosts = self.placement_manager.host_states
+        occupied = {hs.host for hs in placement.host_slots
+                    if hs.num_slots > 0 and hs.host in hosts}
+        if len(occupied) != 1:
+            return False
+        own = sum(hs.num_slots for hs in placement.host_slots
+                  if hs.num_slots > 0 and hs.host in hosts)
+        free = max(0, hosts[next(iter(occupied))].free_slots)
+        return 0 < n_new <= own + free
 
     def _schedule_retry(self) -> None:
         """Reference: TriggerReschedAtTime after allocator failure
@@ -676,13 +727,22 @@ class Scheduler:
 
     def _scale_job(self, name: str,
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        """Reference: scaleTrainingJob (scheduler.go:542-574)."""
-        self.backend.scale_job(name, self.job_num_chips[name], placements)
-        self.m_job_restarts.inc()
+        """Reference: scaleTrainingJob (scheduler.go:542-574), priced by
+        the path the backend actually took (doc/elastic-resize.md)."""
+        path = self.backend.scale_job(name, self.job_num_chips[name],
+                                      placements)
         self._last_resize_at[name] = self.clock.now()
+        if path == ResizePath.INPLACE:
+            # The job never stopped: no restart counted, and the
+            # preemption lease (seconds_since_restart) keeps running —
+            # re-arming it here would shield a live-resized job from
+            # eviction it never earned (and skew restart metrics).
+            self.m_job_resizes_inplace.inc()
+            return
+        self.m_job_restarts.inc()
         job = self.ready_jobs.get(name)
         if job is not None:
-            # A resize is a checkpoint-restart too: re-arm the preemption
+            # A cold resize is a checkpoint-restart: re-arm the preemption
             # lease so the just-restarted job isn't evicted back-to-back.
             job.metrics.seconds_since_restart = 0.0
             self.store.update_job(job)
