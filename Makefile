@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast bench bench-dryrun native docker deploy-gke clean
+.PHONY: test test-all test-fast bench bench-dryrun trace-dryrun native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -32,6 +32,14 @@ bench:
 # also wired into the tier-1 suite (tests/test_benchrunner.py).
 bench-dryrun:
 	$(PY) -m vodascheduler_tpu.benchrunner.dryrun
+
+# Decision-audit plane dryrun: a short fake-backend scenario (start,
+# in-place shrink, completion-driven grow) whose every emitted trace
+# record is schema-validated — unknown reason codes or unstitched
+# supervisor spans fail the build. Fast (~2s); also in tier-1 via
+# tests/test_obs.py.
+trace-dryrun:
+	$(PY) -m vodascheduler_tpu.obs.dryrun
 
 # Build the C++ resched kernels from source. The binary is a build
 # artifact (never checked into git — .gitignore covers *.so); CI and

@@ -59,6 +59,13 @@ SCALE_OUT_HYSTERESIS = _config.SCALE_OUT_HYSTERESIS
 RESIZE_COOLDOWN_SECONDS = _config.RESIZE_COOLDOWN_SECONDS
 
 
+# The replay's decision-audit stream (doc/observability.md): every
+# resched pass's trigger/queue/delta-reason record, schema-validated and
+# attached to the bench artifact as provenance — the trace-data shape the
+# Placeto/NEST line of placement-learning work consumes.
+AUDIT_JSONL = os.path.join("doc", "bench_audit.jsonl")
+
+
 def run_replay():
     from vodascheduler_tpu.placement import PoolTopology
     from vodascheduler_tpu.replay import ReplayHarness, philly_like_trace
@@ -69,13 +76,45 @@ def run_replay():
     # Spot preemption (BASELINE config 5): two hosts reclaimed mid-trace,
     # returned later — the fleet dips 8/64 chips for ~1.4 simulated hours.
     preemptions = config5_preemptions(topology)
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    audit_path = os.path.join(repo_dir, AUDIT_JSONL)
+    try:
+        os.unlink(audit_path)  # fresh capture; no stale rounds appended
+    except OSError:
+        pass
     harness = ReplayHarness(trace, algorithm="ElasticTiresias",
                             topology=topology,
                             rate_limit_seconds=RATE_LIMIT_SECONDS,
                             scale_out_hysteresis=SCALE_OUT_HYSTERESIS,
                             resize_cooldown_seconds=RESIZE_COOLDOWN_SECONDS,
                             preemptions=preemptions)
-    return harness.run()
+    # Sink config set after ctor on purpose: the harness already built
+    # its tracer on its own VirtualClock (deterministic ids); only the
+    # file sink is added here. kinds filters that sink to audit records
+    # (spans stay in the ring) so the artifact is the decision stream,
+    # not megabytes of span noise.
+    harness.tracer.trace_dir = os.path.dirname(audit_path)
+    harness.tracer.filename = os.path.basename(audit_path)
+    harness.tracer.kinds = {"resched_audit"}
+    return harness.run(), audit_path
+
+
+def audit_provenance(audit_path: str) -> dict:
+    """Schema-validate the captured audit JSONL and summarize it for the
+    bench artifact's detail section."""
+    from vodascheduler_tpu.obs import validate_jsonl
+    try:
+        with open(audit_path) as f:
+            records = sum(1 for line in f if line.strip())
+    except OSError:
+        return {"path": AUDIT_JSONL, "records": 0,
+                "error": "audit JSONL missing (read-only checkout?)"}
+    problems = validate_jsonl(audit_path)
+    out = {"path": AUDIT_JSONL, "records": records,
+           "schema_errors": len(problems)}
+    if problems:
+        out["first_error"] = problems[0]
+    return out
 
 
 # The model point set for the hardware section. Order here no longer
@@ -364,7 +403,7 @@ def maybe_hardware():
 
 
 def main() -> None:
-    report = run_replay()
+    report, audit_path = run_replay()
     detail = {
         # BASELINE metric is "avg JCT + cluster util": both headline-level.
         "avg_jct_seconds": round(report.avg_jct_seconds, 1),
@@ -388,6 +427,9 @@ def main() -> None:
         "knobs": {"rate_limit_seconds": RATE_LIMIT_SECONDS,
                   "scale_out_hysteresis": SCALE_OUT_HYSTERESIS,
                   "resize_cooldown_seconds": RESIZE_COOLDOWN_SECONDS},
+        # Per-decision provenance: the replay's full audit stream
+        # (schema-validated JSONL) rides alongside the benchrunner rows.
+        "audit": audit_provenance(audit_path),
     }
     hw = maybe_hardware()
     if hw is not None:

@@ -176,3 +176,68 @@ class TestEventBusReviewFixes:
         bus = EventBus()
         bus.subscribe("pool", lambda e: (_ for _ in ()).throw(RuntimeError("boom")))
         bus.publish("pool", JobEvent(EventVerb.CREATE, "x"))  # must not raise
+
+
+class TestMetricsConcurrency:
+    """Satellite fix (obs PR): unlabeled Gauge.set/value, Counter.value,
+    and Summary.count/mean used to read/write shared dicts outside
+    self._lock — scrapes race increments. Stress every instrument with a
+    concurrent scrape loop and check the final values are exact."""
+
+    def test_scrape_vs_inc_stress(self):
+        import threading
+
+        from vodascheduler_tpu.common.metrics import Registry
+
+        r = Registry()
+        counter = r.counter("voda_stress_counter_total", "c", ("k",))
+        gauge = r.gauge("voda_stress_gauge", "g")
+        lgauge = r.gauge("voda_stress_labeled_gauge", "lg", labels=("k",))
+        summary = r.summary("voda_stress_summary_seconds", "s", ("k",))
+        hist = r.histogram("voda_stress_histogram_seconds", "h", ("k",),
+                           buckets=(0.5, 1.5))
+
+        N, WRITERS = 400, 4
+        stop = threading.Event()
+        scrape_errors = []
+
+        def scrape_loop():
+            while not stop.is_set():
+                try:
+                    text = r.exposition()
+                    assert "voda_stress_counter_total" in text
+                    counter.value(k="a")
+                    gauge.value()
+                    lgauge.value(k="a")
+                    summary.count(k="a")
+                    summary.mean(k="a")
+                    hist.count(k="a")
+                except Exception as e:  # noqa: BLE001
+                    scrape_errors.append(e)
+                    return
+
+        def write_loop():
+            for i in range(N):
+                counter.inc(k="a")
+                gauge.set(float(i))
+                lgauge.set(float(i), k="a")
+                summary.observe(1.0, k="a")
+                hist.observe(1.0, k="a")
+
+        scrapers = [threading.Thread(target=scrape_loop) for _ in range(2)]
+        writers = [threading.Thread(target=write_loop)
+                   for _ in range(WRITERS)]
+        for t in scrapers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in scrapers:
+            t.join()
+
+        assert not scrape_errors, scrape_errors
+        assert counter.value(k="a") == N * WRITERS
+        assert summary.count(k="a") == N * WRITERS
+        assert summary.mean(k="a") == 1.0
+        assert hist.count(k="a") == N * WRITERS
+        assert hist.bucket_counts(k="a") == {0.5: 0, 1.5: N * WRITERS}

@@ -40,6 +40,23 @@ class TestMetricsCatalog:
         # a substantial catalog.
         assert len(_source_series()) >= 25
 
+    def test_histogram_series_documented_as_histograms(self):
+        """The decision-audit PR's bucketed instruments: each must be in
+        the catalog AND typed `histogram` on its row (a histogram family
+        scrapes as _bucket/_sum/_count — a reader needs the type to query
+        it)."""
+        with open(os.path.join(REPO, "doc",
+                               "prometheus-metrics-exposed.md")) as f:
+            doc = f.read()
+        for series in ("voda_scheduler_resched_latency_seconds",
+                       "voda_scheduler_resize_duration_seconds",
+                       "voda_allocator_algorithm_runtime_seconds",
+                       "voda_job_step_time_seconds"):
+            rows = [ln for ln in doc.splitlines() if series in ln]
+            assert rows, f"{series} missing from the catalog"
+            assert any("histogram" in row for row in rows), \
+                f"{series} row does not declare type histogram"
+
 
 class TestApisDoc:
     def test_documented_routes_exist_in_rest_layer(self):
@@ -51,6 +68,32 @@ class TestApisDoc:
         for route in ("/training", "/algorithm", "/ratelimit",
                       "/allocation", "/metrics"):
             assert route in doc and route in rest
+
+    def test_debug_routes_documented(self):
+        """The decision-audit debug surface: routes must exist in the
+        REST layer and be documented (apis.md + observability.md)."""
+        with open(os.path.join(REPO, "doc", "apis.md")) as f:
+            doc = f.read()
+        with open(os.path.join(REPO, "vodascheduler_tpu", "service",
+                               "rest.py")) as f:
+            rest = f.read()
+        for route in ("/debug/resched", "/debug/trace"):
+            assert route in doc and route in rest
+        assert "explain" in doc  # the CLI verb riding these routes
+
+    def test_observability_doc_covers_contract(self):
+        """doc/observability.md documents the record schema, the reason
+        vocabulary, and the retention knobs."""
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        from vodascheduler_tpu.obs import REASON_CODES, TRIGGERS
+        for code in sorted(REASON_CODES) + sorted(TRIGGERS):
+            assert code in doc, f"reason/trigger {code!r} undocumented"
+        for knob in ("VODA_TRACE_DIR", "VODA_TRACE_RING",
+                     "VODA_TRACE_MAX_MB"):
+            assert knob in doc, f"retention knob {knob} undocumented"
+        for kind in ("resched_audit", "span", "http_access"):
+            assert kind in doc, f"record kind {kind} undocumented"
 
 
 def test_helm_chart_values_references_resolve():

@@ -11,6 +11,7 @@ Usage:
   python -m vodascheduler_tpu.cli get jobs
   python -m vodascheduler_tpu.cli get status      # scheduler's table
   python -m vodascheduler_tpu.cli algorithm <name>
+  python -m vodascheduler_tpu.cli explain <job>   # decision-audit history
 """
 
 from __future__ import annotations
@@ -89,6 +90,14 @@ def main(argv=None) -> int:
     p_rate = sub.add_parser("ratelimit", help="set resched rate limit")
     p_rate.add_argument("seconds", type=float)
 
+    p_explain = sub.add_parser(
+        "explain",
+        help="why did the scheduler resize this job? (decision-audit "
+             "history from GET /debug/trace/<job>)")
+    p_explain.add_argument("name")
+    p_explain.add_argument("-n", type=int, default=20,
+                           help="max decisions to show (newest last)")
+
     args = parser.parse_args(argv)
     from urllib.parse import quote as _q
     pool_q = f"?pool={_q(args.pool, safe='')}" if args.pool else ""
@@ -120,7 +129,48 @@ def main(argv=None) -> int:
         out = _request(f"{args.scheduler_server}/ratelimit{pool_q}", "PUT",
                        json.dumps({"seconds": args.seconds}).encode())
         print(f"rate limit set: {out['seconds']}s")
+    elif args.command == "explain":
+        from urllib.parse import quote
+        out = _request(f"{args.scheduler_server}/debug/trace/"
+                       f"{quote(args.name, safe='')}{pool_q}")
+        _print_explain(args.name, out, limit=args.n)
     return 0
+
+
+def _print_explain(job: str, payload: dict, limit: int = 20) -> None:
+    """Human rendering of the decision-audit history: one line per resched
+    that touched the job, with its trigger(s) and reason codes."""
+    records = payload.get("records", [])[-limit:]
+    if not records:
+        print(f"no recorded decisions for {job!r} (ring empty or job "
+              "unknown; the JSONL sink under VODA_TRACE_DIR keeps the "
+              "long tail)")
+        return
+    print(f"decision history for {job} (oldest first):")
+    for rec in records:
+        delta = next((d for d in rec.get("deltas", ())
+                      if d.get("job") == job), None)
+        if delta is None:
+            continue
+        reasons = ",".join(delta.get("reasons", ()))
+        extra = ""
+        if "resize_seconds" in delta:
+            extra = f" in {delta['resize_seconds']}s"
+        print(f"  [{rec.get('ts', 0):.1f}] resched#{rec.get('seq')} "
+              f"({'+'.join(rec.get('triggers', ()))}, "
+              f"{rec.get('algorithm')}): "
+              f"{delta.get('before')} -> {delta.get('after')} chips "
+              f"[{reasons}]{extra}")
+    spans = payload.get("spans", [])
+    if spans:
+        print(f"recent spans ({len(spans)}):")
+        for s in spans[-limit:]:
+            attrs = s.get("attrs", {})
+            path = f" path={attrs['path']}" if "path" in attrs else ""
+            print(f"  [{s.get('start', 0):.1f}] {s.get('name')} "
+                  f"{s.get('duration_ms')}ms "
+                  f"status={s.get('status')}{path} "
+                  f"trace={s.get('trace_id')}")
 
 
 if __name__ == "__main__":

@@ -23,9 +23,26 @@ from __future__ import annotations
 import abc
 import dataclasses
 import enum
+import json
 from typing import Callable, Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.obs import tracer as obs_tracer
+
+
+def spec_dict_with_trace(spec: JobSpec) -> dict:
+    """The spec as serialized for a training supervisor, carrying the
+    ambient trace context in extra.trace_context (a JSON string — extra
+    is a str->str map) so the supervisor's startup span stitches into
+    the resched trace that launched it. Shared by every spawning backend
+    (local/multihost/gke); a no-op copy outside a trace."""
+    d = spec.to_dict()
+    ctx = obs_tracer.current_context()
+    if ctx is not None:
+        extra = dict(d.get("extra") or {})
+        extra["trace_context"] = json.dumps(ctx.to_dict())
+        d["extra"] = extra
+    return d
 
 
 class ResizePath(str, enum.Enum):

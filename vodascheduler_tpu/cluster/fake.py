@@ -39,6 +39,7 @@ from vodascheduler_tpu.cluster.backend import (
 )
 from vodascheduler_tpu.common.clock import VirtualClock
 from vodascheduler_tpu.common.job import JobSpec, category_of
+from vodascheduler_tpu.obs import tracer as obs_tracer
 
 
 @dataclasses.dataclass
@@ -194,6 +195,21 @@ class FakeClusterBackend(ClusterBackend):
 
     def start_job(self, spec: JobSpec, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        # Simulated counterparts of the real chain's backend + supervisor
+        # spans (cluster/local.py, runtime/supervisor.py): same
+        # names/components/attrs, parented on the ambient resched context
+        # — a replay trace and a live trace of the same workload are
+        # directly diffable.
+        tracer = obs_tracer.active_tracer()
+        with tracer.span("backend.start", component="backend",
+                         attrs={"job": spec.name, "chips": num_workers}):
+            with tracer.span("supervisor.start", component="supervisor",
+                             attrs={"job": spec.name, "chips": num_workers,
+                                    "simulated": True}):
+                self._start_job_traced(spec, num_workers, placements)
+
+    def _start_job_traced(self, spec: JobSpec, num_workers: int,
+                          placements: Optional[List[Tuple[str, int]]]) -> None:
         now = self.clock.now()
         existing = self.jobs.get(spec.name)
         if existing is not None:
@@ -241,24 +257,40 @@ class FakeClusterBackend(ClusterBackend):
         inplace = (sim.num_workers > 0 and num_workers > 0
                    and old_hosts is not None and new_hosts is not None
                    and len(old_hosts) == 1 and old_hosts == new_hosts)
-        sim.num_workers = num_workers
-        if placements is not None:
-            sim.placements = placements
-        if inplace:
-            sim.resizes_inplace += 1
-            self.resizes_inplace_total += 1
-        else:
-            sim.restarts += 1
-            self.restarts_total += 1
-            self.cold_resizes_total += 1
-        now = self.clock.now()
-        sim.busy_until = now + (self._inplace_overhead(sim) if inplace
-                                else self._overhead(sim))
-        sim.epoch_started_at = now
-        sim.epoch_started_serial = sim.progress_serial
-        sim.epoch_started_workers = num_workers
-        sim.generation += 1
-        self._schedule_next_event(sim)
+        # Simulated backend.scale + supervisor.resize spans: same schema
+        # the real chain writes for its control-channel resize handling,
+        # so one fake-backend resched stitches scheduler -> ... -> backend
+        # -> supervisor exactly like a live run (and replay/live traces
+        # diff cleanly).
+        tracer = obs_tracer.active_tracer()
+        with tracer.span(
+                "backend.scale", component="backend",
+                attrs={"job": name, "chips": num_workers,
+                       "path": "inplace" if inplace else "restart"}), \
+            tracer.span(
+                "supervisor.resize", component="supervisor",
+                attrs={"job": name, "from_chips": sim.num_workers,
+                       "to_chips": num_workers,
+                       "path": "inplace" if inplace else "restart",
+                       "simulated": True}):
+            sim.num_workers = num_workers
+            if placements is not None:
+                sim.placements = placements
+            if inplace:
+                sim.resizes_inplace += 1
+                self.resizes_inplace_total += 1
+            else:
+                sim.restarts += 1
+                self.restarts_total += 1
+                self.cold_resizes_total += 1
+            now = self.clock.now()
+            sim.busy_until = now + (self._inplace_overhead(sim) if inplace
+                                    else self._overhead(sim))
+            sim.epoch_started_at = now
+            sim.epoch_started_serial = sim.progress_serial
+            sim.epoch_started_workers = num_workers
+            sim.generation += 1
+            self._schedule_next_event(sim)
         return ResizePath.INPLACE if inplace else ResizePath.RESTART
 
     def stop_job(self, name: str) -> None:
@@ -267,10 +299,12 @@ class FakeClusterBackend(ClusterBackend):
         sim = self.jobs.get(name)
         if sim is None:
             return
-        self._accrue(sim)
-        sim.num_workers = 0
-        sim.placements = []
-        sim.generation += 1  # cancel pending timers
+        with obs_tracer.active_tracer().span(
+                "backend.stop", component="backend", attrs={"job": name}):
+            self._accrue(sim)
+            sim.num_workers = 0
+            sim.placements = []
+            sim.generation += 1  # cancel pending timers
 
     def migrate_workers(self, name: str,
                         placements: List[Tuple[str, int]]) -> None:

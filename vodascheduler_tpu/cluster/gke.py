@@ -64,6 +64,7 @@ from vodascheduler_tpu.cluster.backend import (
 )
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+from vodascheduler_tpu.obs import tracer as obs_tracer
 
 LOG = logging.getLogger(__name__)
 
@@ -315,7 +316,9 @@ class GkeBackend(ClusterBackend):
 
     def start_job(self, spec: JobSpec, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        with self._lock:
+        with obs_tracer.active_tracer().span(
+                "backend.start", component="backend",
+                attrs={"job": spec.name, "chips": num_workers}), self._lock:
             if spec.name in self._jobs:
                 raise RuntimeError(f"job {spec.name!r} already running")
             self._missing_pods.pop(spec.name, None)  # fresh vanish grace
@@ -351,6 +354,9 @@ class GkeBackend(ClusterBackend):
             raise KeyError(f"unknown job {name!r}")
         with self._lock:
             self._resizing.add(name)
+        resize_span = obs_tracer.active_tracer().start_span(
+            "backend.scale", component="backend",
+            attrs={"job": name, "chips": num_workers, "path": "restart"})
         try:
             try:
                 self._delete_pods(name)
@@ -394,7 +400,11 @@ class GkeBackend(ClusterBackend):
                 self._jobs[name] = JobHandle(name=name,
                                              num_workers=num_workers,
                                              placements=list(placements))
+        except BaseException as e:
+            resize_span.set_error(e)
+            raise
         finally:
+            resize_span.end()
             with self._lock:
                 self._resizing.discard(name)
         self._ensure_monitor()
@@ -527,6 +537,14 @@ class GkeBackend(ClusterBackend):
             env = [
                 {"name": "VODA_JOB_NAME", "value": spec.name},
             ]
+            # Cross-process trace stitching: pods have no spec.json write
+            # from this side of the PVC, so the scheduler's trace context
+            # rides a pod env var instead (the supervisor falls back to it
+            # when the spec carries none).
+            ctx = obs_tracer.current_context()
+            if ctx is not None:
+                env.append({"name": "VODA_TRACE_CONTEXT",
+                            "value": json.dumps(ctx.to_dict())})
             if self.topology is not None:
                 env.append({"name": "VODA_TOPOLOGY",
                             "value": str(self.topology)})

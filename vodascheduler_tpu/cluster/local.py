@@ -44,6 +44,8 @@ from vodascheduler_tpu.cluster.backend import (
 from vodascheduler_tpu import config
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+from vodascheduler_tpu.cluster.backend import spec_dict_with_trace
+from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.runtime.supervisor import (
     read_resize_ack,
     request_resize,
@@ -105,11 +107,14 @@ class LocalBackend(ClusterBackend):
 
     def start_job(self, spec: JobSpec, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        with self._lock:
-            if spec.name in self._procs:
-                raise RuntimeError(f"job {spec.name!r} already running")
-            self._specs[spec.name] = spec
-            self._spawn_locked(spec, num_workers)
+        with obs_tracer.active_tracer().span(
+                "backend.start", component="backend",
+                attrs={"job": spec.name, "chips": num_workers}):
+            with self._lock:
+                if spec.name in self._procs:
+                    raise RuntimeError(f"job {spec.name!r} already running")
+                self._specs[spec.name] = spec
+                self._spawn_locked(spec, num_workers)
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
@@ -133,10 +138,15 @@ class LocalBackend(ClusterBackend):
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
-        if self._try_inplace_resize(name, num_workers):
-            return ResizePath.INPLACE
-        self._restart_at(name, spec, num_workers)
-        return ResizePath.RESTART
+        with obs_tracer.active_tracer().span(
+                "backend.scale", component="backend",
+                attrs={"job": name, "chips": num_workers}) as sp:
+            if self._try_inplace_resize(name, num_workers):
+                sp.set_attr("path", "inplace")
+                return ResizePath.INPLACE
+            sp.set_attr("path", "restart")
+            self._restart_at(name, spec, num_workers)
+            return ResizePath.RESTART
 
     def _restart_at(self, name: str, spec: JobSpec, num_workers: int) -> None:
         """The cold path: checkpoint-stop, respawn at the new size."""
@@ -178,8 +188,14 @@ class LocalBackend(ClusterBackend):
         job_dir = self._job_dir(spec.name)
         os.makedirs(job_dir, exist_ok=True)
         with open(os.path.join(job_dir, "spec.json"), "w") as f:
-            json.dump(spec.to_dict(), f)
+            json.dump(spec_dict_with_trace(spec), f)
         env = dict(os.environ)
+        # The supervisor's spans land in the same JSONL sink as the
+        # control plane's (one stitched trace file); an explicit
+        # VODA_TRACE_DIR in the environment wins.
+        tracer = obs_tracer.current_tracer() or obs_tracer.get_tracer()
+        if tracer.trace_dir and "VODA_TRACE_DIR" not in env:
+            env["VODA_TRACE_DIR"] = tracer.trace_dir
         if self.hermetic_devices:
             # The virtual mesh must cover the job's chip count, whatever
             # the configured floor is.
@@ -210,7 +226,9 @@ class LocalBackend(ClusterBackend):
                 or num_chips > proc.devices_visible):
             return False
         job_dir = self._job_dir(name)
-        seq = request_resize(job_dir, num_chips)
+        ctx = obs_tracer.current_context()
+        seq = request_resize(job_dir, num_chips,
+                             trace=ctx.to_dict() if ctx else None)
         deadline = (time.monotonic()
                     + config.INPLACE_RESIZE_TIMEOUT_SECONDS)
         while time.monotonic() < deadline:

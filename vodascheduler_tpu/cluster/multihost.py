@@ -49,9 +49,11 @@ from vodascheduler_tpu.cluster.backend import (
     ClusterEventKind,
     JobHandle,
     ResizePath,
+    spec_dict_with_trace,
 )
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+from vodascheduler_tpu.obs import tracer as obs_tracer
 
 
 def _free_port() -> int:
@@ -126,9 +128,12 @@ class MultiHostBackend(ClusterBackend):
         spec = self._specs.get(name)
         if spec is None:
             raise KeyError(f"unknown job {name!r}")
-        self._stop_set(name)
-        with self._lock:
-            self._spawn_locked(spec, num_workers, placements)
+        with obs_tracer.active_tracer().span(
+                "backend.scale", component="backend",
+                attrs={"job": name, "chips": num_workers, "path": "restart"}):
+            self._stop_set(name)
+            with self._lock:
+                self._spawn_locked(spec, num_workers, placements)
         self._ensure_monitor()
         return ResizePath.RESTART
 
@@ -206,7 +211,7 @@ class MultiHostBackend(ClusterBackend):
         job_dir = self._job_dir(spec.name)
         os.makedirs(job_dir, exist_ok=True)
         with open(os.path.join(job_dir, "spec.json"), "w") as f:
-            json.dump(spec.to_dict(), f)
+            json.dump(spec_dict_with_trace(spec), f)
         port = _free_port()
         procs: List[subprocess.Popen] = []
         single = len(placements) == 1

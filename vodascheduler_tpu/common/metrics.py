@@ -2,28 +2,36 @@
 
 Reference counterpart: the prometheus/client_golang series registered across
 scheduler (13+4 placement), allocator (8), and service (7) — catalog in
-doc/prometheus-metrics-exposed.md. This registry provides the same three
-instrument kinds the reference uses (Counter, Gauge/GaugeFunc, Summary) and
-renders the standard text format for a `/metrics` endpoint, without a
-client-library dependency.
+doc/prometheus-metrics-exposed.md. This registry provides the instrument
+kinds the reference uses (Counter, Gauge/GaugeFunc, Summary) plus a
+bucketed Histogram (the reference has none — its latency series are all
+summaries, which can't answer "what fraction of rescheds finished under
+100 ms"), and renders the standard text format for a `/metrics` endpoint,
+without a client-library dependency.
+
+Thread-safety contract: every read and write of an instrument's shared
+dicts holds the instrument's lock — scrapes run on the REST server's
+threads concurrently with scheduler/daemon increments.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 @contextlib.contextmanager
-def timed(summary: "Summary", **labels: str):
-    """Observe the wall-clock duration of a block into a Summary."""
+def timed(instrument, **labels: str):
+    """Observe the wall-clock duration of a block into any instrument with
+    an observe() method (Summary or Histogram)."""
     t0 = time.monotonic()
     try:
         yield
     finally:
-        summary.observe(time.monotonic() - t0, **labels)
+        instrument.observe(time.monotonic() - t0, **labels)
 
 
 class Counter:
@@ -43,7 +51,8 @@ class Counter:
 
     def value(self, **labels: str) -> float:
         key = tuple(labels.get(n, "") for n in self.label_names)
-        return self._values.get(key, 0.0)
+        with self._lock:
+            return self._values.get(key, 0.0)
 
     def collect(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -78,13 +87,18 @@ class Gauge:
             with self._lock:
                 self._values[key] = v
         else:
-            self._value = v
+            with self._lock:
+                self._value = v
 
     def value(self, **labels: str) -> float:
         if self.label_names:
             key = tuple(labels.get(n, "") for n in self.label_names)
-            return self._values.get(key, 0.0)
-        return self._fn() if self._fn is not None else self._value
+            with self._lock:
+                return self._values.get(key, 0.0)
+        if self._fn is not None:
+            return self._fn()
+        with self._lock:
+            return self._value
 
     def clear(self) -> None:
         """Drop all labeled series (for full-rebuild collectors)."""
@@ -134,12 +148,16 @@ class Summary:
 
     def count(self, **labels: str) -> int:
         key = tuple(labels.get(n, "") for n in self.label_names)
-        return self._count.get(key, 0)
+        with self._lock:
+            return self._count.get(key, 0)
 
     def mean(self, **labels: str) -> float:
         key = tuple(labels.get(n, "") for n in self.label_names)
-        c = self._count.get(key, 0)
-        return self._sum.get(key, 0.0) / c if c else 0.0
+        with self._lock:
+            # Sum and count must come from the same locked snapshot, or a
+            # concurrent observe between the two reads skews the mean.
+            c = self._count.get(key, 0)
+            return self._sum.get(key, 0.0) / c if c else 0.0
 
     def collect(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
@@ -148,6 +166,91 @@ class Summary:
                 labels = _merge_labels(self.const_labels, self.label_names, key)
                 lines.append(f"{self.name}_sum{labels} {self._sum[key]}")
                 lines.append(f"{self.name}_count{labels} {self._count[key]}")
+        return lines
+
+
+# Control-plane latencies span sub-millisecond (in-process allocation on a
+# small queue) to minutes (a cold resize waiting out a checkpoint drain).
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0, 600.0)
+
+
+class Histogram:
+    """Cumulative-bucket histogram in the Prometheus text format:
+    `<name>_bucket{le="..."}` per bound plus `le="+Inf"`, and the usual
+    `_sum`/`_count`. Buckets are fixed at construction (exposition
+    requires every series of a family to share them)."""
+
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 const_labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self.const_labels = dict(const_labels or {})
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        # per label tuple: [count per finite bucket] (non-cumulative in
+        # memory; cumulated at collect time), sum, total count
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._total: Dict[Tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        idx = bisect.bisect_left(self.buckets, v)  # first bound >= v
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * len(self.buckets)
+            if idx < len(self.buckets):
+                self._counts[key][idx] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + v
+            self._total[key] = self._total.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            return self._total.get(key, 0)
+
+    def bucket_counts(self, **labels: str) -> Dict[float, int]:
+        """Cumulative count per finite bound (observability/test helper)."""
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            per = list(self._counts.get(key, [0] * len(self.buckets)))
+        out, cum = {}, 0
+        for bound, c in zip(self.buckets, per):
+            cum += c
+            out[bound] = cum
+        return out
+
+    @staticmethod
+    def _le(bound: float) -> str:
+        # Prometheus renders integral bounds without a trailing .0
+        return str(int(bound)) if float(bound).is_integer() else repr(bound)
+
+    def collect(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            snapshot = {key: (list(per), self._sum.get(key, 0.0),
+                              self._total.get(key, 0))
+                        for key, per in self._counts.items()}
+        for key, (per, total_sum, total) in snapshot.items():
+            cum = 0
+            for bound, c in zip(self.buckets, per):
+                cum += c
+                labels = _merge_labels(
+                    self.const_labels, self.label_names + ("le",),
+                    key + (self._le(bound),))
+                lines.append(f"{self.name}_bucket{labels} {cum}")
+            inf_labels = _merge_labels(
+                self.const_labels, self.label_names + ("le",), key + ("+Inf",))
+            lines.append(f"{self.name}_bucket{inf_labels} {total}")
+            plain = _merge_labels(self.const_labels, self.label_names, key)
+            lines.append(f"{self.name}_sum{plain} {total_sum}")
+            lines.append(f"{self.name}_count{plain} {total}")
         return lines
 
 
@@ -192,6 +295,12 @@ class Registry:
                 const_labels: Optional[Dict[str, str]] = None) -> Summary:
         return self.register(Summary(name, help_, labels,
                                      const_labels=const_labels))
+
+    def histogram(self, name: str, help_: str, labels: Tuple[str, ...] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  const_labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets=buckets,
+                                       const_labels=const_labels))
 
     def exposition(self) -> str:
         # Multi-pool registrations repeat metric names (same name, a

@@ -82,12 +82,34 @@ class CsvDirRowSource:
 class MetricsCollector:
     def __init__(self, store: JobStore, source: RowSource,
                  clock: Optional[Clock] = None,
-                 interval_seconds: float = DEFAULT_INTERVAL_SECONDS):
+                 interval_seconds: float = DEFAULT_INTERVAL_SECONDS,
+                 registry=None, pool: str = ""):
         self.store = store
         self.source = source
         self.clock = clock
         self.interval_seconds = interval_seconds
         self._stopped = False
+        # Supervisor-reported step times, bucketed (doc/observability.md).
+        # The control plane is the only process with a /metrics endpoint,
+        # so training-side step latency surfaces here at ingestion time —
+        # one observation per newly-collected epoch row, labeled by the
+        # job's category (family) so repeat submissions aggregate. The
+        # pool const-label keeps N per-pool collectors on one shared
+        # registry from emitting duplicate identical-labelset series
+        # (same pattern as every per-pool scheduler instrument).
+        self.h_step_time = None
+        if registry is not None:
+            self.h_step_time = registry.histogram(
+                "voda_job_step_time_seconds",
+                "Trainer-reported mean step time per ingested epoch row",
+                ("category",),
+                buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                         30.0),
+                const_labels={"pool": pool} if pool else None)
+        # Highest epoch already observed into the histogram per job (the
+        # job-info current_epoch can't serve: a job whose info update is
+        # skipped must still not re-observe old rows next pass).
+        self._observed_epoch: Dict[str, int] = {}
 
     def start(self) -> None:
         """Register the periodic collection timer (simulation mode)."""
@@ -118,6 +140,7 @@ class MetricsCollector:
         rows = self.source.rows(job_name)
         if not rows:
             return False
+        self._observe_step_times(job_name, rows)
         info = self.store.get_job_info(job_name)
         if info is None:
             # The record must exist before we update it (reference
@@ -171,6 +194,26 @@ class MetricsCollector:
 
         self.store.upsert_job_info(info)
         return True
+
+    def _observe_step_times(self, job_name: str, rows) -> None:
+        """Feed newly-seen rows' step times into the histogram (no-op
+        without a registry). Rows without a trainer-reported step time
+        fall back to epoch_time/steps-per-epoch? No — they are skipped:
+        a derived value would blur the series' meaning (the summary of
+        epoch time already lives in the job info)."""
+        if self.h_step_time is None:
+            return
+        seen = self._observed_epoch.get(job_name, -1)
+        newest = seen
+        category = category_of(job_name)
+        for r in rows:
+            if r.epoch <= seen:
+                continue
+            newest = max(newest, r.epoch)
+            step = getattr(r, "step_time_sec", 0.0)
+            if step and step > 0:
+                self.h_step_time.observe(step, category=category)
+        self._observed_epoch[job_name] = newest
 
     @staticmethod
     def _epoch_seconds_at_1(info: JobInfo) -> Optional[float]:

@@ -1,0 +1,145 @@
+"""Decision-audit records: the machine-readable "why" of each resched.
+
+One record per rescheduling pass captures the trigger, the queue
+snapshot, the algorithm, every per-job before→after chip delta, and a
+*reason code* for each delta drawn from the closed vocabulary below —
+the state → decision → (priced) action tuples that placement-learning
+work (Placeto, arxiv 1906.08879; NEST, arxiv 2603.06798) consumes as
+training/evaluation input, and that `voda explain <job>` renders for a
+human.
+
+The vocabulary is deliberately frozen: a new scheduler behavior must add
+its code HERE (and to doc/observability.md) before it can emit, and
+`make trace-dryrun` + the schema validator fail on unknown codes — the
+audit stream can never silently grow untyped reasons.
+
+The replay simulator emits the same schema through the same scheduler
+code path, so a replay audit stream and a live audit stream of the same
+workload are directly diffable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+# Why a resched pass ran (the coalesced set of triggers since the last
+# pass — several events inside one rate-limit window share one pass).
+TRIGGERS = frozenset({
+    "job_created",       # admission announced a new job
+    "job_deleted",       # user cancel
+    "job_completed",
+    "job_failed",
+    "host_added",        # fleet grew (spot return / scale-up)
+    "host_removed",      # fleet shrank (spot preemption / drain)
+    "priority_change",   # Tiresias promote/demote flipped a priority
+    "algorithm_changed",  # PUT /algorithm
+    "metrics_update",    # collector learned fresh speedup curves
+    "retry",             # a failed apply scheduled this retry pass
+    "resume",            # crash-resume reconstruction
+    "manual",            # untagged trigger_resched caller
+})
+
+# Why a job's chip count changed (or pointedly didn't). A delta may carry
+# several codes: a scale_out that bypassed hysteresis carries both.
+REASON_CODES = frozenset({
+    "started",                   # 0 -> n: job got its first/next allocation
+    "halted",                    # n -> 0: preempted back to the queue
+    "released_terminal",         # n -> 0: job completed/failed/canceled
+    "scale_out",                 # n -> m, m > n
+    "scale_in",                  # n -> m, 0 < m < n
+    "migrated",                  # same size, host binding changed
+    "resize_inplace",            # the backend took the Tier-A live reshard
+    "resize_cold",               # checkpoint-restart resize
+    "hysteresis_suppressed",     # small grow clipped back to the old size
+    "hysteresis_bypassed_grow_fits_host",  # grow passed the gate: fits own host
+    "start_failed",              # backend raised; allocation reverted
+    "scale_failed",              # backend raised; re-booked from live state
+    "halt_failed",               # backend raised; halt kept booked for retry
+    "migrate_failed",            # backend raised during migration
+    "reverted_release_failure",  # pass aborted: booking reverted wholesale
+})
+
+_REQUIRED_AUDIT_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
+                          "triggers", "algorithm", "total_chips", "queue",
+                          "deltas", "duration_ms")
+_REQUIRED_SPAN_FIELDS = ("kind", "trace_id", "span_id", "name", "component",
+                         "start", "end", "duration_ms", "status")
+_REQUIRED_ACCESS_FIELDS = ("kind", "ts", "method", "path", "status",
+                           "duration_ms")
+
+
+def validate_record(rec: Dict[str, Any]) -> List[str]:
+    """Schema-check one emitted JSONL record; returns human-readable
+    problems (empty = valid). Unknown kinds are invalid — the trace file
+    is a closed format, same posture as the reason vocabulary."""
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    kind = rec.get("kind")
+    if kind == "resched_audit":
+        return _validate_audit(rec)
+    if kind == "span":
+        return _check_fields(rec, _REQUIRED_SPAN_FIELDS)
+    if kind == "http_access":
+        return _check_fields(rec, _REQUIRED_ACCESS_FIELDS)
+    return [f"unknown record kind {kind!r}"]
+
+
+def _check_fields(rec: Dict[str, Any], required) -> List[str]:
+    return [f"{rec.get('kind')}: missing field {f!r}"
+            for f in required if f not in rec]
+
+
+def _validate_audit(rec: Dict[str, Any]) -> List[str]:
+    problems = _check_fields(rec, _REQUIRED_AUDIT_FIELDS)
+    for trig in rec.get("triggers", ()):
+        if trig not in TRIGGERS:
+            problems.append(f"unknown trigger {trig!r}")
+    if not isinstance(rec.get("queue", []), list):
+        problems.append("queue is not a list")
+    for delta in rec.get("deltas", ()):
+        if not isinstance(delta, dict):
+            problems.append(f"delta is not an object: {delta!r}")
+            continue
+        for f in ("job", "before", "after", "reasons"):
+            if f not in delta:
+                problems.append(f"delta for {delta.get('job')!r}: "
+                                f"missing {f!r}")
+        for code in delta.get("reasons", ()):
+            if code not in REASON_CODES:
+                problems.append(f"unknown reason code {code!r} "
+                                f"(job {delta.get('job')!r})")
+        if not delta.get("reasons"):
+            problems.append(f"delta for {delta.get('job')!r} has no reasons")
+    return problems
+
+
+def validate_jsonl(path: str) -> List[str]:
+    """Validate every line of a trace file; returns problems prefixed
+    with their line number."""
+    import json
+
+    problems: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {i}: not JSON ({e})")
+                continue
+            problems.extend(f"line {i}: {p}" for p in validate_record(rec))
+    return problems
+
+
+def summarize_deltas(record: Dict[str, Any]) -> List[str]:
+    """Human-readable one-liners for `voda explain` output."""
+    out = []
+    for d in record.get("deltas", ()):
+        reasons = ",".join(d.get("reasons", ()))
+        out.append(f"{d.get('job')}: {d.get('before')} -> {d.get('after')} "
+                   f"chips [{reasons}]")
+    return out

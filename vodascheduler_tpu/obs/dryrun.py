@@ -1,0 +1,134 @@
+"""`make trace-dryrun`: exercise the decision-audit plane end to end and
+validate every emitted record.
+
+Runs a short fake-backend scenario under a VirtualClock — two elastic
+jobs forcing a start, an elastic share (scale_in via live reshard), and a
+completion-driven scale_out — with the tracer's JSONL sink pointed at a
+scratch directory. Then:
+
+1. every line of the trace file must validate against the record schema
+   (obs/audit.py) — unknown record kinds, unknown triggers, and unknown
+   per-job reason codes are failures, so a scheduler change that invents
+   an untyped reason cannot ship past tier-1;
+2. the scenario must have produced at least one resched_audit whose
+   deltas explain a resize, and a supervisor span stitched (same
+   trace_id) to a scheduler resched span — the cross-boundary contract.
+
+Exit code 0 on success; nonzero with the problems printed. Wired into
+tier-1 via tests/test_obs.py, so CI runs it on every change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
+
+
+def run_scenario(trace_dir: str) -> dict:
+    """Drive the scenario; returns {path, problems: [...], stats: {...}}."""
+    from vodascheduler_tpu.allocator import ResourceAllocator
+    from vodascheduler_tpu.cluster.fake import (
+        FakeClusterBackend,
+        WorkloadProfile,
+    )
+    from vodascheduler_tpu.common.clock import VirtualClock
+    from vodascheduler_tpu.common.events import EventBus
+    from vodascheduler_tpu.common.job import JobConfig, JobSpec
+    from vodascheduler_tpu.common.store import JobStore
+    from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.scheduler import Scheduler
+    from vodascheduler_tpu.service import AdmissionService
+
+    clock = VirtualClock(start=1753760000.0)
+    tracer = obs_tracer.Tracer(clock=clock, trace_dir=trace_dir,
+                               filename="dryrun.jsonl")
+    store = JobStore()
+    bus = EventBus()
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=5.0,
+                                 inplace_overhead_seconds=0.5)
+    backend.add_host("host-0", 8, announce=False)
+    pm = PlacementManager("dryrun-pool")
+    sched = Scheduler("dryrun-pool", backend, store,
+                      ResourceAllocator(store), clock, bus=bus,
+                      placement_manager=pm, algorithm="ElasticFIFO",
+                      rate_limit_seconds=1.0, tracer=tracer)
+    admission = AdmissionService(store, bus, clock)
+
+    def spec(name, epochs):
+        return JobSpec(name=name, pool="dryrun-pool",
+                       config=JobConfig(min_num_chips=1, max_num_chips=8,
+                                        epochs=epochs))
+
+    backend.register_profile("stretchy",
+                             WorkloadProfile(epoch_seconds_at_1=30.0))
+    backend.register_profile("newcomer",
+                             WorkloadProfile(epoch_seconds_at_1=30.0))
+    # Job A starts with the whole host; B's arrival splits it (a same-
+    # host shrink = Tier-A in-place reshard on the fake backend); B's
+    # completion grows A back (scale_out). Three rescheds, three kinds
+    # of audited delta.
+    admission.create_training_job(spec("stretchy", epochs=200))
+    clock.advance(5.0)
+    admission.create_training_job(spec("newcomer", epochs=2))
+    clock.advance(3600.0)  # newcomer completes; stretchy scales back out
+
+    path = os.path.join(trace_dir, "dryrun.jsonl")
+    problems = obs_audit.validate_jsonl(path)
+
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    audits = [r for r in records if r.get("kind") == "resched_audit"]
+    spans = [r for r in records if r.get("kind") == "span"]
+    resched_traces = {r["trace_id"] for r in spans
+                      if r.get("name") == "resched"}
+    sup_spans = [s for s in spans
+                 if s.get("component") == "supervisor"
+                 and s["trace_id"] in resched_traces]
+    resize_deltas = [d for r in audits for d in r.get("deltas", ())
+                     if any(code.startswith("resize_")
+                            for code in d.get("reasons", ()))]
+
+    if not audits:
+        problems.append("scenario produced no resched_audit records")
+    if not resize_deltas:
+        problems.append("no audited delta carries a resize_* reason")
+    if not sup_spans:
+        problems.append("no supervisor span stitched to a resched trace")
+
+    return {
+        "path": path,
+        "problems": problems,
+        "stats": {
+            "records": len(records),
+            "audits": len(audits),
+            "spans": len(spans),
+            "supervisor_spans_stitched": len(sup_spans),
+            "resize_deltas": len(resize_deltas),
+            "completed_jobs": len(backend.completed),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    keep_dir = args[0] if args else None
+    if keep_dir:
+        os.makedirs(keep_dir, exist_ok=True)
+        result = run_scenario(keep_dir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="voda-trace-dryrun-") as d:
+            result = run_scenario(d)
+            result["path"] = "(scratch; pass a dir argument to keep)"
+    print(json.dumps({"ok": not result["problems"], **result}, indent=1))
+    if result["problems"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.common.metrics import Registry, timed
+from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import hungarian
 from vodascheduler_tpu.placement.state import HostSlots, HostState, JobPlacement
 from vodascheduler_tpu.placement.topology import PoolTopology
@@ -141,13 +142,19 @@ class PlacementManager:
         arise from host loss — or from an explicit defragment() pass, which
         is where the reference's full repack + Hungarian machinery lives
         on."""
-        with timed(self.m_algo_duration, mode="incremental"):
+        with timed(self.m_algo_duration, mode="incremental"), \
+                obs_tracer.active_tracer().span(
+                    "placement.place", component="placement",
+                    attrs={"pool": self.pool_id, "mode": "incremental",
+                           "num_jobs": len(job_requests)}) as sp:
             old_worker_hosts = {job: self._expand_workers(p)
                                 for job, p in self.job_placements.items()}
 
             self._release_slots(job_requests)
             cross, contiguity = self._place_incremental(job_requests)
             decision = self._decision(old_worker_hosts, cross, contiguity)
+            sp.set_attr("workers_migrated", decision.workers_migrated)
+            sp.set_attr("jobs_cross_host", decision.num_jobs_cross_host)
         self._observe(decision)
         return decision
 
@@ -155,7 +162,11 @@ class PlacementManager:
         """Full repack + Hungarian stay-put relabeling (the reference's
         Place semantics, :306-332). Consolidates fragmentation at the cost
         of migrations; callers weigh that cost explicitly."""
-        with timed(self.m_algo_duration, mode="defragment"):
+        with timed(self.m_algo_duration, mode="defragment"), \
+                obs_tracer.active_tracer().span(
+                    "placement.place", component="placement",
+                    attrs={"pool": self.pool_id, "mode": "defragment",
+                           "num_jobs": len(job_requests)}):
             old_worker_hosts = {job: self._expand_workers(p)
                                 for job, p in self.job_placements.items()}
 

@@ -20,6 +20,7 @@ from vodascheduler_tpu.common.clock import VirtualClock
 from vodascheduler_tpu.common.events import EventBus
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.metricscollector import BackendRowSource, MetricsCollector
+from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import PlacementManager, PoolTopology
 from vodascheduler_tpu.replay.trace import TraceJob
 from vodascheduler_tpu.scheduler import Scheduler
@@ -112,6 +113,7 @@ class ReplayHarness:
         collector_interval_seconds: float = 60.0,
         preemptions: Sequence[PreemptionEvent] = (),
         start_epoch: float = 1753760000.0,
+        tracer: Optional[obs_tracer.Tracer] = None,
     ):
         self.trace = list(trace)
         self.algorithm = algorithm
@@ -119,6 +121,13 @@ class ReplayHarness:
         self.clock = VirtualClock(start=start_epoch)
         self.store = JobStore()
         self.bus = EventBus()
+        # Decision-audit tracing under simulated time: ids and timestamps
+        # derive from the VirtualClock (obs/tracer.py), so the same trace
+        # replayed twice emits byte-identical records — directly diffable
+        # against a live run's trace of the same workload. Default keeps
+        # records in the ring only; pass a Tracer with trace_dir (bench.py
+        # does) to persist the audit JSONL as a provenance artifact.
+        self.tracer = tracer or obs_tracer.Tracer(clock=self.clock)
         if restart_overhead_seconds is None:
             from vodascheduler_tpu.replay.restart_costs import (
                 default_restart_seconds,
@@ -151,7 +160,8 @@ class ReplayHarness:
             resize_cooldown_seconds=(
                 config.RESIZE_COOLDOWN_SECONDS
                 if resize_cooldown_seconds is None
-                else resize_cooldown_seconds))
+                else resize_cooldown_seconds),
+            tracer=self.tracer)
         self.admission = AdmissionService(self.store, self.bus, self.clock)
         self.collector = MetricsCollector(
             self.store, BackendRowSource(self.backend), self.clock,

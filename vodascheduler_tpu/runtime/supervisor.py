@@ -30,6 +30,7 @@ import time
 from typing import Optional
 
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+from vodascheduler_tpu.obs import tracer as obs_tracer
 
 # Chunk size between stop-flag checks: small enough that SIGTERM turns into
 # a checkpoint promptly, big enough to amortize dispatch overhead.
@@ -102,16 +103,21 @@ class ControlChannel:
                     pass
 
 
-def request_resize(workdir: str, num_chips: int) -> int:
+def request_resize(workdir: str, num_chips: int,
+                   trace: Optional[dict] = None) -> int:
     """Backend side: enqueue an in-place resize; returns the command seq
-    to pass to read_resize_ack."""
+    to pass to read_resize_ack. `trace` ({trace_id, parent_span}) rides
+    the command file so the supervisor's resize span stitches into the
+    scheduler's resched trace across the process boundary."""
     d = os.path.join(workdir, CONTROL_DIRNAME)
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, _CMD_FILE)
     prev = _read_json(path)
     seq = (int(prev.get("seq", 0)) if prev else 0) + 1
-    _atomic_write_json(path, {"op": "resize", "num_chips": int(num_chips),
-                              "seq": seq})
+    cmd = {"op": "resize", "num_chips": int(num_chips), "seq": seq}
+    if trace:
+        cmd["trace"] = dict(trace)
+    _atomic_write_json(path, cmd)
     return seq
 
 
@@ -256,7 +262,8 @@ def run_job(workdir: str, num_chips: int,
         from vodascheduler_tpu.placement.topology import PoolTopology
         topology = PoolTopology.parse(topo_env)
 
-    if latest_step(ckpt_dir) is not None:
+    resumed = latest_step(ckpt_dir) is not None
+    if resumed:
         session = TrainSession.resume(
             bundle, num_chips, ckpt_dir, devices=devices,
             global_batch_size=spec.global_batch_size, topology=topology)
@@ -269,6 +276,27 @@ def run_job(workdir: str, num_chips: int,
         session = TrainSession(bundle, num_chips, devices=devices,
                                global_batch_size=spec.global_batch_size,
                                topology=topology)
+
+    # Cross-process stitching: the backend stamped the scheduler's trace
+    # context into the job spec (obs/tracer.py); this span records the
+    # incarnation's startup (fresh vs resumed-from-checkpoint) under the
+    # resched trace that launched it. The supervisor's tracer writes to
+    # the shared VODA_TRACE_DIR sink, interleaving with the control
+    # plane's records.
+    _trace_parent = None
+    raw_ctx = ((spec.extra or {}).get("trace_context", "")
+               or os.environ.get("VODA_TRACE_CONTEXT", ""))
+    if raw_ctx:
+        try:
+            _trace_parent = obs_tracer.TraceContext.from_dict(
+                json.loads(raw_ctx))
+        except (ValueError, TypeError):
+            _trace_parent = None
+    _sup_tracer = obs_tracer.get_tracer()
+    _sup_tracer.start_span(
+        "supervisor.start", component="supervisor", parent=_trace_parent,
+        attrs={"job": spec.name, "chips": num_chips, "resumed": resumed,
+               "step": session.step}).end()
 
     steps_per_epoch = max(1, spec.steps_per_epoch)
     total_steps = spec.config.epochs * steps_per_epoch
@@ -334,6 +362,23 @@ def run_job(workdir: str, num_chips: int,
             if cmd is not None and cmd.get("op") == "resize":
                 seq = int(cmd.get("seq", 0))
                 new_n = int(cmd.get("num_chips", 0))
+                # The command file carried the scheduler's trace context
+                # (request_resize); this span is the cross-process leaf of
+                # the resched trace — ended by the ack that reports the
+                # fast-vs-cold outcome, whichever arm takes it.
+                rs = _sup_tracer.start_span(
+                    "supervisor.resize", component="supervisor",
+                    parent=obs_tracer.TraceContext.from_dict(
+                        cmd.get("trace")),
+                    attrs={"job": spec.name, "from_chips": num_chips,
+                           "to_chips": new_n, "seq": seq})
+
+                def ack(seq, _span=rs, **fields):
+                    for k, v in fields.items():
+                        _span.set_attr(k, v)
+                    _span.end()
+                    control.ack(seq, **fields)
+
                 # The Tier-A feasibility gate: the process group must not
                 # change. Any multihost membership change, or a target
                 # beyond this process's visible devices, needs the
@@ -341,18 +386,28 @@ def run_job(workdir: str, num_chips: int,
                 # back (it SIGTERMs and respawns).
                 if not (0 < new_n <= len(jax.devices())
                         and jax.process_count() == 1):
-                    control.ack(seq, ok=False, path="restart_required",
+                    ack(seq, ok=False, path="restart_required",
                                 reason=(f"resize to {new_n} needs a process-"
                                         f"group change ({len(jax.devices())} "
                                         f"devices visible across "
                                         f"{jax.process_count()} processes)"))
                 elif new_n == num_chips:
-                    control.ack(seq, ok=True, path="inplace",
+                    ack(seq, ok=True, path="inplace",
                                 num_chips=num_chips, step=session.step)
                 else:
                     from vodascheduler_tpu.runtime.train import (
                         ResizeStateInvalid,
                     )
+                    from vodascheduler_tpu.runtime.tpu_monitor import (
+                        hbm_in_use_bytes,
+                    )
+                    # HBM in-use before/after the live reshard rides the
+                    # span (None on platforms without memory stats — the
+                    # attr is simply skipped, never a zero).
+                    hbm_before = hbm_in_use_bytes()
+                    if hbm_before is not None:
+                        rs.set_attr("hbm_in_use_before_bytes",
+                                    int(hbm_before))
                     t0 = time.monotonic()
                     try:
                         session.resize(new_n, devices=jax.devices()[:new_n])
@@ -363,7 +418,7 @@ def run_job(workdir: str, num_chips: int,
                         # committed checkpoint (step dirs are never
                         # overwritten in place, so it is intact even if
                         # the best-effort save below fails).
-                        control.ack(seq, ok=False, path="restart_required",
+                        ack(seq, ok=False, path="restart_required",
                                     reason=str(e)[:300])
                         print(f"supervisor: {e}; exiting for "
                               "checkpoint-restart", file=sys.stderr)
@@ -379,7 +434,7 @@ def run_job(workdir: str, num_chips: int,
                         # session was never mutated — nack so the backend
                         # takes the cold path, and KEEP TRAINING at the
                         # old size until its SIGTERM arrives.
-                        control.ack(seq, ok=False, path="restart_required",
+                        ack(seq, ok=False, path="restart_required",
                                     reason=f"{type(e).__name__}: "
                                            f"{str(e)[:300]}")
                         print(f"supervisor: in-place resize to {new_n} "
@@ -406,7 +461,7 @@ def run_job(workdir: str, num_chips: int,
                         # Post-reshard step failure (OOM / compile): the
                         # state was donated into the failed execution —
                         # same invalid-state exit as above.
-                        control.ack(seq, ok=False, path="restart_required",
+                        ack(seq, ok=False, path="restart_required",
                                     reason=f"{type(e).__name__}: "
                                            f"{str(e)[:300]}")
                         print(f"supervisor: first step after in-place "
@@ -420,7 +475,10 @@ def run_job(workdir: str, num_chips: int,
                             pass
                         return PREEMPTED_EXIT_CODE
                     resize_ms = (time.monotonic() - t0) * 1000.0
-                    control.ack(seq, ok=True, path="inplace",
+                    hbm_after = hbm_in_use_bytes()
+                    if hbm_after is not None:
+                        rs.set_attr("hbm_in_use_after_bytes", int(hbm_after))
+                    ack(seq, ok=True, path="inplace",
                                 num_chips=new_n, step=session.step,
                                 resize_ms=round(resize_ms, 1))
                     # Greppable fast-path evidence (counterpart of the

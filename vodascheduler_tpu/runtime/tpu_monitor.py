@@ -25,6 +25,7 @@ hermetically.
 from __future__ import annotations
 
 import logging
+from typing import Optional
 
 from vodascheduler_tpu.common.metrics import Registry
 
@@ -127,6 +128,20 @@ def telemetry_snapshot() -> dict:
     if sdk:
         out["sdk"] = sdk
     return out
+
+
+def hbm_in_use_bytes(snapshot: Optional[dict] = None) -> Optional[float]:
+    """Total `bytes_in_use` across local devices from a telemetry
+    snapshot (taken fresh when not supplied), or None when the platform
+    reports no memory stats (CPU test mesh, chips owned elsewhere) —
+    callers skip cleanly rather than recording zeros. Used to attach
+    HBM before/after deltas to supervisor resize spans
+    (doc/observability.md)."""
+    snap = telemetry_snapshot() if snapshot is None else snapshot
+    mem = (snap or {}).get("memory") or {}
+    vals = [row["bytes_in_use"] for row in mem.values()
+            if "bytes_in_use" in row]
+    return float(sum(vals)) if vals else None
 
 
 class TpuMonitor:

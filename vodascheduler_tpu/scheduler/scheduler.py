@@ -48,9 +48,16 @@ from vodascheduler_tpu.common.types import (
     JobStatus,
     ScheduleResult,
 )
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import PlacementManager
 
 log = logging.getLogger(__name__)
+
+# How many decision-audit records each scheduler retains in memory for
+# GET /debug/resched and `voda explain` (the JSONL sink keeps the long
+# tail; this bounds the hot queryable window).
+AUDIT_RING_SIZE = 256
 
 # Reference default is 30 s (scheduler.go:212); under two-tier resize
 # pricing the r6 sweep pick is 15 s (cheap in-place resizes reward a
@@ -84,6 +91,7 @@ class Scheduler:
         scale_out_hysteresis: float = DEFAULT_SCALE_OUT_HYSTERESIS,
         resize_cooldown_seconds: float = DEFAULT_RESIZE_COOLDOWN_SECONDS,
         defrag_cross_host_threshold: int = 0,
+        tracer: Optional[obs_tracer.Tracer] = None,
     ):
         self.pool_id = pool_id
         self.backend = backend
@@ -133,6 +141,21 @@ class Scheduler:
         self._resched_pending = False
         self._in_resched = False
         self._stopped = False
+        # Decision-audit plane (doc/observability.md): every resched pass
+        # emits one machine-readable record (trigger, queue snapshot,
+        # per-job delta reasons) through the tracer, retained here for
+        # /debug/resched and `voda explain`.
+        self.tracer = tracer or obs_tracer.get_tracer()
+        import collections
+        self.audit_ring = collections.deque(maxlen=AUDIT_RING_SIZE)
+        self._audit_seq = 0
+        # Triggers coalesce like the rescheds they request: every reason
+        # arriving inside one rate-limit window lands in the same pass's
+        # record.
+        self._pending_triggers: List[str] = []
+        # Per-pass scratch: job -> reason codes, job -> resize seconds.
+        self._pass_reasons: Dict[str, List[str]] = {}
+        self._pass_resize_seconds: Dict[str, float] = {}
         # Serializes all entry points (reference: SchedulerLock,
         # scheduler.go:88-89). Event-bus and backend callbacks arrive on the
         # publisher's thread in real-time mode; reentrant because handlers
@@ -197,6 +220,25 @@ class Scheduler:
         self.m_job_resizes_inplace = registry.counter(
             "voda_scheduler_job_resizes_inplace_total",
             "Elastic resizes taken in-place (live reshard, no restart)",
+            const_labels=pool_l)
+        # Histograms (the summaries above keep their reference-parity
+        # names; the bucketed views answer tail questions the sums can't).
+        self.h_resched_latency = registry.histogram(
+            "voda_scheduler_resched_latency_seconds",
+            "Rescheduling pass latency (bucketed)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                     60.0),
+            const_labels=pool_l)
+        # Fast-vs-cold resize duration: the measured wall time of each
+        # backend scale_job call, labeled by the ResizePath it took —
+        # the live counterpart of doc/resize_measured.json.
+        self.h_resize_duration = registry.histogram(
+            "voda_scheduler_resize_duration_seconds",
+            "Backend scale_job wall time by resize path (fast = in-place "
+            "live reshard, cold = checkpoint-restart)",
+            labels=("path",),
+            buckets=(0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0,
+                     300.0, 600.0),
             const_labels=pool_l)
         registry.gauge("voda_scheduler_ready_jobs",
                        "Jobs in the ready queue",
@@ -267,7 +309,7 @@ class Scheduler:
         self.ready_jobs[name] = job
         self.job_num_chips[name] = 0
         self.m_jobs_created.inc()
-        self.trigger_resched()
+        self.trigger_resched("job_created")
 
     def delete_training_job(self, name: str) -> None:
         """User-initiated cancel (reference: scheduler.go:916-1000)."""
@@ -282,7 +324,7 @@ class Scheduler:
         self.store.update_job(job)
         self.done_jobs[name] = job
         self.m_jobs_deleted.inc()
-        self.trigger_resched()
+        self.trigger_resched("job_deleted")
 
     def handle_job_completed(self, name: str) -> None:
         """Reference: handleJobCompleted (scheduler.go:630-650)."""
@@ -293,7 +335,7 @@ class Scheduler:
         job.status = JobStatus.COMPLETED
         self._job_done(job)
         self.m_jobs_completed.inc()
-        self.trigger_resched()
+        self.trigger_resched("job_completed")
 
     def handle_job_failed(self, name: str) -> None:
         """Reference: handleJobFailed (scheduler.go:652-671)."""
@@ -304,7 +346,7 @@ class Scheduler:
         job.status = JobStatus.FAILED
         self._job_done(job)
         self.m_jobs_failed.inc()
-        self.trigger_resched()
+        self.trigger_resched("job_failed")
 
     def _job_done(self, job: TrainingJob) -> None:
         """Reference: handleJobDoneInternal (scheduler.go:673-686)."""
@@ -323,7 +365,7 @@ class Scheduler:
         if self.placement_manager is not None:
             chips = self.backend.list_hosts().get(name, 0)
             self.placement_manager.add_host(name, chips)
-        self.trigger_resched()
+        self.trigger_resched("host_added")
 
     def _on_host_removed(self, name: str) -> None:
         # The backend no longer lists the host; recompute capacity.
@@ -333,15 +375,19 @@ class Scheduler:
             # Jobs that lost workers need re-placement even if the next
             # allocation leaves their chip count unchanged.
             self._placement_dirty = True
-        self.trigger_resched()
+        self.trigger_resched("host_removed")
 
     # ---- rescheduling (reference: Run select loop + resched :271-434) ----
 
-    def trigger_resched(self) -> None:
+    def trigger_resched(self, reason: str = "manual") -> None:
         """Request a resched; coalesces and honors the rate limit
         (reference: TriggerResched + the Run loop's drop-and-block logic,
-        scheduler.go:297-316)."""
+        scheduler.go:297-316). `reason` (an obs.audit.TRIGGERS code) is
+        recorded in the pass's decision-audit record; reasons arriving
+        while a resched is already pending coalesce into that pass."""
         with self._lock:
+            if reason not in self._pending_triggers:
+                self._pending_triggers.append(reason)
             if self._resched_pending or self._stopped:
                 return
             self._resched_pending = True
@@ -377,7 +423,7 @@ class Scheduler:
         new_algorithm(name, self.pool_id)  # validate; raises on unknown
         with self._lock:
             self.algorithm = name
-        self.trigger_resched()
+        self.trigger_resched("algorithm_changed")
 
     def set_rate_limit(self, seconds: float) -> None:
         """Adjust the resched rate limit (reference: PUT /ratelimit,
@@ -408,12 +454,42 @@ class Scheduler:
                                        self._run_resched_now)
 
     def resched(self) -> None:
-        """One rescheduling pass (reference: resched, scheduler.go:326-364)."""
+        """One rescheduling pass (reference: resched, scheduler.go:326-364),
+        wrapped in the decision-audit plane (doc/observability.md): a root
+        span per pass — every downstream boundary (allocator, placement,
+        backend, supervisor control channel) parents onto it via the
+        ambient context — plus one schema-validated audit record capturing
+        the trigger set, the queue snapshot, and a reason code for every
+        per-job chip delta."""
         import time as _walltime
 
+        with self._lock:
+            triggers = [t for t in self._pending_triggers
+                        if t in obs_audit.TRIGGERS] or ["manual"]
+            self._pending_triggers = []
+        self._pass_reasons = {}
+        self._pass_resize_seconds = {}
         t_start = _walltime.monotonic()
         self.update_time_metrics()
         old = dict(self.job_num_chips)
+        outcome = "error"
+        with self.tracer.span(
+                "resched", component="scheduler", new_trace=True,
+                attrs={"pool": self.pool_id, "algorithm": self.algorithm,
+                       "triggers": triggers}) as sp:
+            try:
+                outcome = self._resched_pass(t_start, old)
+            finally:
+                duration = _walltime.monotonic() - t_start
+                sp.set_attr("outcome", outcome)
+                self.h_resched_latency.observe(duration)
+                self._emit_audit(sp, triggers, old, duration, outcome)
+
+    def _resched_pass(self, t_start: float, old: ScheduleResult) -> str:
+        """The pass body; returns the audit outcome tag ('applied',
+        'allocation_failed', or 'reverted_release_failure')."""
+        import time as _walltime
+
         jobs = list(self.ready_jobs.values())
         t_alloc = _walltime.monotonic()
         try:
@@ -431,7 +507,7 @@ class Scheduler:
         except Exception:
             log.exception("allocation failed; retrying after rate limit")
             self._schedule_retry()
-            return
+            return "allocation_failed"
         self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
 
         if self.scale_out_hysteresis > 1.0:
@@ -439,6 +515,14 @@ class Scheduler:
         self.job_num_chips = new
         halts, scale_ins, scale_outs, starts = self.compare_results(old)
         changed = bool(halts or scale_ins or scale_outs or starts)
+        for job in starts:
+            self._add_reason(job, "started")
+        for job in halts:
+            self._add_reason(job, "halted")
+        for job in scale_ins:
+            self._add_reason(job, "scale_in")
+        for job in scale_outs:
+            self._add_reason(job, "scale_out")
 
         # Unlike the reference (which places *after* the MPI-Operator
         # creates pods, steering them via tolerations and deleting movers,
@@ -473,6 +557,7 @@ class Scheduler:
             except Exception:
                 log.exception("halt of %r failed; keeping its allocation "
                               "booked so the halt is retried", job)
+                self._add_reason(job, "halt_failed")
                 self.job_num_chips[job] = old.get(job, 0)
                 release_failed = True
         applied_scale_ins = set()
@@ -496,12 +581,13 @@ class Scheduler:
             unapplied = [j for j in scale_ins if j not in applied_scale_ins]
             for job in unapplied + scale_outs + starts:
                 self.job_num_chips[job] = old.get(job, 0)
+                self._add_reason(job, "reverted_release_failure")
             self._placement_dirty = True
             self._schedule_retry()
             self.store.flush()
             self.m_resched_total.inc()
             self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
-            return
+            return "reverted_release_failure"
         for job in starts:
             self._apply_start(job, placements.get(job))
         for job in scale_outs:
@@ -513,6 +599,7 @@ class Scheduler:
         self.store.flush()  # batch boundary for autoflush=False stores
         self.m_resched_total.inc()
         self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
+        return "applied"
 
     def _migrate_moved_jobs(self, placements: Dict[str, List[Tuple[str, int]]],
                             already_restarted: set) -> None:
@@ -529,10 +616,15 @@ class Scheduler:
                 continue
             if sorted(handle.placements) != sorted(target):
                 try:
-                    self.backend.migrate_workers(job_name, target)
+                    with self.tracer.span(
+                            "job.migrate", component="scheduler",
+                            attrs={"job": job_name,
+                                   "target": [list(t) for t in target]}):
+                        self.backend.migrate_workers(job_name, target)
                 except Exception:
                     log.exception("migration of %r failed; re-booking from "
                                   "backend state and retrying", job_name)
+                    self._add_reason(job_name, "migrate_failed")
                     try:
                         still_live = job_name in self.backend.running_jobs()
                     except Exception:  # noqa: BLE001 - storm still on
@@ -545,6 +637,7 @@ class Scheduler:
                     self._placement_dirty = True
                     self._schedule_retry()
                     continue
+                self._add_reason(job_name, "migrated")
                 self._last_resize_at[job_name] = self.clock.now()
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
@@ -571,12 +664,18 @@ class Scheduler:
         now = self.clock.now()
         for job, n_new in new.items():
             n_old = old.get(job, 0)
-            if (n_old > 0 and n_new > n_old
+            if not (n_old > 0 and n_new > n_old
                     and n_new < _math.ceil(n_old * self.scale_out_hysteresis)
-                    and not self._grow_fits_current_hosts(job, n_new)
                     and now - self._last_resize_at.get(job, -float("inf"))
                     < self.resize_cooldown_seconds):
+                continue
+            # Small growth inside the cooldown window: the gate fires, and
+            # which way it goes is an audited decision either way.
+            if self._grow_fits_current_hosts(job, n_new):
+                self._add_reason(job, "hysteresis_bypassed_grow_fits_host")
+            else:
                 new[job] = n_old
+                self._add_reason(job, "hysteresis_suppressed")
 
     def _grow_fits_current_hosts(self, job: str, n_new: int) -> bool:
         """Whether growing `job` to n_new chips can plausibly be applied
@@ -616,11 +715,14 @@ class Scheduler:
         (scheduler.go:344-349)."""
         delay = self.rate_limit_seconds + 1.0
         if isinstance(self.clock, VirtualClock):
-            self.clock.call_later(delay, self.trigger_resched)
+            self.clock.call_later(delay,
+                                  lambda: self.trigger_resched("retry"))
         else:
             # Real-time mode: keep the request pending so the service
             # daemon retries once the window opens.
             self._resched_pending = True
+            if "retry" not in self._pending_triggers:
+                self._pending_triggers.append("retry")
             self.resched_blocked_until = self.clock.now() + delay
 
     def compare_results(self, old: ScheduleResult) -> Tuple[
@@ -664,6 +766,7 @@ class Scheduler:
         except Exception:
             log.exception("start of %r failed; reverting allocation and "
                           "retrying after the rate limit", name)
+            self._add_reason(name, "start_failed")
             self._revert_to_waiting(name)
             self._schedule_retry()
 
@@ -684,6 +787,7 @@ class Scheduler:
         except Exception:
             log.exception("resize of %r failed; re-booking from backend "
                           "state and retrying", name)
+            self._add_reason(name, "scale_failed")
             try:
                 live = self.backend.running_jobs()
             except Exception:  # noqa: BLE001 - storm may still be on
@@ -710,7 +814,11 @@ class Scheduler:
         job = self.ready_jobs.get(name)
         if job is None:
             return
-        self.backend.start_job(job.spec, self.job_num_chips[name], placements)
+        with self.tracer.span("job.start", component="scheduler",
+                              attrs={"job": name,
+                                     "chips": self.job_num_chips[name]}):
+            self.backend.start_job(job.spec, self.job_num_chips[name],
+                                   placements)
         self.m_job_restarts.inc()
         job.status = JobStatus.RUNNING
         job.metrics.last_chip_seconds = 0.0
@@ -729,8 +837,24 @@ class Scheduler:
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
         """Reference: scaleTrainingJob (scheduler.go:542-574), priced by
         the path the backend actually took (doc/elastic-resize.md)."""
-        path = self.backend.scale_job(name, self.job_num_chips[name],
-                                      placements)
+        import time as _walltime
+
+        t0 = _walltime.monotonic()
+        with self.tracer.span("job.scale", component="scheduler",
+                              attrs={"job": name,
+                                     "chips": self.job_num_chips[name]}) as sp:
+            path = self.backend.scale_job(name, self.job_num_chips[name],
+                                          placements)
+            took = _walltime.monotonic() - t0
+            path_label = "fast" if path == ResizePath.INPLACE else "cold"
+            sp.set_attr("path", path_label)
+            sp.set_attr("resize_seconds", round(took, 4))
+        # The resize-duration histogram + audit pricing: the measured wall
+        # time of the backend call, labeled by the tier it took.
+        self.h_resize_duration.observe(took, path=path_label)
+        self._pass_resize_seconds[name] = took
+        self._add_reason(name, "resize_inplace" if path == ResizePath.INPLACE
+                         else "resize_cold")
         self._last_resize_at[name] = self.clock.now()
         if path == ResizePath.INPLACE:
             # The job never stopped: no restart counted, and the
@@ -750,7 +874,9 @@ class Scheduler:
     def _halt_job(self, name: str) -> None:
         """Reference: haltTrainingJob (scheduler.go:576-590)."""
         job = self.ready_jobs.get(name)
-        self.backend.stop_job(name)
+        with self.tracer.span("job.halt", component="scheduler",
+                              attrs={"job": name}):
+            self.backend.stop_job(name)
         if job is not None:
             job.status = JobStatus.WAITING
             job.metrics.last_waiting_seconds = 0.0
@@ -759,6 +885,77 @@ class Scheduler:
     def _job_status(self, name: str) -> Optional[JobStatus]:
         job = self.ready_jobs.get(name) or self.done_jobs.get(name)
         return job.status if job else None
+
+    # ---- decision audit (doc/observability.md) ---------------------------
+
+    def _add_reason(self, job: str, code: str) -> None:
+        """Tag this pass's delta for `job` with a REASON_CODES entry."""
+        reasons = self._pass_reasons.setdefault(job, [])
+        if code not in reasons:
+            reasons.append(code)
+
+    def _emit_audit(self, span, triggers: List[str], old: ScheduleResult,
+                    duration_s: float, outcome: str) -> None:
+        """Build + emit the pass's decision-audit record: the trigger set,
+        the queue snapshot, and one delta (with reason codes) per job whose
+        chip count changed or about which a decision was recorded."""
+        self._audit_seq += 1
+        queue = [{"name": j.name, "status": j.status.value,
+                  "priority": j.priority,
+                  "chips_before": old.get(j.name, 0)}
+                 for j in sorted(self.ready_jobs.values(),
+                                 key=lambda j: j.submit_time)]
+        deltas = []
+        for job in sorted(set(old) | set(self.job_num_chips)
+                          | set(self._pass_reasons)):
+            before = old.get(job, 0)
+            after = self.job_num_chips.get(job, 0)
+            reasons = list(self._pass_reasons.get(job, []))
+            if before == after and not reasons:
+                continue
+            if not reasons:
+                # Changed with no recorded action: the only silent path is
+                # a job that left the allocation by reaching a terminal
+                # state (completed/failed/canceled before this pass).
+                reasons = ["released_terminal"]
+            delta = {"job": job, "before": before, "after": after,
+                     "reasons": reasons}
+            if job in self._pass_resize_seconds:
+                delta["resize_seconds"] = round(
+                    self._pass_resize_seconds[job], 4)
+            deltas.append(delta)
+        rec = {
+            "kind": "resched_audit",
+            "schema": obs_audit.SCHEMA_VERSION,
+            "ts": self.clock.now(),
+            "pool": self.pool_id,
+            "seq": self._audit_seq,
+            "trace_id": span.trace_id,
+            "triggers": triggers,
+            "algorithm": self.algorithm,
+            "total_chips": self.total_chips,
+            "queue": queue,
+            "deltas": deltas,
+            "duration_ms": round(duration_s * 1000.0, 3),
+            "outcome": outcome,
+        }
+        self.audit_ring.append(rec)
+        self.tracer.emit(dict(rec))
+
+    def audit_records(self, n: int = 20) -> List[dict]:
+        """The last n decision-audit records (GET /debug/resched)."""
+        with self._lock:
+            records = list(self.audit_ring)
+        return records[-max(0, int(n)):] if n else records
+
+    def explain_job(self, job: str, n: int = 50) -> List[dict]:
+        """Audit records whose deltas touch `job`, oldest first
+        (GET /debug/trace/<job> and `voda explain <job>`)."""
+        with self._lock:
+            records = list(self.audit_ring)
+        hits = [r for r in records
+                if any(d.get("job") == job for d in r.get("deltas", ()))]
+        return hits[-max(0, int(n)):] if n else hits
 
     # ---- time accounting + Tiresias transitions (reference :757-813) -----
 
@@ -807,7 +1004,7 @@ class Scheduler:
                     m.last_waiting_seconds = 0.0
                     priority_changed = True
         if priority_changed:
-            self.trigger_resched()
+            self.trigger_resched("priority_change")
 
     # ---- crash resume (reference: constructStatusOnRestart :1009-1072) ---
 
@@ -831,7 +1028,7 @@ class Scheduler:
             self.placement_manager.restore(
                 {name: h.placements for name, h in running.items()
                  if h.placements})
-        self.trigger_resched()
+        self.trigger_resched("resume")
 
     # ---- introspection (reference: GET /training table :968-998) ---------
 

@@ -109,6 +109,21 @@ class VodaApp:
         self.bus = EventBus()
         self.registry = Registry()
 
+        # Decision-audit tracing plane (doc/observability.md): JSONL sink
+        # under the workdir unless VODA_TRACE_DIR points elsewhere.
+        # Installed as the process-global tracer so every component —
+        # including the REST layer's access events and the supervisors
+        # spawned with the dir in their env — records into one trace.
+        from vodascheduler_tpu import obs
+        self.tracer = obs.Tracer(
+            clock=self.clock,
+            trace_dir=os.environ.get("VODA_TRACE_DIR")
+            or os.path.join(self.workdir, "trace"),
+            ring_size=int(os.environ.get("VODA_TRACE_RING", "4096")),
+            max_bytes=int(float(os.environ.get("VODA_TRACE_MAX_MB", "64"))
+                          * 1024 * 1024))
+        obs.set_tracer(self.tracer)
+
         self.allocator = ResourceAllocator(self.store, registry=self.registry)
 
         # Pool set: explicit multi-pool spec, or the single-pool args
@@ -190,13 +205,14 @@ class VodaApp:
                 algorithm=ps.algorithm or algorithm,
                 rate_limit_seconds=rate_limit_seconds,
                 resume=resume, registry=self.registry,
-                placement_manager=pm)
+                placement_manager=pm, tracer=self.tracer)
             self.backends[ps.name] = be
             self.placements[ps.name] = pm
             self.schedulers[ps.name] = sched
             self.collectors[ps.name] = MetricsCollector(
                 self.store, CsvDirRowSource(be.metrics_dir),
-                interval_seconds=collector_interval_seconds)
+                interval_seconds=collector_interval_seconds,
+                registry=self.registry, pool=ps.name)
 
         # Back-compat single-pool attributes (first pool).
         first = pool_specs[0].name
@@ -253,7 +269,7 @@ class VodaApp:
         (reference: collector writes Mongo, next resched reads it §3.5)."""
         for name, collector in self.collectors.items():
             if collector.collect_all() > 0:
-                self.schedulers[name].trigger_resched()
+                self.schedulers[name].trigger_resched("metrics_update")
 
     def start(self) -> None:
         self.daemon.start()

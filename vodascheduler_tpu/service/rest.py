@@ -33,12 +33,16 @@ from vodascheduler_tpu.allocator import AllocationRequest, ResourceAllocator
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import job_from_dict, job_to_dict
+from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.service.admission import AdmissionError, AdmissionService
 
 log = logging.getLogger(__name__)
 
 # route table: (method, path) -> fn(body_bytes, query_dict) -> (status, payload)
 # payload: dict/list (JSON), or (content_type, str) for raw text.
+# A path ending in "/*" is a prefix route: the remainder of the request
+# path (e.g. the job name in /debug/trace/<job>) is passed to the handler
+# as query["__path__"][0].
 Route = Callable[[bytes, Dict[str, list]], Tuple[int, object]]
 
 
@@ -48,24 +52,78 @@ class RestServer:
     def __init__(self, routes: Dict[Tuple[str, str], Route],
                  host: str = "127.0.0.1", port: int = 0):
         class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):  # quiet; klog-level 5 noise
+            def log_message(self, fmt, *args):
+                # The raw BaseHTTPRequestHandler line is dropped (klog-
+                # level-5 noise); the structured http_access event emitted
+                # by _dispatch is the access log.
                 log.debug("%s - %s", self.address_string(), fmt % args)
 
+            def _resolve(self, method: str, path: str):
+                fn = routes.get((method, path))
+                if fn is not None:
+                    return fn, None
+                # Longest-prefix wildcard match: ("GET", "/debug/trace/*")
+                # serves /debug/trace/<job>.
+                best = None
+                for (m, pat), candidate in routes.items():
+                    if m != method or not pat.endswith("/*"):
+                        continue
+                    prefix = pat[:-1]  # keep the trailing slash
+                    if path.startswith(prefix) and (
+                            best is None or len(prefix) > best[0]):
+                        best = (len(prefix), candidate, path[len(prefix):])
+                if best is None:
+                    return None, None
+                # Decode the segment: the CLI percent-encodes job names
+                # (quote(name, safe='')), and the ?job= form decodes via
+                # parse_qs — the two access paths must agree.
+                from urllib.parse import unquote
+                return best[1], unquote(best[2])
+
             def _dispatch(self, method: str) -> None:
+                import time as _walltime
+
                 parsed = urlparse(self.path)
-                fn = routes.get((method, parsed.path))
+                fn, wildcard = self._resolve(method, parsed.path)
                 if fn is None:
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                query = parse_qs(parsed.query)
+                if wildcard is not None:
+                    query["__path__"] = [wildcard]
+                # Cross-process trace propagation: a caller that sent
+                # X-Voda-Trace-Id (RemoteAllocator does) has its context
+                # installed as ambient for the handler, so spans opened
+                # inside (allocator.allocate) stitch into its trace.
+                ctx = obs_tracer.TraceContext.from_headers(self.headers)
+                t0 = _walltime.monotonic()
                 try:
-                    status, payload = fn(body, parse_qs(parsed.query))
+                    with obs_tracer.use_context(ctx):
+                        status, payload = fn(body, query)
                 except (AdmissionError, KeyError, ValueError) as e:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:
                     log.exception("handler error")
                     status, payload = 500, {"error": str(e)}
+                # Structured access event (the log_message pass above
+                # would otherwise silently drop all access logs): the
+                # /debug endpoints are themselves observable.
+                try:
+                    rec = {
+                        "kind": "http_access",
+                        "method": method,
+                        "path": parsed.path,
+                        "status": int(status),
+                        "duration_ms": round(
+                            (_walltime.monotonic() - t0) * 1000.0, 3),
+                    }
+                    if ctx is not None:
+                        rec["trace_id"] = ctx.trace_id
+                    obs_tracer.get_tracer().emit(rec)
+                except Exception:  # noqa: BLE001 - never fail a reply
+                    log.debug("access event emit failed", exc_info=True)
                 self._reply(status, payload)
 
             def _reply(self, status: int, payload) -> None:
@@ -218,11 +276,34 @@ def make_scheduler_server(scheduler, registry: Registry,
                             "total_chips": s.total_chips}
                      for name, s in schedulers.items()}
 
+    def debug_resched(body, query):
+        """Last K decision-audit records (?n=K, default 20) — the
+        machine-readable why of recent rescheds (doc/observability.md)."""
+        n = int(query.get("n", ["20"])[0])
+        return 200, pick(body, query).audit_records(n)
+
+    def debug_trace(body, query):
+        """Decision history + spans for one job: /debug/trace/<job> or
+        ?job=<name>. Backs `voda explain <job>`."""
+        job = (query.get("__path__", [None])[0]
+               or query.get("job", [None])[0])
+        if not job:
+            raise ValueError("job name required: /debug/trace/<job>")
+        sched = pick(body, query)
+        return 200, {
+            "job": job,
+            "records": sched.explain_job(job),
+            "spans": sched.tracer.spans_for_job(job, limit=200),
+        }
+
     return RestServer({
         ("GET", "/training"): get_training,
         ("PUT", "/algorithm"): put_algorithm,
         ("PUT", "/ratelimit"): put_ratelimit,
         ("GET", "/pools"): get_pools,
+        ("GET", "/debug/resched"): debug_resched,
+        ("GET", "/debug/trace"): debug_trace,
+        ("GET", "/debug/trace/*"): debug_trace,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
 
@@ -276,8 +357,16 @@ class RemoteAllocator:
                  "host_block": list(request.topology.host_block)}
                 if request.topology is not None else None),
         }).encode()
+        headers = {"Content-Type": "application/json"}
+        # Propagate the resched trace across the HTTP hop: the allocator
+        # server installs these as its handler's ambient context, so the
+        # remote allocator.allocate span stitches into the scheduler's
+        # trace exactly like the in-process call.
+        ctx = obs_tracer.current_context()
+        if ctx is not None:
+            headers.update(ctx.to_headers())
         req = urllib.request.Request(
             f"{self.base_url}/allocation", data=payload,
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return {k: int(v) for k, v in json.load(resp).items()}
