@@ -2,21 +2,25 @@
 
 Re-derives the rate_limit x hysteresis x cooldown knee and the 8-seed
 robustness panel (doc/benchmarks.md methodology) — required after any
-change to replay pricing or workload simulation. r6's trigger: two-tier
-resize pricing (doc/elastic-resize.md) — same-host resizes are now
-in-place live reshards at a fraction of the cold checkpoint-restart
-cost, and in-place resizes no longer re-arm the preemption lease; with
-reconfiguration cheaper, the knee moves to a much faster rate limit
-(45 s -> 15 s: the scheduler can afford to act more often — the
-compounding the motivating reconfiguration-cost papers predict). r5's
-trigger was the profile-registration race fix (simulator._submit
-on_admitted), which revealed 29/64 headline-trace jobs had been
-simulating the default 60 s-epoch toy profile.
+change to replay pricing or workload simulation. r7's trigger:
+critical-path actuation pricing (the concurrent actuation plane) — the
+replay now charges every pass its per-wave-max actuation seconds
+against the next rate-limit window, where it previously charged ZERO
+(the scheduler could reschedule infinitely fast compared to a live
+control plane; the pre-wave serial engine would have charged the SUM,
+even worse). Passes are no longer free, so the knee re-balances toward
+fewer, better-timed passes. r6's trigger: two-tier resize pricing
+(doc/elastic-resize.md) — same-host resizes are in-place live reshards
+at a fraction of the cold checkpoint-restart cost, and in-place resizes
+no longer re-arm the preemption lease. r5's trigger was the
+profile-registration race fix (simulator._submit on_admitted), which
+revealed 29/64 headline-trace jobs had been simulating the default
+60 s-epoch toy profile.
 
 Usage:
   python scripts/replay_sweep.py knee    # pinned-seed knob sweep
   python scripts/replay_sweep.py panel   # 8-seed panel at chosen knobs
-  python scripts/replay_sweep.py all     # both; writes doc/replay_sweep_r6.json
+  python scripts/replay_sweep.py all     # both; writes doc/replay_sweep_r7.json
 """
 
 from __future__ import annotations
@@ -58,6 +62,8 @@ def run_one(seed: int, rate: float, hyst: float, cooldown: float,
         "p95_jct": round(r.p95_jct_seconds, 1),
         "makespan": round(r.makespan_seconds, 1),
         "ss_frac": round(r.steady_state_seconds / r.makespan_seconds, 3),
+        "act_cp_s": r.actuation_critical_path_seconds,
+        "act_sum_s": r.actuation_serial_sum_seconds,
     }
 
 
@@ -90,12 +96,16 @@ def panel(rate: float, hyst: float, cooldown: float) -> list:
 
 # The shipped headline configuration (bench.py) — the panel's knobs when
 # run standalone, and _best's fallback when no sweep cell qualifies.
-SHIPPED_KNEE = dict(rate=15.0, hyst=1.5, cooldown=60.0)
+# r7 pick: with resizes (not starts — a spawn never blocks its caller)
+# priced at their critical path, the knee slows to a 20 s rate limit
+# and hardens suppression (hysteresis 2.0, cooldown 300 s): a marginal
+# grow now charges the pass its drain, so fewer are worth taking.
+SHIPPED_KNEE = dict(rate=20.0, hyst=2.0, cooldown=300.0)
 
 
 def _write(out: dict) -> None:
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "doc", "replay_sweep_r6.json")
+        os.path.abspath(__file__))), "doc", "replay_sweep_r7.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", path)
@@ -134,8 +144,16 @@ def _best(rows: list) -> dict:
     near = [r for r in ok if r["ss_util"] >= best_util - 0.01]
     # Within the util-equivalent set, balance mean against tail — on a
     # saturated workload the knobs move avg and p95 in opposite
-    # directions, so neither alone picks a defensible knee.
-    r = min(near, key=lambda r: r["avg_jct"] + r["p95_jct"])
+    # directions, so neither alone picks a defensible knee. Exact ties
+    # (whole knob ranges that never bound) break toward the shipped
+    # values, so a flat axis doesn't flip a knob for no measured reason.
+    def score(r):
+        tie = sum(abs(r[k] - SHIPPED_KNEE[k2])
+                  for k, k2 in (("rate", "rate"), ("hyst", "hyst"),
+                                ("cooldown", "cooldown")))
+        return (r["avg_jct"] + r["p95_jct"], tie)
+
+    r = min(near, key=score)
     return dict(rate=r["rate"], hyst=r["hyst"], cooldown=r["cooldown"])
 
 

@@ -21,3 +21,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Deadlock watchdog: the scheduler actuates rescheds on worker threads
+# (decide/actuate lock split), and a future locking bug would present as
+# a silent hang the tier-1 driver kills with a bare timeout and no
+# evidence. pytest's built-in faulthandler plugin handles this —
+# `faulthandler_timeout = 780` in pyproject.toml dumps every thread's
+# stack to a PRE-CAPTURE dup of stderr when a single test exceeds the
+# budget, so the diagnosis survives both output capturing and the
+# driver's subsequent hard kill. (A hand-rolled faulthandler.enable()
+# here would regress that: it re-registers against the captured fd and
+# the evidence would vanish into capture temp files.)

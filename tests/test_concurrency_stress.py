@@ -37,7 +37,12 @@ def _build():
     clock = VirtualClock(start=1_700_000_000.0)
     store = JobStore()
     bus = EventBus()
-    backend = FakeClusterBackend(clock, restart_overhead_seconds=5.0)
+    # A small real actuation latency + forced-parallel waves: the storm
+    # exercises the decide/actuate lock split (workers booking while
+    # readers/advancers/chaos hammer the lock), not just the old
+    # everything-under-one-lock path.
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=5.0,
+                                 actuation_latency_seconds=0.005)
     topology = PoolTopology(torus_dims=(4, 2, 2), host_block=(2, 2, 1))
     pm = PlacementManager("stress", topology=topology)
     pm.add_hosts_from_topology(topology)
@@ -47,7 +52,7 @@ def _build():
     sched = Scheduler("stress", backend, store,
                       ResourceAllocator(store), clock, bus=bus,
                       placement_manager=pm, algorithm="ElasticTiresias",
-                      rate_limit_seconds=5.0)
+                      rate_limit_seconds=5.0, actuation_parallel=True)
     admission = AdmissionService(store, bus, clock)
     return clock, store, backend, sched, admission, topology
 

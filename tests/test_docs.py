@@ -49,6 +49,7 @@ class TestMetricsCatalog:
                                "prometheus-metrics-exposed.md")) as f:
             doc = f.read()
         for series in ("voda_scheduler_resched_latency_seconds",
+                       "voda_scheduler_actuation_seconds",
                        "voda_scheduler_resize_duration_seconds",
                        "voda_allocator_algorithm_runtime_seconds",
                        "voda_job_step_time_seconds"):
@@ -94,6 +95,20 @@ class TestApisDoc:
             assert knob in doc, f"retention knob {knob} undocumented"
         for kind in ("resched_audit", "span", "http_access"):
             assert kind in doc, f"record kind {kind} undocumented"
+
+    def test_observability_doc_covers_concurrency_model(self):
+        """The concurrent actuation plane's contract is documented: the
+        decide/actuate split, the wave vocabulary (matching the
+        histogram's label values), the barrier, and the generation
+        token."""
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        assert "Scheduler concurrency model" in doc
+        for term in ("Decide under the lock", "Actuate outside the lock",
+                     "wave barrier", "release", "claim", "migrate",
+                     "generation", "VODA_ACTUATION_WORKERS",
+                     "voda_scheduler_actuation_seconds"):
+            assert term in doc, f"concurrency-model term {term!r} missing"
 
 
 def test_helm_chart_values_references_resolve():

@@ -6,12 +6,24 @@ import os
 
 import pytest
 
+from tests import helpers
+
+# Model points step real models (reshard path -> get_abstract_mesh) and
+# attention points build the Pallas flash kernel (CompilerParams): both
+# newer-jax surfaces must exist for the measured rows to materialize —
+# on older jax the points error out and the asserted keys never appear.
+needs_new_jax = pytest.mark.skipif(
+    not (helpers.JAX_HAS_ABSTRACT_MESH
+         and helpers.JAX_HAS_PALLAS_COMPILER_PARAMS),
+    reason=f"{helpers.NEEDS_ABSTRACT_MESH}; {helpers.NEEDS_PALLAS_COMPILER_PARAMS}")
+
 
 @pytest.fixture(autouse=True)
 def cpu_escape_hatch(monkeypatch):
     monkeypatch.setenv("VODA_HWBENCH_ON_CPU", "1")
 
 
+@needs_new_jax
 def test_model_point_and_attention_point():
     from vodascheduler_tpu.runtime.hwbench import run_hardware_bench
     out = run_hardware_bench(model_points=(("llama_tiny", 4),),
@@ -34,6 +46,7 @@ def test_point_errors_are_isolated():
     assert "error" in out["models"][0]
 
 
+@needs_new_jax
 def test_moe_dispatch_compare_hermetic():
     """The gather/routed/dense comparison runs hermetically on a tiny
     config and reports active-param MFU for the gather flagship."""

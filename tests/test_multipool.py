@@ -13,6 +13,7 @@ import urllib.request
 
 import pytest
 
+from tests import helpers
 from vodascheduler_tpu.placement.topology import PoolTopology
 from vodascheduler_tpu.service.app import PoolSpec, VodaApp, parse_pools
 
@@ -67,6 +68,8 @@ class TestTopologyReachesMeshPlanning:
                          slice_shape=topo.slice_for(8))
         assert plan.num_chips == 8
 
+    @pytest.mark.skipif(not helpers.JAX_HAS_ABSTRACT_MESH,
+                        reason=helpers.NEEDS_ABSTRACT_MESH)
     def test_train_setup_uses_topology(self):
         # params_b >= 1 wants tp; a 1-chip-per-host pool forbids it.
         from vodascheduler_tpu.models import get_model

@@ -6,6 +6,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+from tests import helpers
 from vodascheduler_tpu.parallel.mesh import MeshPlan, build_mesh, plan_mesh
 from vodascheduler_tpu.parallel.ring_attention import (
     make_ring_attention,
@@ -70,6 +71,8 @@ class TestShardingRules:
         assert spec == P(("dp", "fsdp"))
 
 
+@pytest.mark.skipif(not helpers.JAX_HAS_ABSTRACT_MESH,
+                    reason=helpers.NEEDS_ABSTRACT_MESH)
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_reference(self, causal):

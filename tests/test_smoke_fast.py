@@ -15,7 +15,11 @@ from vodascheduler_tpu.models import get_model
 from vodascheduler_tpu.parallel.mesh import MeshPlan
 from vodascheduler_tpu.runtime import TrainSession, latest_step
 
+from tests import helpers
 
+
+@pytest.mark.skipif(not helpers.JAX_HAS_ABSTRACT_MESH,
+                    reason=helpers.NEEDS_ABSTRACT_MESH)
 def test_llama_tiny_trains_and_reshards(tmp_path):
     """Train on dp2, checkpoint, restore on a 4-chip fsdp mesh, continue:
     the end-to-end elastic slice (models + sharding + checkpoint) in one
@@ -42,6 +46,8 @@ def test_llama_tiny_trains_and_reshards(tmp_path):
     assert np.isfinite(r.run_steps(1))
 
 
+@pytest.mark.skipif(not helpers.JAX_HAS_PALLAS_COMPILER_PARAMS,
+                    reason=helpers.NEEDS_PALLAS_COMPILER_PARAMS)
 def test_flash_attention_tiny_parity():
     """One interpreter-mode Pallas point vs the O(S²) reference —
     values and grads (the sweep lives in test_ops)."""
@@ -70,6 +76,8 @@ def test_flash_attention_tiny_parity():
                                rtol=2e-3)
 
 
+@pytest.mark.skipif(not helpers.JAX_HAS_ABSTRACT_MESH,
+                    reason=helpers.NEEDS_ABSTRACT_MESH)
 def test_mixtral_tiny_single_step():
     """MoE path stays alive in the fast suite (full matrix in
     test_models)."""

@@ -123,6 +123,18 @@ class ClusterBackend(abc.ABC):
         """Live jobs as the backend sees them (crash-resume source;
         reference: listing MPIJobs on restart, scheduler.go:1019)."""
 
+    def actuation_price_seconds(self, name: str) -> Optional[float]:
+        """Modeled wall-clock cost of the most recent start/scale/stop
+        call for `name`, or None when the backend has no model. Real
+        backends return None — the scheduler prices actuation from the
+        measured wall time of the call it just made. Simulated backends
+        (FakeClusterBackend under a VirtualClock, where every call
+        returns in microseconds of real time) return the overhead they
+        modeled, so replay prices a pass's actuation waves at their
+        critical path (per-wave max) exactly like a live run would
+        measure them."""
+        return None
+
     def set_event_callback(self, cb: Callable[[ClusterEvent], None]) -> None:
         """Register the scheduler's event sink (informer analog)."""
         self._event_cb = cb

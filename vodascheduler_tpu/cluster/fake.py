@@ -28,6 +28,8 @@ rows exactly like the reference's training-side CSV logger
 from __future__ import annotations
 
 import dataclasses
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from vodascheduler_tpu.cluster.backend import (
@@ -110,9 +112,28 @@ class FakeClusterBackend(ClusterBackend):
 
     def __init__(self, clock: VirtualClock,
                  restart_overhead_seconds: float = 10.0,
-                 inplace_overhead_seconds: Optional[float] = None):
+                 inplace_overhead_seconds: Optional[float] = None,
+                 actuation_latency_seconds: float = 0.0):
         self.clock = clock
         self.restart_overhead_seconds = restart_overhead_seconds
+        # WALL-clock latency of each start/scale/stop call (a real
+        # time.sleep, never a virtual-clock advance): models the blocking
+        # backend round trip (ack poll loop, pod churn) so concurrency
+        # tests can pin a parallel pass at the per-wave max instead of
+        # the serial sum without real restart-scale sleeps dominating.
+        self.actuation_latency_seconds = actuation_latency_seconds
+        # Serializes simulation-state mutation: the scheduler's actuation
+        # waves call start/scale/stop from several threads at once, and
+        # epoch-boundary timers can fire concurrently (a stress test's
+        # clock-advancer thread). Reentrant: migrate -> scale_job.
+        # Invariant: no sleep and no emit() while holding it — emitting
+        # re-enters the scheduler (its own lock) and would invert lock
+        # order against scheduler->backend calls.
+        self._state_lock = threading.RLock()
+        # job -> modeled seconds of its most recent actuation call (the
+        # scheduler's replay-pricing hint, see
+        # ClusterBackend.actuation_price_seconds).
+        self._actuation_price: Dict[str, float] = {}
         # Tier-A pause default: reshard + recompile, no process lifecycle
         # and no checkpoint round-trip. When not measured (replay passes
         # restart_costs.default_inplace_seconds), a tenth of the cold
@@ -145,15 +166,19 @@ class FakeClusterBackend(ClusterBackend):
     # ---- fleet management -------------------------------------------------
 
     def add_host(self, name: str, chips: int, announce: bool = True) -> None:
-        self.hosts[name] = chips
-        self.capacity_history.append((self.clock.now(), self.total_chips()))
+        with self._state_lock:
+            self.hosts[name] = chips
+            self.capacity_history.append((self.clock.now(),
+                                          self.total_chips()))
         if announce:
             self.emit(ClusterEvent(ClusterEventKind.HOST_ADDED, name,
                                    timestamp=self.clock.now()))
 
     def remove_host(self, name: str, announce: bool = True) -> None:
-        self.hosts.pop(name, None)
-        self.capacity_history.append((self.clock.now(), self.total_chips()))
+        with self._state_lock:
+            self.hosts.pop(name, None)
+            self.capacity_history.append((self.clock.now(),
+                                          self.total_chips()))
         if announce:
             self.emit(ClusterEvent(ClusterEventKind.HOST_REMOVED, name,
                                    timestamp=self.clock.now()))
@@ -165,7 +190,9 @@ class FakeClusterBackend(ClusterBackend):
         total = 0.0
         chips = 0
         t_prev = start
-        for t, c in self.capacity_history:
+        with self._state_lock:
+            history = list(self.capacity_history)
+        for t, c in history:
             if t <= start:
                 chips = c
                 continue
@@ -178,7 +205,8 @@ class FakeClusterBackend(ClusterBackend):
         return total
 
     def list_hosts(self) -> Dict[str, int]:
-        return dict(self.hosts)
+        with self._state_lock:
+            return dict(self.hosts)
 
     def register_profile(self, name: str, profile: WorkloadProfile) -> None:
         """Register under an exact job name or a category (family) name.
@@ -200,6 +228,7 @@ class FakeClusterBackend(ClusterBackend):
         # names/components/attrs, parented on the ambient resched context
         # — a replay trace and a live trace of the same workload are
         # directly diffable.
+        self._actuation_sleep()
         tracer = obs_tracer.active_tracer()
         with tracer.span("backend.start", component="backend",
                          attrs={"job": spec.name, "chips": num_workers}):
@@ -208,37 +237,65 @@ class FakeClusterBackend(ClusterBackend):
                                     "simulated": True}):
                 self._start_job_traced(spec, num_workers, placements)
 
+    def _actuation_sleep(self) -> None:
+        """The modeled blocking round trip of one backend call — real
+        wall time, never virtual time, and never under the state lock
+        (serializing the sleeps would turn a parallel wave back into the
+        sum the wave exists to avoid)."""
+        if self.actuation_latency_seconds > 0:
+            time.sleep(self.actuation_latency_seconds)
+
     def _start_job_traced(self, spec: JobSpec, num_workers: int,
                           placements: Optional[List[Tuple[str, int]]]) -> None:
-        now = self.clock.now()
-        existing = self.jobs.get(spec.name)
-        if existing is not None:
-            # restart of a halted job: training state survived (checkpoint)
-            sim = existing
-            sim.num_workers = num_workers
-            sim.placements = placements or []
-        else:
-            sim = _SimJob(spec=spec, profile=self._profile_for(spec),
-                          num_workers=num_workers,
-                          placements=placements or [], last_update=now)
-            self.jobs[spec.name] = sim
-            self.metrics_rows.setdefault(spec.name, [])
-        sim.restarts += 1
-        self.restarts_total += 1
-        sim.busy_until = now + self._overhead(sim)
-        sim.last_update = now
-        sim.epoch_started_at = now
-        sim.epoch_started_serial = sim.progress_serial
-        sim.epoch_started_workers = num_workers
-        sim.generation += 1
-        self._schedule_next_event(sim)
+        with self._state_lock:
+            now = self.clock.now()
+            existing = self.jobs.get(spec.name)
+            if existing is not None:
+                # restart of a halted job: training state survived
+                # (checkpoint)
+                sim = existing
+                sim.num_workers = num_workers
+                sim.placements = placements or []
+            else:
+                sim = _SimJob(spec=spec, profile=self._profile_for(spec),
+                              num_workers=num_workers,
+                              placements=placements or [], last_update=now)
+                self.jobs[spec.name] = sim
+                self.metrics_rows.setdefault(spec.name, [])
+            sim.restarts += 1
+            self.restarts_total += 1
+            overhead = self._overhead(sim)
+            # Price a START at just the call round trip: every real
+            # backend's start_job returns once the processes/pods are
+            # launched — the checkpoint restore + recompile (the busy
+            # window below) runs inside the job, not on the scheduler's
+            # thread. Only resizes block the caller longer (see
+            # _scale_job_locked).
+            self._actuation_price[spec.name] = self.actuation_latency_seconds
+            sim.busy_until = now + overhead
+            sim.last_update = now
+            sim.epoch_started_at = now
+            sim.epoch_started_serial = sim.progress_serial
+            sim.epoch_started_workers = num_workers
+            sim.generation += 1
+            self._schedule_next_event(sim)
 
     def scale_job(self, name: str, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None
                   ) -> Optional[ResizePath]:
+        with self._state_lock:
+            if name not in self.jobs:
+                return None
+        self._actuation_sleep()
+        with self._state_lock:
+            return self._scale_job_locked(name, num_workers, placements)
+
+    def _scale_job_locked(self, name: str, num_workers: int,
+                          placements: Optional[List[Tuple[str, int]]]
+                          ) -> Optional[ResizePath]:
         sim = self.jobs.get(name)
         if sim is None:
-            return None
+            return None  # vanished during the modeled round trip
         self._accrue(sim)
         # Tier decision, mirroring the REAL feasibility gate
         # (runtime/supervisor.py: single process, target within its
@@ -284,8 +341,16 @@ class FakeClusterBackend(ClusterBackend):
                 self.restarts_total += 1
                 self.cold_resizes_total += 1
             now = self.clock.now()
-            sim.busy_until = now + (self._inplace_overhead(sim) if inplace
-                                    else self._overhead(sim))
+            overhead = (self._inplace_overhead(sim) if inplace
+                        else self._overhead(sim))
+            # A resize DOES block its caller: the in-place path waits for
+            # the supervisor's resharded-step ack (≈ the in-place
+            # overhead), the cold path waits out the SIGTERM checkpoint
+            # drain + respawn (≈ the restart overhead on LocalBackend —
+            # conservative for GKE, whose pod churn returns in seconds).
+            self._actuation_price[name] = (
+                overhead + self.actuation_latency_seconds)
+            sim.busy_until = now + overhead
             sim.epoch_started_at = now
             sim.epoch_started_serial = sim.progress_serial
             sim.epoch_started_workers = num_workers
@@ -296,28 +361,44 @@ class FakeClusterBackend(ClusterBackend):
     def stop_job(self, name: str) -> None:
         """Halt: remove from running set; progress (checkpoint) is kept in
         the sim record so a later start resumes where it left off."""
-        sim = self.jobs.get(name)
-        if sim is None:
-            return
+        with self._state_lock:
+            if name not in self.jobs:
+                return
+        self._actuation_sleep()
         with obs_tracer.active_tracer().span(
-                "backend.stop", component="backend", attrs={"job": name}):
+                "backend.stop", component="backend", attrs={"job": name}), \
+                self._state_lock:
+            sim = self.jobs.get(name)
+            if sim is None:
+                return  # completed/failed during the modeled round trip
             self._accrue(sim)
             sim.num_workers = 0
             sim.placements = []
             sim.generation += 1  # cancel pending timers
+            # A halt's checkpoint drain is folded into the NEXT start's
+            # restart overhead (that's where the sim charges it), so the
+            # stop itself prices at just the call round trip.
+            self._actuation_price[name] = self.actuation_latency_seconds
 
     def migrate_workers(self, name: str,
                         placements: List[Tuple[str, int]]) -> None:
-        sim = self.jobs.get(name)
-        if sim is None:
-            return
+        with self._state_lock:
+            sim = self.jobs.get(name)
+            if sim is None:
+                return
+            num_workers = sim.num_workers
         # Same-size re-placement: still a checkpoint-restart on TPU.
-        self.scale_job(name, sim.num_workers, placements)
+        self.scale_job(name, num_workers, placements)
+
+    def actuation_price_seconds(self, name: str) -> Optional[float]:
+        with self._state_lock:
+            return self._actuation_price.get(name)
 
     def running_jobs(self) -> Dict[str, JobHandle]:
-        return {name: JobHandle(name=name, num_workers=sim.num_workers,
-                                placements=list(sim.placements))
-                for name, sim in self.jobs.items() if sim.num_workers > 0}
+        with self._state_lock:
+            return {name: JobHandle(name=name, num_workers=sim.num_workers,
+                                    placements=list(sim.placements))
+                    for name, sim in self.jobs.items() if sim.num_workers > 0}
 
     def _overhead(self, sim: _SimJob) -> float:
         if sim.profile.restart_overhead_seconds is not None:
@@ -337,7 +418,7 @@ class FakeClusterBackend(ClusterBackend):
         return sim.profile.speedup_at(sim.num_workers)
 
     def _accrue(self, sim: _SimJob) -> None:
-        """Bring progress up to now."""
+        """Bring progress up to now. Callers hold the state lock."""
         now = self.clock.now()
         start = max(sim.last_update, sim.busy_until)
         if now > start and sim.num_workers > 0:
@@ -351,8 +432,9 @@ class FakeClusterBackend(ClusterBackend):
         """Bring every job's busy-chip-second integral up to the current
         clock time — utilization readers (replay steady-state windows)
         sample between events, where lazy per-job accrual would lag."""
-        for sim in self.jobs.values():
-            self._accrue(sim)
+        with self._state_lock:
+            for sim in self.jobs.values():
+                self._accrue(sim)
 
     def _schedule_next_event(self, sim: _SimJob) -> None:
         """Schedule the next epoch-completion (or failure) timer."""
@@ -374,8 +456,18 @@ class FakeClusterBackend(ClusterBackend):
         self.clock.call_at(eta, lambda: self._on_epoch_boundary(sim, generation))
 
     def _on_epoch_boundary(self, sim: _SimJob, generation: int) -> None:
+        with self._state_lock:
+            event = self._epoch_boundary_inner(sim, generation)
+        if event is not None:
+            # Emit OUTSIDE the state lock: the scheduler's handler takes
+            # its own lock, and an actuation-wave worker may already hold
+            # it while calling into this backend.
+            self.emit(event)
+
+    def _epoch_boundary_inner(self, sim: _SimJob,
+                              generation: int) -> Optional[ClusterEvent]:
         if sim.generation != generation or sim.spec.name not in self.jobs:
-            return  # stale timer: job was resized/stopped meanwhile
+            return None  # stale timer: job was resized/stopped meanwhile
         self._accrue(sim)
         now = self.clock.now()
         sim.epochs_done += 1
@@ -410,27 +502,30 @@ class FakeClusterBackend(ClusterBackend):
                 and sim.epochs_done >= sim.profile.fail_at_epoch):
             self.failed.append(sim.spec.name)
             del self.jobs[sim.spec.name]
-            self.emit(ClusterEvent(ClusterEventKind.JOB_FAILED, sim.spec.name,
-                                   detail=f"injected failure at epoch {sim.epochs_done}",
-                                   timestamp=now))
-            return
+            return ClusterEvent(
+                ClusterEventKind.JOB_FAILED, sim.spec.name,
+                detail=f"injected failure at epoch {sim.epochs_done}",
+                timestamp=now)
 
         if sim.epochs_done >= sim.spec.config.epochs:
             self.completed.append(sim.spec.name)
             del self.jobs[sim.spec.name]
-            self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED, sim.spec.name,
-                                   timestamp=now))
-            return
+            return ClusterEvent(ClusterEventKind.JOB_COMPLETED,
+                                sim.spec.name, timestamp=now)
 
         self._schedule_next_event(sim)
+        return None
 
     # ---- introspection ---------------------------------------------------
 
     def total_chips(self) -> int:
-        return sum(self.hosts.values())
+        with self._state_lock:
+            return sum(self.hosts.values())
 
     def job_progress(self, name: str) -> float:
-        sim = self.jobs.get(name)
-        if sim is None:
-            return 1.0 if name in self.completed else 0.0
-        return sim.progress_serial / sim.total_serial if sim.total_serial else 0.0
+        with self._state_lock:
+            sim = self.jobs.get(name)
+            if sim is None:
+                return 1.0 if name in self.completed else 0.0
+            return (sim.progress_serial / sim.total_serial
+                    if sim.total_serial else 0.0)

@@ -276,6 +276,16 @@ class GkeBackend(ClusterBackend):
         # pods, so sweeps must not read it as vanished (or as terminal
         # phases of the dying incarnation).
         self._resizing: set = set()
+        # Jobs mid-start (pods being created, not yet tracked): blocks a
+        # duplicate start without holding the lock across the API calls.
+        self._starting: set = set()
+        # Guards the tracking maps ONLY — never held across a kube API
+        # call: the scheduler's actuation waves start/scale several jobs
+        # concurrently, and pod churn for job A must not serialize
+        # behind job B's. Per-job exclusivity (the scheduler never
+        # issues two ops for one job in a pass; _starting/_resizing
+        # catch stragglers) is what makes the lock-free API stretches
+        # safe.
         self._lock = threading.RLock()
         self._closed = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -318,13 +328,21 @@ class GkeBackend(ClusterBackend):
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
         with obs_tracer.active_tracer().span(
                 "backend.start", component="backend",
-                attrs={"job": spec.name, "chips": num_workers}), self._lock:
-            if spec.name in self._jobs:
-                raise RuntimeError(f"job {spec.name!r} already running")
-            self._missing_pods.pop(spec.name, None)  # fresh vanish grace
-            placements = placements or self._default_placements(num_workers)
-            self._specs[spec.name] = spec
+                attrs={"job": spec.name, "chips": num_workers}):
+            with self._lock:
+                if spec.name in self._jobs or spec.name in self._starting:
+                    raise RuntimeError(f"job {spec.name!r} already running")
+                # Placements may raise (not enough chips) — resolve them
+                # BEFORE claiming _starting, or the claim would leak and
+                # block every retried start of this job forever.
+                placements = (placements
+                              or self._default_placements(num_workers))
+                self._starting.add(spec.name)
+                self._missing_pods.pop(spec.name, None)  # fresh vanish grace
+                self._specs[spec.name] = spec
             try:
+                # Pod creation happens WITHOUT the lock: a wave of
+                # concurrent starts overlaps its apiserver round trips.
                 self._create_pods(spec, num_workers, placements)
             except Exception:
                 # A 5xx mid-loop leaves earlier pods (and the coord
@@ -333,11 +351,15 @@ class GkeBackend(ClusterBackend):
                 # Clean up this incarnation best-effort, then let the
                 # caller see the failure (job stays schedulable).
                 self._cleanup_incarnation(spec.name, len(placements))
-                self._specs.pop(spec.name, None)
+                with self._lock:
+                    self._specs.pop(spec.name, None)
+                    self._starting.discard(spec.name)
                 raise
-            self._jobs[spec.name] = JobHandle(
-                name=spec.name, num_workers=num_workers,
-                placements=list(placements))
+            with self._lock:
+                self._jobs[spec.name] = JobHandle(
+                    name=spec.name, num_workers=num_workers,
+                    placements=list(placements))
+                self._starting.discard(spec.name)
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
@@ -378,25 +400,28 @@ class GkeBackend(ClusterBackend):
                     self._jobs.pop(name, None)
                     self._specs.pop(name, None)
                 raise
-            with self._lock:
-                placements = placements or self._default_placements(
-                    num_workers)
-                try:
-                    self._create_pods(spec, num_workers, placements)
-                except Exception:
-                    # Old pods are gone and the new set is partial: a
-                    # half-created incarnation would sit Pending under
-                    # the job's label and the sweep would wait on it
-                    # forever. Clean up and drop the job, then let the
-                    # exception reach the scheduler, which reverts its
-                    # allocation bookkeeping and retries the start — the
-                    # checkpoint makes this a resumable pause, so no
-                    # JOB_FAILED (that verdict is permanent) for a
-                    # transient API storm.
-                    self._cleanup_incarnation(name, len(placements))
+            placements = placements or self._default_placements(num_workers)
+            try:
+                # No lock across the delete->create pod churn: concurrent
+                # wave members resize their own jobs in parallel
+                # (_resizing keeps the sweep out of this window).
+                self._create_pods(spec, num_workers, placements)
+            except Exception:
+                # Old pods are gone and the new set is partial: a
+                # half-created incarnation would sit Pending under
+                # the job's label and the sweep would wait on it
+                # forever. Clean up and drop the job, then let the
+                # exception reach the scheduler, which reverts its
+                # allocation bookkeeping and retries the start — the
+                # checkpoint makes this a resumable pause, so no
+                # JOB_FAILED (that verdict is permanent) for a
+                # transient API storm.
+                self._cleanup_incarnation(name, len(placements))
+                with self._lock:
                     self._jobs.pop(name, None)
                     self._specs.pop(name, None)
-                    raise
+                raise
+            with self._lock:
                 self._jobs[name] = JobHandle(name=name,
                                              num_workers=num_workers,
                                              placements=list(placements))
@@ -484,7 +509,11 @@ class GkeBackend(ClusterBackend):
         if total != num_chips:
             raise ValueError(
                 f"placements cover {total} chips, job wants {num_chips}")
-        self._incarnation[spec.name] = self._incarnation.get(spec.name, 0) + 1
+        with self._lock:
+            # Per-job exclusivity makes the read-back below stable: only
+            # this thread operates on this job's incarnation right now.
+            self._incarnation[spec.name] = \
+                self._incarnation.get(spec.name, 0) + 1
         multi = len(placements) > 1
         coordinator = ""
         if multi:

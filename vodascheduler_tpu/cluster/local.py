@@ -91,7 +91,14 @@ class LocalBackend(ClusterBackend):
         os.makedirs(self.metrics_dir, exist_ok=True)
         self._procs: Dict[str, _Proc] = {}
         self._specs: Dict[str, JobSpec] = {}
+        # Guards the proc/spec tables; never held across a spawn, a
+        # SIGTERM drain, or the in-place ack poll — the scheduler's
+        # actuation waves drive several jobs' lifecycles concurrently
+        # and one job's blocking call must not freeze the table.
         self._lock = threading.Lock()
+        # Jobs mid-spawn (Popen issued, not yet in _procs): duplicate-
+        # start guard for the lock-free spawn stretch.
+        self._starting: set = set()
         self._monitor: Optional[threading.Thread] = None
         self._closed = threading.Event()
 
@@ -111,10 +118,17 @@ class LocalBackend(ClusterBackend):
                 "backend.start", component="backend",
                 attrs={"job": spec.name, "chips": num_workers}):
             with self._lock:
-                if spec.name in self._procs:
+                if spec.name in self._procs or spec.name in self._starting:
                     raise RuntimeError(f"job {spec.name!r} already running")
+                self._starting.add(spec.name)
                 self._specs[spec.name] = spec
-                self._spawn_locked(spec, num_workers)
+            try:
+                proc = self._spawn(spec, num_workers)
+                with self._lock:
+                    self._procs[spec.name] = proc
+            finally:
+                with self._lock:
+                    self._starting.discard(spec.name)
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
@@ -151,8 +165,9 @@ class LocalBackend(ClusterBackend):
     def _restart_at(self, name: str, spec: JobSpec, num_workers: int) -> None:
         """The cold path: checkpoint-stop, respawn at the new size."""
         self._stop_proc(name)
+        proc = self._spawn(spec, num_workers)
         with self._lock:
-            self._spawn_locked(spec, num_workers)
+            self._procs[name] = proc
         self._ensure_monitor()
 
     def stop_job(self, name: str) -> None:
@@ -184,7 +199,10 @@ class LocalBackend(ClusterBackend):
     def _job_dir(self, name: str) -> str:
         return os.path.join(self.workdir, name)
 
-    def _spawn_locked(self, spec: JobSpec, num_chips: int) -> None:
+    def _spawn(self, spec: JobSpec, num_chips: int) -> _Proc:
+        """Launch one supervisor process. Deliberately NOT under the
+        table lock (the caller registers the returned _Proc): spawns of
+        different jobs in one actuation wave overlap."""
         job_dir = self._job_dir(spec.name)
         os.makedirs(job_dir, exist_ok=True)
         with open(os.path.join(job_dir, "spec.json"), "w") as f:
@@ -213,7 +231,7 @@ class LocalBackend(ClusterBackend):
         log_f.close()
         devices_visible = (max(self.hermetic_devices, num_chips)
                            if self.hermetic_devices else self.chips)
-        self._procs[spec.name] = _Proc(popen, num_chips, devices_visible)
+        return _Proc(popen, num_chips, devices_visible)
 
     def _try_inplace_resize(self, name: str, num_chips: int) -> bool:
         """Tier A: ask the running supervisor to reshard in place. True on

@@ -97,7 +97,12 @@ class MultiHostBackend(ClusterBackend):
         os.makedirs(self.metrics_dir, exist_ok=True)
         self._jobs: Dict[str, _ProcSet] = {}
         self._specs: Dict[str, JobSpec] = {}
+        # Guards the job/spec tables; never held across a spawn or the
+        # SIGTERM drain, so one actuation wave's concurrent per-job
+        # restarts overlap instead of serializing on the table.
         self._lock = threading.Lock()
+        # Jobs mid-spawn (duplicate-start guard for the lock-free spawn).
+        self._starting: set = set()
         self._monitor: Optional[threading.Thread] = None
         self._closed = threading.Event()
 
@@ -110,10 +115,17 @@ class MultiHostBackend(ClusterBackend):
     def start_job(self, spec: JobSpec, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
         with self._lock:
-            if spec.name in self._jobs:
+            if spec.name in self._jobs or spec.name in self._starting:
                 raise RuntimeError(f"job {spec.name!r} already running")
+            self._starting.add(spec.name)
             self._specs[spec.name] = spec
-            self._spawn_locked(spec, num_workers, placements)
+        try:
+            pset = self._spawn(spec, num_workers, placements)
+            with self._lock:
+                self._jobs[spec.name] = pset
+        finally:
+            with self._lock:
+                self._starting.discard(spec.name)
         self._ensure_monitor()
 
     def scale_job(self, name: str, num_workers: int,
@@ -132,8 +144,9 @@ class MultiHostBackend(ClusterBackend):
                 "backend.scale", component="backend",
                 attrs={"job": name, "chips": num_workers, "path": "restart"}):
             self._stop_set(name)
+            pset = self._spawn(spec, num_workers, placements)
             with self._lock:
-                self._spawn_locked(spec, num_workers, placements)
+                self._jobs[name] = pset
         self._ensure_monitor()
         return ResizePath.RESTART
 
@@ -200,10 +213,14 @@ class MultiHostBackend(ClusterBackend):
                 f"{sum(self.hosts.values())}")
         return out
 
-    def _spawn_locked(self, spec: JobSpec, num_chips: int,
-                      placements: Optional[List[Tuple[str, int]]]) -> None:
+    def _spawn(self, spec: JobSpec, num_chips: int,
+               placements: Optional[List[Tuple[str, int]]]) -> _ProcSet:
+        """Launch one process set. Runs WITHOUT the table lock (the
+        caller registers the returned _ProcSet) so concurrent wave
+        members' spawns overlap."""
         if placements is None or not placements:
-            placements = self._default_placements(num_chips)
+            with self._lock:
+                placements = self._default_placements(num_chips)
         total = sum(c for _, c in placements)
         if total != num_chips:
             raise ValueError(
@@ -229,7 +246,7 @@ class MultiHostBackend(ClusterBackend):
                 except Exception:  # noqa: BLE001 - best-effort
                     pass
             raise
-        self._jobs[spec.name] = _ProcSet(procs, num_chips, list(placements))
+        return _ProcSet(procs, num_chips, list(placements))
 
     def _spawn_procs(self, spec: JobSpec, num_chips: int,
                      placements: List[Tuple[str, int]], port: int,

@@ -17,13 +17,31 @@ from typing import Callable, List, Optional, Tuple
 
 
 class Clock:
-    """Real wall-clock."""
+    """Real wall-clock.
+
+    Timers (`call_at`/`call_later`) fire on daemon `threading.Timer`
+    threads, so a callback scheduled on the real clock runs even when no
+    service daemon is pumping — the scheduler uses this to re-arm a
+    resched that was requested mid-pass instead of silently waiting for
+    the next poll tick. Callbacks must therefore be thread-safe (every
+    scheduler entry point already is). Timers are fire-and-forget and
+    never cancelled; callees guard their own idempotence.
+    """
 
     def now(self) -> float:
         return time.time()
 
     def sleep(self, seconds: float) -> None:
         time.sleep(seconds)
+
+    def call_at(self, when: float, fn: Callable[[], None]) -> None:
+        """Run fn at wall time `when` (immediately if already past)."""
+        self.call_later(when - self.now(), fn)
+
+    def call_later(self, delay: float, fn: Callable[[], None]) -> None:
+        timer = threading.Timer(max(0.0, delay), fn)
+        timer.daemon = True
+        timer.start()
 
 
 class VirtualClock(Clock):

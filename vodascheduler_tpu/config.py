@@ -42,22 +42,25 @@ def _env_float(name: str, default: str) -> float:
 # ~free; checkpoint-restart resizes are not). The ONE source of truth for
 # the shipped values: Scheduler ctor defaults and ReplayHarness both read
 # these, so replay evidence and production policy cannot drift. Defaults
-# are the r6 sweep knee under TWO-TIER resize pricing
-# (doc/elastic-resize.md): cold restarts at their measured cost
-# (doc/resize_measured.json), same-host resizes at the in-place
-# fast-path cost — scripts/replay_sweep.py → doc/replay_sweep_r6.json.
-# Making reconfiguration cheaper moved the knee to a much faster rate
-# limit (45 s → 15 s — the scheduler can afford to act more often, the
-# compounding the reconfiguration-cost literature predicts) and a softer
-# hysteresis (2.0 → 1.5, since same-host grows now bypass suppression
-# entirely). The surface stays flat near the knee (top cells within
-# ~1 pt of utilization); the shipped values are the sweep's util-first/
-# avg+p95-tiebreak pick. Env overrides exist for operators re-tuning on
-# their own workload. (r5 history: 45 s / 2.0 / 120 s under
-# cold-only measured pricing, doc/replay_sweep_r5.json.)
-RATE_LIMIT_SECONDS = _env_float("VODA_RATE_LIMIT_SECONDS", "15")
-SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "1.5")
-RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "60")
+# are the r7 sweep knee under CRITICAL-PATH actuation pricing on top of
+# two-tier resize pricing (doc/elastic-resize.md): every replayed pass
+# now charges its slowest actuation wave member against the next
+# rate-limit window (the concurrent actuation plane's cost model —
+# earlier sweeps charged zero, letting replay reschedule infinitely
+# fast). Starts price at the spawn round trip (no backend blocks its
+# caller for the restore); resizes price at what genuinely blocks —
+# the in-place ack or the cold checkpoint drain. With resizes carrying
+# a real pass cost, the knee slowed from r6's 15 s to a 20 s rate limit
+# and hardened suppression (hysteresis 1.5 → 2.0, cooldown 60 → 300 s:
+# a marginal grow now costs the pass its drain, so fewer are worth it)
+# — scripts/replay_sweep.py → doc/replay_sweep_r7.json. Env overrides
+# exist for operators re-tuning on their own workload. (history: r6
+# 15 s / 1.5 / 60 s under zero-cost-pass two-tier pricing,
+# doc/replay_sweep_r6.json; r5 45 s / 2.0 / 120 s under cold-only
+# pricing, doc/replay_sweep_r5.json.)
+RATE_LIMIT_SECONDS = _env_float("VODA_RATE_LIMIT_SECONDS", "20")
+SCALE_OUT_HYSTERESIS = _env_float("VODA_SCALE_OUT_HYSTERESIS", "2.0")
+RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "300")
 
 # How long a preempted worker gets between SIGTERM and SIGKILL — it must
 # cover a full synchronous checkpoint save (the SIGTERM→save→PREEMPTED
@@ -68,6 +71,14 @@ RESIZE_COOLDOWN_SECONDS = _env_float("VODA_RESIZE_COOLDOWN_SECONDS", "60")
 # llama_350m's ~4.2 GB AdamW state needs ~300 s, i.e. this MUST be
 # raised on tunnel-attached or slow-NFS deployments.
 STOP_GRACE_SECONDS = _env_float("VODA_STOP_GRACE_SECONDS", "120")
+
+# Bound on the concurrent-actuation thread pool: how many backend calls
+# one rescheduling pass may have in flight at once (per wave — halts and
+# scale-ins release chips concurrently, then starts/scale-outs/migrations
+# claim them concurrently). The pass costs the slowest wave member (the
+# critical path), not the sum; the bound keeps a 100-job pass from
+# opening 100 sockets against one apiserver. 1 restores serial actuation.
+ACTUATION_WORKERS = int(_env_float("VODA_ACTUATION_WORKERS", "8"))
 
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the

@@ -57,6 +57,16 @@ class ReplayReport:
     # restarts_total; in-place ones never are).
     resizes_inplace_total: int = 0
     cold_resizes_total: int = 0
+    # Actuation pricing (the concurrent actuation plane): scheduler-busy
+    # seconds spent actuating passes at the parallel engine's cost (sum
+    # over passes of per-wave critical paths) vs what the pre-wave
+    # serial engine would have paid (sum of every backend call). The
+    # ratio is the modeled resched-latency win; the critical-path figure
+    # is also priced into the replay itself (each pass delays the next
+    # rate-limit window by its critical path — see Scheduler
+    # price_actuation).
+    actuation_critical_path_seconds: float = 0.0
+    actuation_serial_sum_seconds: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -161,7 +171,14 @@ class ReplayHarness:
                 config.RESIZE_COOLDOWN_SECONDS
                 if resize_cooldown_seconds is None
                 else resize_cooldown_seconds),
-            tracer=self.tracer)
+            tracer=self.tracer,
+            # A live pass occupies real time while its actuation waves
+            # run; under the VirtualClock it would occupy none, letting
+            # replay reschedule infinitely fast. price_actuation charges
+            # each pass its critical-path actuation seconds (per-wave
+            # max — what the parallel engine pays; the pre-wave serial
+            # engine paid the sum) against the next rate-limit window.
+            price_actuation=True)
         self.admission = AdmissionService(self.store, self.bus, self.clock)
         self.collector = MetricsCollector(
             self.store, BackendRowSource(self.backend), self.clock,
@@ -334,4 +351,8 @@ class ReplayHarness:
             rescheds_total=self.scheduler.m_resched_total.value(),
             resizes_inplace_total=self.backend.resizes_inplace_total,
             cold_resizes_total=self.backend.cold_resizes_total,
+            actuation_critical_path_seconds=round(
+                self.scheduler.actuation_critical_path_seconds_total, 1),
+            actuation_serial_sum_seconds=round(
+                self.scheduler.actuation_serial_sum_seconds_total, 1),
         )

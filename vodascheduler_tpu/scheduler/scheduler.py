@@ -73,6 +73,48 @@ DEFAULT_SCALE_OUT_HYSTERESIS = config.SCALE_OUT_HYSTERESIS
 DEFAULT_RESIZE_COOLDOWN_SECONDS = config.RESIZE_COOLDOWN_SECONDS
 
 
+class _OwnedRLock:
+    """RLock that knows whether the calling thread owns it.
+
+    The concurrent actuation engine needs this introspection: a resched
+    pass launched from a frame that already holds the scheduler lock
+    (a VirtualClock event handler running the pass inline) must actuate
+    on its own thread — parallel workers would deadlock waiting for a
+    lock the pass thread's outer frames hold until the pass returns.
+    `held_by_me()` is what lets `_run_wave` pick safely.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._owner = threading.get_ident()
+            self._count += 1
+        return ok
+
+    def release(self) -> None:
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+        self._lock.release()
+
+    def __enter__(self) -> "_OwnedRLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def held_by_me(self) -> bool:
+        # Benign race: a stale read of another thread's ident can never
+        # equal ours, and our own writes happen on this thread.
+        return self._owner == threading.get_ident() and self._count > 0
+
+
 class Scheduler:
     def __init__(
         self,
@@ -92,6 +134,9 @@ class Scheduler:
         resize_cooldown_seconds: float = DEFAULT_RESIZE_COOLDOWN_SECONDS,
         defrag_cross_host_threshold: int = 0,
         tracer: Optional[obs_tracer.Tracer] = None,
+        actuation_workers: Optional[int] = None,
+        actuation_parallel: Optional[bool] = None,
+        price_actuation: bool = False,
     ):
         self.pool_id = pool_id
         self.backend = backend
@@ -141,6 +186,56 @@ class Scheduler:
         self._resched_pending = False
         self._in_resched = False
         self._stopped = False
+        # --- concurrent actuation plane (doc/observability.md,
+        # "Scheduler concurrency model") ---
+        # Bound on in-flight backend calls per wave.
+        self.actuation_workers = max(1, int(
+            config.ACTUATION_WORKERS if actuation_workers is None
+            else actuation_workers))
+        # Whether waves may fan out on a thread pool. Default: parallel on
+        # the wall clock (production), serial under a VirtualClock —
+        # replay determinism requires span/record creation in a fixed
+        # order, and simulated backend calls return instantly anyway.
+        # Either way a pass whose thread already holds the scheduler lock
+        # (inline VirtualClock trigger under an event handler) actuates
+        # serially — see _OwnedRLock.
+        self.actuation_parallel = (
+            not isinstance(clock, VirtualClock)
+            if actuation_parallel is None else bool(actuation_parallel))
+        # Replay-mode pricing: treat each pass's critical-path actuation
+        # seconds as scheduler-busy time when opening the next rate-limit
+        # window. Under a VirtualClock the pass consumes zero simulated
+        # time, which would let replay schedule infinitely fast compared
+        # to a live control plane; the harness sets this so replay prices
+        # a pass at max-per-wave (what the parallel engine pays), not the
+        # serial sum (what the pre-wave engine paid) nor zero.
+        self.price_actuation = price_actuation
+        # Monotonic pass counter. The actuation window below carries the
+        # running pass's generation (0 = no pass actuating): job/cluster
+        # events arriving while it is set are queued and replayed at the
+        # commit point instead of interleaving with half-applied state,
+        # and a commit may only close the window IT opened — a stale
+        # commit frame can never clear a newer pass's deferral window.
+        self._pass_generation = 0
+        self._actuating_gen = 0
+        self._deferred_events: List[tuple] = []
+        # Backend stops queued by a delete while the lock was held; every
+        # mutator entry point drains them outside the lock, before its
+        # triggers (see _drain_pending_stops). While a stop is draining
+        # (checkpoint flush, up to stop_grace_seconds), the dying job's
+        # chips stay RESERVED via _stops_in_flight — a pass triggered by
+        # an unrelated event mid-drain must not grant chips the backend
+        # still holds (the old engine got this by holding the lock
+        # across the stop; the reservation keeps the invariant without
+        # re-freezing readers).
+        self._pending_stops: List[Tuple[str, int]] = []
+        self._stops_in_flight: Dict[str, int] = {}
+        # Per-pass priced actuation (sum of per-wave critical paths) and
+        # the cumulative totals the replay report exposes.
+        self._last_pass_priced_seconds = 0.0
+        self.actuation_critical_path_seconds_total = 0.0
+        self.actuation_serial_sum_seconds_total = 0.0
+        self._pass_wave_stats: List[dict] = []
         # Decision-audit plane (doc/observability.md): every resched pass
         # emits one machine-readable record (trigger, queue snapshot,
         # per-job delta reasons) through the tracer, retained here for
@@ -156,11 +251,14 @@ class Scheduler:
         # Per-pass scratch: job -> reason codes, job -> resize seconds.
         self._pass_reasons: Dict[str, List[str]] = {}
         self._pass_resize_seconds: Dict[str, float] = {}
-        # Serializes all entry points (reference: SchedulerLock,
-        # scheduler.go:88-89). Event-bus and backend callbacks arrive on the
-        # publisher's thread in real-time mode; reentrant because handlers
-        # trigger rescheds inline.
-        self._lock = threading.RLock()
+        # Serializes state mutation (reference: SchedulerLock,
+        # scheduler.go:88-89) — but NOT backend calls: a pass decides
+        # under the lock, releases it for the actuation waves, and
+        # re-acquires it per bookkeeping step, so REST reads, job events,
+        # and metric updates never wait out a slow backend. Reentrant
+        # (handlers nest), with owner introspection for the wave engine's
+        # serial fallback.
+        self._lock = _OwnedRLock()
 
         self._init_metrics(registry or Registry())
 
@@ -240,6 +338,20 @@ class Scheduler:
             buckets=(0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0,
                      300.0, 600.0),
             const_labels=pool_l)
+        # One observation per non-empty actuation wave: the wave's wall
+        # time — with parallel actuation this is the critical path (the
+        # slowest member), not the per-job sum. wave="release" covers
+        # halts + scale-ins; wave="claim" covers starts + scale-outs +
+        # migrations.
+        self.h_actuation = registry.histogram(
+            "voda_scheduler_actuation_seconds",
+            "Wall time of one actuation wave (release = halts+scale-ins, "
+            "claim = starts+scale-outs+migrations); parallel waves make "
+            "this the critical path, not the sum",
+            labels=("wave",),
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0,
+                     120.0, 300.0, 600.0),
+            const_labels=pool_l)
         registry.gauge("voda_scheduler_ready_jobs",
                        "Jobs in the ready queue",
                        fn=lambda: float(len(self.ready_jobs)),
@@ -273,80 +385,151 @@ class Scheduler:
         self._stopped = True
 
     # ---- event intake ----------------------------------------------------
+    #
+    # The decide/actuate split's intake contract: every handler mutates
+    # state under the lock but fires trigger_resched AFTER releasing it
+    # (an inline VirtualClock pass launched while an outer frame holds
+    # the lock would force the wave engine's serial fallback), and any
+    # event arriving while a pass is mid-actuation is deferred to the
+    # pass's commit point — the alternative is a JOB_COMPLETED popping
+    # bookkeeping that a wave worker is concurrently writing.
+
+    def _locked_or_deferred(self, fn, *args) -> List[str]:
+        """Run a _*_locked mutator under the lock, unless an actuation is
+        in flight — then defer it (with its args) to the commit point.
+        Returns the trigger reasons to fire once the lock is released."""
+        with self._lock:
+            if self._actuating_gen:
+                self._deferred_events.append((fn, args))
+                return []
+            reasons = fn(*args)
+        # Side effects the mutator queued for after the lock (a deleted
+        # job's backend stop) run before its triggers fire.
+        self._drain_pending_stops()
+        return reasons
+
+    def _fire(self, reasons: List[str]) -> None:
+        for reason in reasons:
+            self.trigger_resched(reason)
 
     def _on_job_event(self, event: JobEvent) -> None:
         """Reference: readMsgs goroutine (scheduler.go:829-843)."""
-        with self._lock:
-            if event.verb == EventVerb.CREATE:
-                self.create_training_job(event.job_name)
-            elif event.verb == EventVerb.DELETE:
-                self.delete_training_job(event.job_name)
+        if event.verb == EventVerb.CREATE:
+            self.create_training_job(event.job_name)
+        elif event.verb == EventVerb.DELETE:
+            self.delete_training_job(event.job_name)
 
     def _on_cluster_event(self, event: ClusterEvent) -> None:
         """Reference: MPIJob + node informer handlers (scheduler.go:592-747)."""
-        with self._lock:
-            if event.kind == ClusterEventKind.JOB_COMPLETED:
-                self.handle_job_completed(event.name)
-            elif event.kind == ClusterEventKind.JOB_FAILED:
-                self.handle_job_failed(event.name)
-            elif event.kind == ClusterEventKind.HOST_ADDED:
-                self._on_host_added(event.name)
-            elif event.kind == ClusterEventKind.HOST_REMOVED:
-                self._on_host_removed(event.name)
+        if event.kind == ClusterEventKind.JOB_COMPLETED:
+            self.handle_job_completed(event.name)
+        elif event.kind == ClusterEventKind.JOB_FAILED:
+            self.handle_job_failed(event.name)
+        elif event.kind == ClusterEventKind.HOST_ADDED:
+            self._fire(self._locked_or_deferred(self._on_host_added, event.name))
+        elif event.kind == ClusterEventKind.HOST_REMOVED:
+            self._fire(self._locked_or_deferred(self._on_host_removed,
+                                                event.name))
 
     # ---- job lifecycle ---------------------------------------------------
 
     def create_training_job(self, name: str) -> None:
         """Accept a job announced by the admission service
         (reference: scheduler.go:845-890)."""
+        self._fire(self._locked_or_deferred(self._create_job_locked, name))
+
+    def _create_job_locked(self, name: str) -> List[str]:
         job = self.store.get_job(name)
         if job is None:
             log.error("create event for unknown job %s", name)
-            return
+            return []
         job.status = JobStatus.WAITING
         job.metrics.last_update_time = self.clock.now()
         self.store.update_job(job)
         self.ready_jobs[name] = job
         self.job_num_chips[name] = 0
         self.m_jobs_created.inc()
-        self.trigger_resched("job_created")
+        return ["job_created"]
 
     def delete_training_job(self, name: str) -> None:
         """User-initiated cancel (reference: scheduler.go:916-1000)."""
+        self._fire(self._locked_or_deferred(self._delete_job_locked, name))
+
+    def _delete_job_locked(self, name: str) -> List[str]:
         job = self.ready_jobs.pop(name, None)
         if job is None:
-            return
-        if self.job_num_chips.get(name, 0) > 0:
-            self.backend.stop_job(name)
-        self.job_num_chips.pop(name, None)
+            return []
+        chips = self.job_num_chips.pop(name, 0)
         job.status = JobStatus.CANCELED
         job.finish_time = self.clock.now()
         self.store.update_job(job)
         self.done_jobs[name] = job
         self.m_jobs_deleted.inc()
-        self.trigger_resched("job_deleted")
+        if chips > 0:
+            # The backend stop can block for a full checkpoint drain
+            # (stop_grace_seconds) — it must NOT run under the scheduler
+            # lock this method holds. Queue it with the dying size;
+            # every caller drains the queue right after releasing the
+            # lock and BEFORE firing the resched trigger
+            # (_drain_pending_stops). The reservation is registered HERE,
+            # under the same lock hold that released the booking — were
+            # it registered at drain time, a pass sneaking between the
+            # lock release and the drain would see the chips as free.
+            self._pending_stops.append((name, chips))
+            self._stops_in_flight[name] = chips
+        return ["job_deleted"]
+
+    def _drain_pending_stops(self) -> None:
+        """Execute backend stops queued by _delete_job_locked — outside
+        the scheduler lock (they can block for a checkpoint drain), but
+        before the delete's trigger fires, so the freed chips are truly
+        free by the time a pass re-grants them. Passes triggered by
+        UNRELATED events while a drain is blocking see the dying jobs in
+        _stops_in_flight: their chips stay off the allocator's budget
+        and their host slots stay held (see _resched_pass) until the
+        backend actually released them."""
+        with self._lock:
+            stops, self._pending_stops = self._pending_stops, []
+        for name, _chips in stops:
+            try:
+                self.backend.stop_job(name)
+            except Exception:
+                # Best-effort: the backend monitor reaps stragglers, and
+                # the job is already CANCELED in every table.
+                log.exception("stop of deleted job %r failed", name)
+            finally:
+                with self._lock:
+                    self._stops_in_flight.pop(name, None)
 
     def handle_job_completed(self, name: str) -> None:
         """Reference: handleJobCompleted (scheduler.go:630-650)."""
-        job = self.ready_jobs.get(name)
-        if job is None or job.status == JobStatus.COMPLETED:
-            return
-        self.update_time_metrics()  # final accounting before terminal state
-        job.status = JobStatus.COMPLETED
-        self._job_done(job)
-        self.m_jobs_completed.inc()
-        self.trigger_resched("job_completed")
+        self._fire(self._locked_or_deferred(self._job_terminal_locked, name,
+                                            JobStatus.COMPLETED))
 
     def handle_job_failed(self, name: str) -> None:
         """Reference: handleJobFailed (scheduler.go:652-671)."""
+        self._fire(self._locked_or_deferred(self._job_terminal_locked, name,
+                                            JobStatus.FAILED))
+
+    def _job_terminal_locked(self, name: str,
+                             status: JobStatus) -> List[str]:
         job = self.ready_jobs.get(name)
-        if job is None or job.status == JobStatus.FAILED:
-            return
-        self.update_time_metrics()
-        job.status = JobStatus.FAILED
+        if job is None or job.status == status:
+            return []
+        reasons = []
+        # Final accounting before the terminal state; a Tiresias flip
+        # here rides the same pass as the completion.
+        if self._update_time_metrics_locked():
+            reasons.append("priority_change")
+        job.status = status
         self._job_done(job)
-        self.m_jobs_failed.inc()
-        self.trigger_resched("job_failed")
+        if status == JobStatus.COMPLETED:
+            self.m_jobs_completed.inc()
+            reasons.append("job_completed")
+        else:
+            self.m_jobs_failed.inc()
+            reasons.append("job_failed")
+        return reasons
 
     def _job_done(self, job: TrainingJob) -> None:
         """Reference: handleJobDoneInternal (scheduler.go:673-686)."""
@@ -358,16 +541,16 @@ class Scheduler:
 
     # ---- host churn (reference: addNode/updateNode/deleteNode :689-747) --
 
-    def _on_host_added(self, name: str) -> None:
+    def _on_host_added(self, name: str) -> List[str]:
         # Recompute rather than increment: a re-announced host (capacity
         # update) must not double-count.
         self.total_chips = sum(self.backend.list_hosts().values())
         if self.placement_manager is not None:
             chips = self.backend.list_hosts().get(name, 0)
             self.placement_manager.add_host(name, chips)
-        self.trigger_resched("host_added")
+        return ["host_added"]
 
-    def _on_host_removed(self, name: str) -> None:
+    def _on_host_removed(self, name: str) -> List[str]:
         # The backend no longer lists the host; recompute capacity.
         self.total_chips = sum(self.backend.list_hosts().values())
         if self.placement_manager is not None:
@@ -375,7 +558,7 @@ class Scheduler:
             # Jobs that lost workers need re-placement even if the next
             # allocation leaves their chip count unchanged.
             self._placement_dirty = True
-        self.trigger_resched("host_removed")
+        return ["host_removed"]
 
     # ---- rescheduling (reference: Run select loop + resched :271-434) ----
 
@@ -384,7 +567,15 @@ class Scheduler:
         (reference: TriggerResched + the Run loop's drop-and-block logic,
         scheduler.go:297-316). `reason` (an obs.audit.TRIGGERS code) is
         recorded in the pass's decision-audit record; reasons arriving
-        while a resched is already pending coalesce into that pass."""
+        while a resched is already pending coalesce into that pass.
+
+        A due trigger runs the pass inline on the calling thread; a
+        rate-limited one arms a clock timer for the window's opening —
+        on BOTH clock types (the real clock grew timers for exactly
+        this), so a blocked trigger never silently waits out a daemon
+        poll tick. The service daemon's pump() remains as a belt-and-
+        braces driver; _run_resched_now is idempotent under the race."""
+        run_now = False
         with self._lock:
             if reason not in self._pending_triggers:
                 self._pending_triggers.append(reason)
@@ -392,14 +583,37 @@ class Scheduler:
                 return
             self._resched_pending = True
             if self._in_resched:
-                return  # _run_resched_now reschedules after the current pass
+                return  # the pass's commit point re-arms
             now = self.clock.now()
             at = max(now, self.resched_blocked_until)
             if at <= now:
-                self._run_resched_now()
-            elif isinstance(self.clock, VirtualClock):
-                self.clock.call_at(at, self._run_resched_now)
-            # Real-time mode: service daemon polls resched_pending.
+                run_now = True
+            else:
+                self.clock.call_at(at, self._run_when_window_opens)
+        if run_now:
+            # Outside the trigger's own lock hold: the pass manages its
+            # own locking (decide under, actuate outside).
+            self._run_resched_now()
+
+    def _run_when_window_opens(self) -> None:
+        """Timer target for a pending pass: run it if the rate-limit
+        window is open, else re-arm for the window's (possibly moved)
+        opening. The window can shift AFTER a timer was armed — a pass
+        commit rewrites resched_blocked_until from the time actuation
+        finished, and a retry extends it — so firing _run_resched_now
+        directly would run inside the closed window the limit exists to
+        protect (apiserver churn bounds)."""
+        with self._lock:
+            if (not self._resched_pending or self._stopped
+                    or self._in_resched):
+                return  # commit re-arms if still pending
+            rearm_at = (self.resched_blocked_until
+                        if self.clock.now() < self.resched_blocked_until
+                        else None)
+        if rearm_at is not None:
+            self.clock.call_at(rearm_at, self._run_when_window_opens)
+            return
+        self._run_resched_now()
 
     @property
     def resched_pending(self) -> bool:
@@ -435,23 +649,49 @@ class Scheduler:
 
     def _run_resched_now(self) -> None:
         with self._lock:
-            if not self._resched_pending or self._stopped:
+            if (not self._resched_pending or self._stopped
+                    or self._in_resched):
                 return
             self._resched_pending = False
             self._in_resched = True
-            try:
-                self.resched()
-            finally:
+            self._pass_generation += 1
+            gen = self._pass_generation
+            self._actuating_gen = gen
+        try:
+            self.resched()
+        finally:
+            with self._lock:
+                if self._actuating_gen == gen:
+                    self._actuating_gen = 0
                 self._in_resched = False
-            now = self.clock.now()
-            self.last_resched = now
-            self.resched_blocked_until = now + self.rate_limit_seconds
-            if self._resched_pending:
-                # Re-triggered mid-pass (e.g. a Tiresias priority flip): run
-                # again once the rate-limit window opens.
-                if isinstance(self.clock, VirtualClock):
-                    self.clock.call_at(self.resched_blocked_until,
-                                       self._run_resched_now)
+                now = self.clock.now()
+                self.last_resched = now
+                # Replay pricing: the pass occupied its critical-path
+                # actuation seconds of scheduler time (zero simulated
+                # time passed while it ran), so the rate-limit window
+                # opens that much later — see price_actuation.
+                priced = (self._last_pass_priced_seconds
+                          if self.price_actuation else 0.0)
+                self.resched_blocked_until = (now + priced
+                                              + self.rate_limit_seconds)
+                rearm_at = (self.resched_blocked_until
+                            if self._resched_pending else None)
+                deferred, self._deferred_events = self._deferred_events, []
+            # Commit point: replay events that arrived mid-actuation, in
+            # arrival order, against the now-consistent state. Their
+            # triggers land inside the just-opened rate-limit window and
+            # coalesce into the next pass.
+            for fn, args in deferred:
+                with self._lock:
+                    reasons = fn(*args)
+                self._drain_pending_stops()
+                self._fire(reasons)
+            if rearm_at is not None:
+                # Re-triggered mid-pass (a Tiresias priority flip, a
+                # wave worker's retry): run again once the window opens —
+                # on either clock (the real-clock timer is what closes
+                # the old wait-for-the-next-poll-tick gap).
+                self.clock.call_at(rearm_at, self._run_when_window_opens)
 
     def resched(self) -> None:
         """One rescheduling pass (reference: resched, scheduler.go:326-364),
@@ -460,18 +700,31 @@ class Scheduler:
         backend, supervisor control channel) parents onto it via the
         ambient context — plus one schema-validated audit record capturing
         the trigger set, the queue snapshot, and a reason code for every
-        per-job chip delta."""
+        per-job chip delta.
+
+        Concurrency model (doc/observability.md "Scheduler concurrency
+        model"): the pass DECIDES under the scheduler lock — allocation,
+        hysteresis, diff, placement, and the booking commit of
+        job_num_chips — then releases the lock and ACTUATES the decision
+        in two bounded-parallel waves (release, then barrier, then
+        claim), re-acquiring the lock only for per-job bookkeeping. The
+        pass therefore costs the slowest wave member (the critical
+        path), not the sum of K backend calls, and readers
+        (status_table, REST, metric ticks) never wait out a backend."""
         import time as _walltime
 
         with self._lock:
             triggers = [t for t in self._pending_triggers
                         if t in obs_audit.TRIGGERS] or ["manual"]
             self._pending_triggers = []
-        self._pass_reasons = {}
-        self._pass_resize_seconds = {}
+            self._pass_reasons = {}
+            self._pass_resize_seconds = {}
+            self._last_pass_priced_seconds = 0.0
+            self._pass_wave_stats = []
         t_start = _walltime.monotonic()
         self.update_time_metrics()
-        old = dict(self.job_num_chips)
+        with self._lock:
+            old = dict(self.job_num_chips)
         outcome = "error"
         with self.tracer.span(
                 "resched", component="scheduler", new_trace=True,
@@ -482,6 +735,12 @@ class Scheduler:
             finally:
                 duration = _walltime.monotonic() - t_start
                 sp.set_attr("outcome", outcome)
+                sp.set_attr("actuation_mode",
+                            "parallel" if self.actuation_parallel
+                            else "serial")
+                sp.set_attr("actuation_workers", self.actuation_workers)
+                sp.set_attr("actuation_critical_path_s",
+                            round(self._last_pass_priced_seconds, 4))
                 self.h_resched_latency.observe(duration)
                 self._emit_audit(sp, triggers, old, duration, outcome)
 
@@ -490,124 +749,256 @@ class Scheduler:
         'allocation_failed', or 'reverted_release_failure')."""
         import time as _walltime
 
-        jobs = list(self.ready_jobs.values())
-        t_alloc = _walltime.monotonic()
-        try:
-            new = self.allocator.allocate(AllocationRequest(
-                scheduler_id=self.pool_id,
-                num_chips=self.total_chips,
-                algorithm=self.algorithm,
-                ready_jobs=jobs,
-                # Slice-shape feasibility: with a modeled torus, grants are
-                # rounded to counts that admit a contiguous sub-slice
-                # (SURVEY.md §7 allocation-unit delta).
-                topology=(self.placement_manager.topology
-                          if self.placement_manager is not None else None),
-            ))
-        except Exception:
-            log.exception("allocation failed; retrying after rate limit")
-            self._schedule_retry()
-            return "allocation_failed"
-        self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
+        # ---- decide (under the lock) ---------------------------------
+        with self._lock:
+            jobs = list(self.ready_jobs.values())
+            # Chips of deleted jobs whose checkpoint drain is still
+            # blocking in _drain_pending_stops: physically occupied, so
+            # off this pass's budget (and their host slots stay held
+            # below). The drain's own trigger re-runs allocation once
+            # the backend has truly released them.
+            reserved = dict(self._stops_in_flight)
+            t_alloc = _walltime.monotonic()
+            try:
+                new = self.allocator.allocate(AllocationRequest(
+                    scheduler_id=self.pool_id,
+                    num_chips=max(0, self.total_chips
+                                  - sum(reserved.values())),
+                    algorithm=self.algorithm,
+                    ready_jobs=jobs,
+                    # Slice-shape feasibility: with a modeled torus,
+                    # grants are rounded to counts that admit a
+                    # contiguous sub-slice (SURVEY.md §7).
+                    topology=(self.placement_manager.topology
+                              if self.placement_manager is not None
+                              else None),
+                ))
+            except Exception:
+                log.exception("allocation failed; retrying after rate limit")
+                self._schedule_retry()
+                return "allocation_failed"
+            self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
 
-        if self.scale_out_hysteresis > 1.0:
-            self._apply_hysteresis(old, new)
-        self.job_num_chips = new
-        halts, scale_ins, scale_outs, starts = self.compare_results(old)
-        changed = bool(halts or scale_ins or scale_outs or starts)
-        for job in starts:
-            self._add_reason(job, "started")
-        for job in halts:
-            self._add_reason(job, "halted")
-        for job in scale_ins:
-            self._add_reason(job, "scale_in")
-        for job in scale_outs:
-            self._add_reason(job, "scale_out")
+            if self.scale_out_hysteresis > 1.0:
+                self._apply_hysteresis(old, new)
+            self.job_num_chips = new
+            halts, scale_ins, scale_outs, starts = self.compare_results(old)
+            changed = bool(halts or scale_ins or scale_outs or starts)
+            for job in starts:
+                self._add_reason(job, "started")
+            for job in halts:
+                self._add_reason(job, "halted")
+            for job in scale_ins:
+                self._add_reason(job, "scale_in")
+            for job in scale_outs:
+                self._add_reason(job, "scale_out")
+            # Per-job shrink targets, snapshotted now: the wave-1 barrier
+            # compares bookkeeping against these to detect shrinks the
+            # backend didn't realize.
+            scale_in_targets = {j: self.job_num_chips.get(j, 0)
+                                for j in scale_ins}
 
-        # Unlike the reference (which places *after* the MPI-Operator
-        # creates pods, steering them via tolerations and deleting movers,
-        # §3.3), we own the runtime: compute host bindings first and hand
-        # them to the backend with each start/scale.
-        placements: Dict[str, List[Tuple[str, int]]] = {}
-        placed = False
-        if (changed or self._placement_dirty) and self.placement_manager is not None:
-            requests = {j: n for j, n in self.job_num_chips.items() if n > 0}
-            if (self.defrag_cross_host_threshold > 0
-                    and self._last_cross_host >= self.defrag_cross_host_threshold):
-                decision = self.placement_manager.defragment(requests)
-            else:
-                decision = self.placement_manager.place(requests)
-            self._last_cross_host = decision.num_jobs_cross_host
-            placements = decision.placements
-            placed = True
-            self._placement_dirty = False
+            # Unlike the reference (which places *after* the MPI-Operator
+            # creates pods, steering them via tolerations and deleting
+            # movers, §3.3), we own the runtime: compute host bindings
+            # first and hand them to the backend with each start/scale.
+            placements: Dict[str, List[Tuple[str, int]]] = {}
+            placed = False
+            if ((changed or self._placement_dirty)
+                    and self.placement_manager is not None):
+                requests = {j: n for j, n in self.job_num_chips.items()
+                            if n > 0}
+                # Draining deletions keep their host slots until the
+                # backend released them (phantom same-size requests:
+                # _release_slots leaves an unchanged request alone).
+                requests.update(reserved)
+                if (self.defrag_cross_host_threshold > 0
+                        and self._last_cross_host
+                        >= self.defrag_cross_host_threshold):
+                    decision = self.placement_manager.defragment(requests)
+                else:
+                    decision = self.placement_manager.place(requests)
+                self._last_cross_host = decision.num_jobs_cross_host
+                placements = decision.placements
+                placed = True
+                self._placement_dirty = False
 
-        # Halts and scale-ins release chips before starts/scale-outs claim
-        # them (reference: applySchedulerResults order, scheduler.go:434-445).
+        # ---- actuate (lock released; re-acquired per bookkeeping) ----
+        # Wave 1 — release: halts and scale-ins free chips concurrently.
         # Each apply is isolated: a backend failure (API storm during pod
-        # creation) must not abort the rest of the pass, and — critically —
-        # must not leave job_num_chips claiming an allocation the backend
-        # never realized, or the diff would never emit the start again and
-        # the job would strand as phantom-running (found live in r5: a
-        # single 503 during start_job stranded the job permanently).
-        release_failed = False
-        for job in halts:
+        # creation) must not abort the rest of the pass, and — critically
+        # — must not leave job_num_chips claiming an allocation the
+        # backend never realized, or the diff would never emit the start
+        # again and the job would strand as phantom-running (found live
+        # in r5: a single 503 during start_job stranded the job
+        # permanently). Failures are gathered at the wave barrier and
+        # feed the release-failure revert below.
+        halt_failures: List[str] = []
+
+        def _halt_task(job: str) -> None:
             try:
                 self._halt_job(job)
             except Exception:
                 log.exception("halt of %r failed; keeping its allocation "
                               "booked so the halt is retried", job)
-                self._add_reason(job, "halt_failed")
-                self.job_num_chips[job] = old.get(job, 0)
-                release_failed = True
-        applied_scale_ins = set()
-        if not release_failed:
-            for job in scale_ins:
-                before = self.job_num_chips.get(job, 0)
-                self._apply_scale(job, placements.get(job), old.get(job, 0))
-                applied_scale_ins.add(job)
-                if self.job_num_chips.get(job, 0) > before:
-                    # The shrink didn't happen (failure handler re-booked
-                    # the old/live size): its chips were never freed.
-                    release_failed = True
-                    break
+                with self._lock:
+                    self._add_reason(job, "halt_failed")
+                    self.job_num_chips[job] = old.get(job, 0)
+                    halt_failures.append(job)
+
+        wave1 = ([(job, (lambda j=job: _halt_task(j))) for job in halts]
+                 + [(job, (lambda j=job: self._apply_scale(
+                     j, placements.get(j), old.get(j, 0))))
+                    for job in scale_ins])
+        self._run_wave("release", wave1)
+
+        with self._lock:
+            release_failed = bool(halt_failures) or any(
+                self.job_num_chips.get(j, 0) > target
+                for j, target in scale_in_targets.items())
         if release_failed:
             # The rest of this pass was computed assuming the released
             # chips are free — applying it would double-book their hosts
             # (starts pinned onto still-occupied nodes). Revert every
-            # UNAPPLIED booking (applied scale-ins already book backend
-            # truth) and leave the pass to the retry, which recomputes
-            # from consistent state.
-            unapplied = [j for j in scale_ins if j not in applied_scale_ins]
-            for job in unapplied + scale_outs + starts:
-                self.job_num_chips[job] = old.get(job, 0)
-                self._add_reason(job, "reverted_release_failure")
-            self._placement_dirty = True
+            # UNAPPLIED booking (wave-1 members already book backend
+            # truth through their failure isolation) and leave the pass
+            # to the retry, which recomputes from consistent state.
+            with self._lock:
+                for job in scale_outs + starts:
+                    self.job_num_chips[job] = old.get(job, 0)
+                    self._add_reason(job, "reverted_release_failure")
+                self._placement_dirty = True
             self._schedule_retry()
             self.store.flush()
             self.m_resched_total.inc()
             self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
             return "reverted_release_failure"
-        for job in starts:
-            self._apply_start(job, placements.get(job))
-        for job in scale_outs:
-            self._apply_scale(job, placements.get(job), old.get(job, 0))
+
+        # Wave 2 — claim: starts and scale-outs run concurrently; then
+        # migrations as a trailing sub-wave (concurrent among
+        # themselves), because candidates are diffed against the
+        # backend's live view and that view must already include this
+        # pass's starts and scales. The job sets are disjoint (a
+        # migration candidate is by construction untouched by the diff),
+        # so per-job isolation carries over from the serial engine
+        # unchanged.
+        wave2 = ([(job, (lambda j=job: self._apply_start(
+            j, placements.get(j)))) for job in starts]
+            + [(job, (lambda j=job: self._apply_scale(
+                j, placements.get(j), old.get(j, 0))))
+               for job in scale_outs])
+        self._run_wave("claim", wave2)
         if placed:
-            self._migrate_moved_jobs(
-                placements, set(halts) | set(starts) | set(scale_ins) | set(scale_outs))
+            # Reserved (draining) jobs are never migration candidates —
+            # they are mid-teardown, not mis-placed.
+            touched = (set(halts) | set(starts) | set(scale_ins)
+                       | set(scale_outs) | set(reserved))
+            self._run_wave("migrate",
+                           self._migration_tasks(placements, touched))
 
         self.store.flush()  # batch boundary for autoflush=False stores
         self.m_resched_total.inc()
         self.m_resched_seconds.observe(_walltime.monotonic() - t_start)
         return "applied"
 
-    def _migrate_moved_jobs(self, placements: Dict[str, List[Tuple[str, int]]],
-                            already_restarted: set) -> None:
-        """Restart same-size jobs whose host binding no longer matches what
-        the backend is running — including jobs whose workers died with a
-        removed host (those produce no index-level move in the placement
-        diff, so the backend's live view is the ground truth to compare)."""
+    def _run_wave(self, label: str, tasks: List[Tuple[str, object]]) -> None:
+        """Run one actuation wave: every task is a backend-facing apply
+        for a distinct job. Parallel on a bounded ThreadPoolExecutor when
+        allowed (see actuation_parallel), serial otherwise — including
+        whenever the calling thread still holds the scheduler lock from
+        an outer frame, where parallel workers would deadlock on their
+        bookkeeping acquisitions.
+
+        The wave barrier is the `with` executor join: the pass never
+        proceeds with a wave still in flight. Tracer context is
+        propagated explicitly into workers (the ambient context is
+        thread-local; without this, job.*/backend.* spans would orphan).
+
+        Pricing: each task is priced at the backend's modeled cost when
+        it offers one (FakeClusterBackend under replay, where wall time
+        is meaningless) else its measured wall time; the wave contributes
+        its MAX (critical path) to the pass price and its SUM to the
+        serial-equivalent counter, so replay and metrics can report the
+        speedup honestly."""
+        import time as _walltime
+
+        if not tasks:
+            return
+        parent = obs_tracer.current_context()
+
+        def _run_one(job: str, fn) -> Tuple[str, float]:
+            t0 = _walltime.monotonic()
+            with obs_tracer.use_context(parent, self.tracer):
+                fn()
+            measured = _walltime.monotonic() - t0
+            price = None
+            try:
+                price = self.backend.actuation_price_seconds(job)
+            except Exception:  # noqa: BLE001 - a hint, never load-bearing
+                price = None
+            return job, (measured if price is None else price)
+
+        t0 = _walltime.monotonic()
+        priced: Dict[str, float] = {}
+        parallel = (self.actuation_parallel and len(tasks) > 1
+                    and self.actuation_workers > 1
+                    and not self._lock.held_by_me())
+        if parallel:
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = min(self.actuation_workers, len(tasks))
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix=f"voda-actuate-{label}") as pool:
+                futures = [pool.submit(_run_one, job, fn)
+                           for job, fn in tasks]
+                for fut in futures:
+                    job, seconds = fut.result()
+                    priced[job] = seconds
+        else:
+            for job, fn in tasks:
+                job, seconds = _run_one(job, fn)
+                priced[job] = seconds
+        wall = _walltime.monotonic() - t0
+        self.h_actuation.observe(wall, wave=label)
+        critical_path = self._wave_critical_path(list(priced.values()))
+        serial_sum = sum(priced.values())
+        with self._lock:
+            self._last_pass_priced_seconds += critical_path
+            self.actuation_critical_path_seconds_total += critical_path
+            self.actuation_serial_sum_seconds_total += serial_sum
+            self._pass_wave_stats.append({
+                "wave": label, "jobs": len(tasks),
+                "parallel": parallel,
+                "wall_s": round(wall, 4),
+                "critical_path_s": round(critical_path, 4),
+                "serial_sum_s": round(serial_sum, 4),
+            })
+
+    def _wave_critical_path(self, costs: List[float]) -> float:
+        """The wave's priced duration under the BOUNDED pool: a greedy
+        longest-first schedule of the per-task costs onto
+        actuation_workers bins — a wave of K tasks over W workers costs
+        ~ceil(K/W) rounds, not max(costs). (max() would understate any
+        wave wider than the pool; the plain sum is what the pre-wave
+        serial engine paid.)"""
+        if not costs:
+            return 0.0
+        bins = [0.0] * min(self.actuation_workers, len(costs))
+        for cost in sorted(costs, reverse=True):
+            index = min(range(len(bins)), key=bins.__getitem__)
+            bins[index] += cost
+        return max(bins)
+
+    def _migration_tasks(self, placements: Dict[str, List[Tuple[str, int]]],
+                         already_restarted: set) -> List[Tuple[str, object]]:
+        """Wave-2 tasks for same-size jobs whose host binding no longer
+        matches what the backend is running — including jobs whose
+        workers died with a removed host (those produce no index-level
+        move in the placement diff, so the backend's live view is the
+        ground truth to compare)."""
         live = self.backend.running_jobs()
+        tasks: List[Tuple[str, object]] = []
         for job_name, target in placements.items():
             if job_name in already_restarted:
                 continue
@@ -615,30 +1006,39 @@ class Scheduler:
             if handle is None:
                 continue
             if sorted(handle.placements) != sorted(target):
-                try:
-                    with self.tracer.span(
-                            "job.migrate", component="scheduler",
-                            attrs={"job": job_name,
-                                   "target": [list(t) for t in target]}):
-                        self.backend.migrate_workers(job_name, target)
-                except Exception:
-                    log.exception("migration of %r failed; re-booking from "
-                                  "backend state and retrying", job_name)
-                    self._add_reason(job_name, "migrate_failed")
-                    try:
-                        still_live = job_name in self.backend.running_jobs()
-                    except Exception:  # noqa: BLE001 - storm still on
-                        still_live = True  # keep the booking; retry decides
-                    if not still_live:
-                        self._revert_to_waiting(job_name)
-                    # The retry only recomputes placements when dirty —
-                    # without this, an unchanged allocation would never
-                    # re-check the mismatched binding.
-                    self._placement_dirty = True
-                    self._schedule_retry()
-                    continue
-                self._add_reason(job_name, "migrated")
-                self._last_resize_at[job_name] = self.clock.now()
+                tasks.append((job_name,
+                              (lambda j=job_name, t=target:
+                               self._migrate_job(j, t))))
+        return tasks
+
+    def _migrate_job(self, job_name: str,
+                     target: List[Tuple[str, int]]) -> None:
+        try:
+            with self.tracer.span(
+                    "job.migrate", component="scheduler",
+                    attrs={"job": job_name,
+                           "target": [list(t) for t in target]}):
+                self.backend.migrate_workers(job_name, target)
+        except Exception:
+            log.exception("migration of %r failed; re-booking from "
+                          "backend state and retrying", job_name)
+            try:
+                still_live = job_name in self.backend.running_jobs()
+            except Exception:  # noqa: BLE001 - storm still on
+                still_live = True  # keep the booking; retry decides
+            with self._lock:
+                self._add_reason(job_name, "migrate_failed")
+                if not still_live:
+                    self._revert_to_waiting(job_name)
+                # The retry only recomputes placements when dirty —
+                # without this, an unchanged allocation would never
+                # re-check the mismatched binding.
+                self._placement_dirty = True
+            self._schedule_retry()
+            return
+        with self._lock:
+            self._add_reason(job_name, "migrated")
+            self._last_resize_at[job_name] = self.clock.now()
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
         """Suppress small scale-outs of recently-resized running jobs (see
@@ -712,18 +1112,23 @@ class Scheduler:
 
     def _schedule_retry(self) -> None:
         """Reference: TriggerReschedAtTime after allocator failure
-        (scheduler.go:344-349)."""
+        (scheduler.go:344-349). Thread-safe: wave workers call this from
+        their failure isolation."""
         delay = self.rate_limit_seconds + 1.0
         if isinstance(self.clock, VirtualClock):
             self.clock.call_later(delay,
                                   lambda: self.trigger_resched("retry"))
         else:
-            # Real-time mode: keep the request pending so the service
-            # daemon retries once the window opens.
-            self._resched_pending = True
-            if "retry" not in self._pending_triggers:
-                self._pending_triggers.append("retry")
-            self.resched_blocked_until = self.clock.now() + delay
+            # Real-time mode: keep the request pending (the service
+            # daemon's pump retries once the window opens) AND arm a
+            # real-clock timer so the retry fires even with no daemon.
+            with self._lock:
+                self._resched_pending = True
+                if "retry" not in self._pending_triggers:
+                    self._pending_triggers.append("retry")
+                self.resched_blocked_until = self.clock.now() + delay
+                at = self.resched_blocked_until
+            self.clock.call_at(at, self._run_when_window_opens)
 
     def compare_results(self, old: ScheduleResult) -> Tuple[
             List[str], List[str], List[str], List[str]]:
@@ -766,8 +1171,9 @@ class Scheduler:
         except Exception:
             log.exception("start of %r failed; reverting allocation and "
                           "retrying after the rate limit", name)
-            self._add_reason(name, "start_failed")
-            self._revert_to_waiting(name)
+            with self._lock:
+                self._add_reason(name, "start_failed")
+                self._revert_to_waiting(name)
             self._schedule_retry()
 
     def _apply_scale(self, name: str,
@@ -787,64 +1193,73 @@ class Scheduler:
         except Exception:
             log.exception("resize of %r failed; re-booking from backend "
                           "state and retrying", name)
-            self._add_reason(name, "scale_failed")
             try:
                 live = self.backend.running_jobs()
             except Exception:  # noqa: BLE001 - storm may still be on
-                self.job_num_chips[name] = old_chips
+                with self._lock:
+                    self._add_reason(name, "scale_failed")
+                    self.job_num_chips[name] = old_chips
                 self._schedule_retry()
                 return
-            if name in live:
-                self.job_num_chips[name] = live[name].num_workers
-            else:
-                self._revert_to_waiting(name)
+            with self._lock:
+                self._add_reason(name, "scale_failed")
+                if name in live:
+                    self.job_num_chips[name] = live[name].num_workers
+                else:
+                    self._revert_to_waiting(name)
             self._schedule_retry()
 
     def _revert_to_waiting(self, name: str) -> None:
-        self.job_num_chips[name] = 0
-        job = self.ready_jobs.get(name)
-        if job is not None and job.status == JobStatus.RUNNING:
-            job.status = JobStatus.WAITING
-            job.metrics.last_waiting_seconds = 0.0
-            self.store.update_job(job)
+        with self._lock:
+            self.job_num_chips[name] = 0
+            job = self.ready_jobs.get(name)
+            if job is not None and job.status == JobStatus.RUNNING:
+                job.status = JobStatus.WAITING
+                job.metrics.last_waiting_seconds = 0.0
+                self.store.update_job(job)
 
     def _start_job(self, name: str,
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
-        """Reference: startTrainingJob (scheduler.go:495-519)."""
-        job = self.ready_jobs.get(name)
+        """Reference: startTrainingJob (scheduler.go:495-519). Runs on a
+        wave worker: the backend call happens without the scheduler lock;
+        bookkeeping re-acquires it."""
+        with self._lock:
+            job = self.ready_jobs.get(name)
+            chips = self.job_num_chips.get(name, 0)
         if job is None:
             return
         with self.tracer.span("job.start", component="scheduler",
-                              attrs={"job": name,
-                                     "chips": self.job_num_chips[name]}):
-            self.backend.start_job(job.spec, self.job_num_chips[name],
-                                   placements)
-        self.m_job_restarts.inc()
-        job.status = JobStatus.RUNNING
-        job.metrics.last_chip_seconds = 0.0
-        job.metrics.last_running_seconds = 0.0
-        job.metrics.seconds_since_restart = 0.0
-        # Also consume the waiting window (the reference leaves it,
-        # scheduler.go:505-514, letting a freshly-started job immediately
-        # satisfy the Tiresias promote test and bounce back to queue 0).
-        job.metrics.last_waiting_seconds = 0.0
-        self._last_resize_at[name] = self.clock.now()
-        if job.metrics.running_seconds == 0:
-            job.metrics.first_start_time = self.clock.now()
-        self.store.update_job(job)
+                              attrs={"job": name, "chips": chips}):
+            self.backend.start_job(job.spec, chips, placements)
+        with self._lock:
+            self.m_job_restarts.inc()
+            job.status = JobStatus.RUNNING
+            job.metrics.last_chip_seconds = 0.0
+            job.metrics.last_running_seconds = 0.0
+            job.metrics.seconds_since_restart = 0.0
+            # Also consume the waiting window (the reference leaves it,
+            # scheduler.go:505-514, letting a freshly-started job
+            # immediately satisfy the Tiresias promote test and bounce
+            # back to queue 0).
+            job.metrics.last_waiting_seconds = 0.0
+            self._last_resize_at[name] = self.clock.now()
+            if job.metrics.running_seconds == 0:
+                job.metrics.first_start_time = self.clock.now()
+            self.store.update_job(job)
 
     def _scale_job(self, name: str,
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
         """Reference: scaleTrainingJob (scheduler.go:542-574), priced by
-        the path the backend actually took (doc/elastic-resize.md)."""
+        the path the backend actually took (doc/elastic-resize.md).
+        Backend call outside the scheduler lock; bookkeeping inside."""
         import time as _walltime
 
+        with self._lock:
+            chips = self.job_num_chips.get(name, 0)
         t0 = _walltime.monotonic()
         with self.tracer.span("job.scale", component="scheduler",
-                              attrs={"job": name,
-                                     "chips": self.job_num_chips[name]}) as sp:
-            path = self.backend.scale_job(name, self.job_num_chips[name],
-                                          placements)
+                              attrs={"job": name, "chips": chips}) as sp:
+            path = self.backend.scale_job(name, chips, placements)
             took = _walltime.monotonic() - t0
             path_label = "fast" if path == ResizePath.INPLACE else "cold"
             sp.set_attr("path", path_label)
@@ -852,35 +1267,41 @@ class Scheduler:
         # The resize-duration histogram + audit pricing: the measured wall
         # time of the backend call, labeled by the tier it took.
         self.h_resize_duration.observe(took, path=path_label)
-        self._pass_resize_seconds[name] = took
-        self._add_reason(name, "resize_inplace" if path == ResizePath.INPLACE
-                         else "resize_cold")
-        self._last_resize_at[name] = self.clock.now()
-        if path == ResizePath.INPLACE:
-            # The job never stopped: no restart counted, and the
-            # preemption lease (seconds_since_restart) keeps running —
-            # re-arming it here would shield a live-resized job from
-            # eviction it never earned (and skew restart metrics).
-            self.m_job_resizes_inplace.inc()
-            return
-        self.m_job_restarts.inc()
-        job = self.ready_jobs.get(name)
-        if job is not None:
-            # A cold resize is a checkpoint-restart: re-arm the preemption
-            # lease so the just-restarted job isn't evicted back-to-back.
-            job.metrics.seconds_since_restart = 0.0
-            self.store.update_job(job)
+        with self._lock:
+            self._pass_resize_seconds[name] = took
+            self._add_reason(name,
+                             "resize_inplace" if path == ResizePath.INPLACE
+                             else "resize_cold")
+            self._last_resize_at[name] = self.clock.now()
+            if path == ResizePath.INPLACE:
+                # The job never stopped: no restart counted, and the
+                # preemption lease (seconds_since_restart) keeps running
+                # — re-arming it here would shield a live-resized job
+                # from eviction it never earned (and skew restart
+                # metrics).
+                self.m_job_resizes_inplace.inc()
+                return
+            self.m_job_restarts.inc()
+            job = self.ready_jobs.get(name)
+            if job is not None:
+                # A cold resize is a checkpoint-restart: re-arm the
+                # preemption lease so the just-restarted job isn't
+                # evicted back-to-back.
+                job.metrics.seconds_since_restart = 0.0
+                self.store.update_job(job)
 
     def _halt_job(self, name: str) -> None:
         """Reference: haltTrainingJob (scheduler.go:576-590)."""
-        job = self.ready_jobs.get(name)
+        with self._lock:
+            job = self.ready_jobs.get(name)
         with self.tracer.span("job.halt", component="scheduler",
                               attrs={"job": name}):
             self.backend.stop_job(name)
         if job is not None:
-            job.status = JobStatus.WAITING
-            job.metrics.last_waiting_seconds = 0.0
-            self.store.update_job(job)
+            with self._lock:
+                job.status = JobStatus.WAITING
+                job.metrics.last_waiting_seconds = 0.0
+                self.store.update_job(job)
 
     def _job_status(self, name: str) -> Optional[JobStatus]:
         job = self.ready_jobs.get(name) or self.done_jobs.get(name)
@@ -889,57 +1310,70 @@ class Scheduler:
     # ---- decision audit (doc/observability.md) ---------------------------
 
     def _add_reason(self, job: str, code: str) -> None:
-        """Tag this pass's delta for `job` with a REASON_CODES entry."""
-        reasons = self._pass_reasons.setdefault(job, [])
-        if code not in reasons:
-            reasons.append(code)
+        """Tag this pass's delta for `job` with a REASON_CODES entry.
+        Lock-guarded: wave workers tag concurrently."""
+        with self._lock:
+            reasons = self._pass_reasons.setdefault(job, [])
+            if code not in reasons:
+                reasons.append(code)
 
     def _emit_audit(self, span, triggers: List[str], old: ScheduleResult,
                     duration_s: float, outcome: str) -> None:
         """Build + emit the pass's decision-audit record: the trigger set,
         the queue snapshot, and one delta (with reason codes) per job whose
         chip count changed or about which a decision was recorded."""
-        self._audit_seq += 1
-        queue = [{"name": j.name, "status": j.status.value,
-                  "priority": j.priority,
-                  "chips_before": old.get(j.name, 0)}
-                 for j in sorted(self.ready_jobs.values(),
-                                 key=lambda j: j.submit_time)]
-        deltas = []
-        for job in sorted(set(old) | set(self.job_num_chips)
-                          | set(self._pass_reasons)):
-            before = old.get(job, 0)
-            after = self.job_num_chips.get(job, 0)
-            reasons = list(self._pass_reasons.get(job, []))
-            if before == after and not reasons:
-                continue
-            if not reasons:
-                # Changed with no recorded action: the only silent path is
-                # a job that left the allocation by reaching a terminal
-                # state (completed/failed/canceled before this pass).
-                reasons = ["released_terminal"]
-            delta = {"job": job, "before": before, "after": after,
-                     "reasons": reasons}
-            if job in self._pass_resize_seconds:
-                delta["resize_seconds"] = round(
-                    self._pass_resize_seconds[job], 4)
-            deltas.append(delta)
-        rec = {
-            "kind": "resched_audit",
-            "schema": obs_audit.SCHEMA_VERSION,
-            "ts": self.clock.now(),
-            "pool": self.pool_id,
-            "seq": self._audit_seq,
-            "trace_id": span.trace_id,
-            "triggers": triggers,
-            "algorithm": self.algorithm,
-            "total_chips": self.total_chips,
-            "queue": queue,
-            "deltas": deltas,
-            "duration_ms": round(duration_s * 1000.0, 3),
-            "outcome": outcome,
-        }
-        self.audit_ring.append(rec)
+        with self._lock:
+            self._audit_seq += 1
+            queue = [{"name": j.name, "status": j.status.value,
+                      "priority": j.priority,
+                      "chips_before": old.get(j.name, 0)}
+                     for j in sorted(self.ready_jobs.values(),
+                                     key=lambda j: j.submit_time)]
+            deltas = []
+            for job in sorted(set(old) | set(self.job_num_chips)
+                              | set(self._pass_reasons)):
+                before = old.get(job, 0)
+                after = self.job_num_chips.get(job, 0)
+                reasons = list(self._pass_reasons.get(job, []))
+                if before == after and not reasons:
+                    continue
+                if not reasons:
+                    # Changed with no recorded action: the only silent
+                    # path is a job that left the allocation by reaching
+                    # a terminal state (completed/failed/canceled before
+                    # this pass).
+                    reasons = ["released_terminal"]
+                delta = {"job": job, "before": before, "after": after,
+                         "reasons": reasons}
+                if job in self._pass_resize_seconds:
+                    delta["resize_seconds"] = round(
+                        self._pass_resize_seconds[job], 4)
+                deltas.append(delta)
+            rec = {
+                "kind": "resched_audit",
+                "schema": obs_audit.SCHEMA_VERSION,
+                "ts": self.clock.now(),
+                "pool": self.pool_id,
+                "seq": self._audit_seq,
+                "trace_id": span.trace_id,
+                "triggers": triggers,
+                "algorithm": self.algorithm,
+                "total_chips": self.total_chips,
+                "queue": queue,
+                "deltas": deltas,
+                "duration_ms": round(duration_s * 1000.0, 3),
+                "outcome": outcome,
+            }
+            if self._pass_wave_stats:
+                # Optional actuation block (schema: additive, validated
+                # as free-form): per-wave size, execution mode, wall
+                # time, and the critical-path vs serial-sum pricing.
+                rec["actuation"] = {
+                    "waves": list(self._pass_wave_stats),
+                    "critical_path_s": round(
+                        self._last_pass_priced_seconds, 4),
+                }
+            self.audit_ring.append(rec)
         self.tracer.emit(dict(rec))
 
     def audit_records(self, n: int = 20) -> List[dict]:
@@ -961,9 +1395,15 @@ class Scheduler:
 
     def update_time_metrics(self) -> None:
         with self._lock:
-            self._update_time_metrics_locked()
+            priority_changed = self._update_time_metrics_locked()
+        # Trigger outside the lock hold (an inline VirtualClock pass
+        # must not inherit this frame's lock — see trigger_resched).
+        if priority_changed:
+            self.trigger_resched("priority_change")
 
-    def _update_time_metrics_locked(self) -> None:
+    def _update_time_metrics_locked(self) -> bool:
+        """Returns whether a Tiresias priority flipped (the caller fires
+        the resched trigger once it has released the lock)."""
         now = self.clock.now()
         priority_changed = False
         for job in self.ready_jobs.values():
@@ -1003,8 +1443,7 @@ class Scheduler:
                     job.priority = tiresias_promote_priority(job.priority)
                     m.last_waiting_seconds = 0.0
                     priority_changed = True
-        if priority_changed:
-            self.trigger_resched("priority_change")
+        return priority_changed
 
     # ---- crash resume (reference: constructStatusOnRestart :1009-1072) ---
 
