@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast bench bench-dryrun trace-dryrun native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline lock-order bench bench-dryrun trace-dryrun native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -22,6 +22,26 @@ test-all:
 	$(PY) -m pytest tests/ -x -q
 
 test-fast: test
+
+# vodalint: the project-native concurrency/determinism linter
+# (doc/static-analysis.md) — clock discipline, lock discipline, closed
+# audit vocabularies, metrics locking, thread hygiene. Exit non-zero on
+# any finding not in the committed baseline (which is empty: every
+# accepted exception is an inline `# vodalint: ignore[rule] reason`).
+lint:
+	$(PY) -m vodascheduler_tpu.analysis.vodalint vodascheduler_tpu \
+		--baseline vodalint_baseline.jsonl
+
+# Regenerate the accepted-findings baseline (review the diff!).
+lint-baseline:
+	$(PY) -m vodascheduler_tpu.analysis.vodalint vodascheduler_tpu \
+		--write-baseline vodalint_baseline.jsonl
+
+# Regenerate the pinned lock-acquisition-order artifact
+# (doc/lock_order.json) from a witnessed concurrency-stress run.
+lock-order:
+	VODA_LOCKWITNESS_WRITE=1 $(PY) -m pytest \
+		tests/test_concurrency_stress.py -q -p no:cacheprovider
 
 bench:
 	$(PY) bench.py

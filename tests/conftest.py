@@ -19,8 +19,22 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def lock_witness():
+    """Opt-in runtime lock-order witness (analysis/lockwitness.py): the
+    test instruments the locks it cares about; teardown fails the test
+    on any witnessed lock-order cycle or lock-held-across-backend-call
+    violation, even if the test body's own assertions all passed."""
+    from vodascheduler_tpu.analysis.lockwitness import LockOrderWitness
+
+    witness = LockOrderWitness()
+    yield witness
+    witness.check()
 
 # Deadlock watchdog: the scheduler actuates rescheds on worker threads
 # (decide/actuate lock split), and a future locking bug would present as

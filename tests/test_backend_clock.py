@@ -1,0 +1,133 @@
+"""Clock discipline on the real backends (vodalint's clock-discipline
+rule, satellite of the invariant-enforcement plane): every cluster
+backend stamps its events with the INJECTED Clock, so a harness driving
+one under a VirtualClock gets virtual-time-stamped events — the
+replay-determinism property raw time.time() stamps silently broke.
+
+Hermetic: no subprocesses — LocalBackend gets a stub Popen, GkeBackend a
+FakeKube, MultiHostBackend pure host churn."""
+
+from vodascheduler_tpu.cluster.backend import ClusterEventKind
+from vodascheduler_tpu.cluster.gke import GkeBackend
+from vodascheduler_tpu.cluster.local import LocalBackend, _Proc
+from vodascheduler_tpu.cluster.multihost import MultiHostBackend
+from vodascheduler_tpu.common.clock import Clock, VirtualClock
+from vodascheduler_tpu.common.job import JobSpec
+from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
+
+from tests.test_gke_backend import FakeKube, make_node, template
+
+T0 = 1_234_500.0
+
+
+class _ExitedPopen:
+    """A process that already exited with the given code."""
+
+    def __init__(self, code: int = 0):
+        self._code = code
+        self.pid = 4242
+
+    def poll(self):
+        return self._code
+
+    def wait(self, timeout=None):
+        return self._code
+
+    def send_signal(self, sig):
+        pass
+
+    def kill(self):
+        pass
+
+
+def test_local_backend_stamps_events_with_virtual_clock(tmp_path):
+    clock = VirtualClock(start=T0)
+    backend = LocalBackend(str(tmp_path), chips=8, clock=clock,
+                           poll_interval_seconds=0.01)
+    events = []
+    backend.set_event_callback(events.append)
+    backend._specs["job-ok"] = JobSpec(name="job-ok")
+    backend._procs["job-ok"] = _Proc(_ExitedPopen(0), 2, 8)
+    clock.advance(30.0)
+    backend._monitor_loop()  # reaps the exited proc, then idle-exits
+    assert [e.kind for e in events] == [ClusterEventKind.JOB_COMPLETED]
+    assert events[0].timestamp == T0 + 30.0
+
+    events.clear()
+    backend._specs["job-bad"] = JobSpec(name="job-bad")
+    backend._procs["job-bad"] = _Proc(_ExitedPopen(PREEMPTED_EXIT_CODE),
+                                      2, 8)
+    clock.advance(15.0)
+    backend._monitor_loop()
+    assert [e.kind for e in events] == [ClusterEventKind.JOB_FAILED]
+    assert events[0].timestamp == T0 + 45.0
+    backend.close()
+
+
+def test_multihost_backend_stamps_host_events_with_virtual_clock(tmp_path):
+    clock = VirtualClock(start=T0)
+    backend = MultiHostBackend(str(tmp_path), hosts={"host-0": 4},
+                               clock=clock)
+    events = []
+    backend.set_event_callback(events.append)
+    backend.add_host("host-1", 4)
+    clock.advance(60.0)
+    backend.remove_host("host-1")
+    assert [e.kind for e in events] == [ClusterEventKind.HOST_ADDED,
+                                        ClusterEventKind.HOST_REMOVED]
+    assert events[0].timestamp == T0
+    assert events[1].timestamp == T0 + 60.0
+    backend.close()
+
+
+def test_gke_backend_stamps_all_events_with_virtual_clock():
+    clock = VirtualClock(start=T0)
+    kube = FakeKube([make_node("host-0", chips=8)])
+    backend = GkeBackend(kube, pod_template=template(),
+                         poll_interval_seconds=600.0, clock=clock)
+    events = []
+    backend.set_event_callback(events.append)
+    backend.start_job(JobSpec(name="job-a"), 4)
+    for pod in kube.pods.values():
+        pod["status"] = {
+            "phase": "Succeeded",
+            "containerStatuses": [{"state": {"terminated":
+                                             {"exitCode": 0}}}],
+        }
+    clock.advance(90.0)
+    backend.poll_once()
+    done = [e for e in events
+            if e.kind == ClusterEventKind.JOB_COMPLETED]
+    assert len(done) == 1
+    assert done[0].timestamp == T0 + 90.0
+
+    # Host churn from the node informer sweep: same virtual stamps.
+    events.clear()
+    kube.nodes.append(make_node("host-1", chips=8))
+    clock.advance(5.0)
+    backend.poll_once()
+    added = [e for e in events if e.kind == ClusterEventKind.HOST_ADDED]
+    assert len(added) == 1 and added[0].timestamp == T0 + 95.0
+    backend.close()
+
+
+def test_backends_default_to_real_clock(tmp_path):
+    backend = MultiHostBackend(str(tmp_path))
+    assert isinstance(backend.clock, Clock)
+    assert not isinstance(backend.clock, VirtualClock)
+    backend.close()
+
+
+def test_app_threads_one_clock_into_its_backends(tmp_path):
+    """The composition root must hand ITS clock to every backend it
+    builds — a silent per-backend Clock() fallback would re-open the
+    wall-clock drift this plane closed."""
+    from vodascheduler_tpu.service.app import VodaApp
+
+    app = VodaApp(str(tmp_path), chips=4, hermetic_devices=4)
+    try:
+        assert app.schedulers
+        for sched in app.schedulers.values():
+            assert sched.backend.clock is app.clock
+    finally:
+        app.stop()

@@ -10,6 +10,8 @@ raised, no deadlock, every job terminal or cleanly allocated within
 capacity and its own bounds.
 """
 
+import json
+import os
 import threading
 import time
 
@@ -57,8 +59,22 @@ def _build():
     return clock, store, backend, sched, admission, topology
 
 
-def test_scheduler_survives_concurrent_hammering():
+LOCK_ORDER_PINNED = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "doc", "lock_order.json")
+
+
+def test_scheduler_survives_concurrent_hammering(lock_witness):
     clock, store, backend, sched, admission, topology = _build()
+    # Runtime half of the invariant-enforcement plane
+    # (doc/static-analysis.md): witness the storm's actual lock
+    # acquisitions. Any order cycle, any backend mutator entered with a
+    # table lock held, or any lock nesting NOT in the pinned
+    # doc/lock_order.json artifact fails the test.
+    lock_witness.instrument(sched, "_lock", "scheduler._lock")
+    lock_witness.instrument(backend, "_state_lock",
+                            "fake_backend._state_lock")
+    lock_witness.instrument(clock, "_lock", "virtual_clock._lock")
+    lock_witness.guard_backend(backend, "fake_backend")
     errors = []
     stop = threading.Event()
     submitted = []
@@ -164,6 +180,20 @@ def test_scheduler_survives_concurrent_hammering():
         assert job is not None
         assert chips == 0 or (job.config.min_num_chips <= chips
                               <= job.config.max_num_chips)
+
+    # Lock-order witness verdict. VODA_LOCKWITNESS_WRITE=1 regenerates
+    # the pinned artifact (`make lock-order`); otherwise the witnessed
+    # graph must be a subset of what a reviewer already signed off on.
+    assert lock_witness.problems() == []
+    assert lock_witness.edges(), "storm should witness real lock nestings"
+    if os.environ.get("VODA_LOCKWITNESS_WRITE"):
+        lock_witness.dump(LOCK_ORDER_PINNED)
+    with open(LOCK_ORDER_PINNED) as f:
+        pinned = json.load(f)
+    new_edges = lock_witness.new_edges_vs(pinned)
+    assert not new_edges, (
+        f"unreviewed lock nesting(s) {new_edges}: update "
+        f"doc/lock_order.json via `make lock-order` if intentional")
 
 
 @pytest.mark.parametrize("n_threads", [8])

@@ -111,6 +111,57 @@ class TestApisDoc:
             assert term in doc, f"concurrency-model term {term!r} missing"
 
 
+class TestStaticAnalysisDoc:
+    def test_rule_catalog_matches_linter_registry(self):
+        """doc/static-analysis.md documents every vodalint rule id, and
+        names no rule the linter doesn't have."""
+        with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:
+            doc = f.read()
+        from vodascheduler_tpu.analysis import vodalint
+        for rule in vodalint.RULES:
+            assert f"`{rule}`" in doc, f"rule {rule!r} undocumented"
+        documented = set(re.findall(r"\| `([a-z\-]+)` \|", doc))
+        unknown = documented - set(vodalint.RULES)
+        assert not unknown, f"documented but not in RULES: {unknown}"
+
+    def test_suppression_syntax_and_artifacts_documented(self):
+        with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:
+            doc = f.read()
+        assert "vodalint: ignore[" in doc
+        assert "vodalint_baseline.jsonl" in doc
+        assert "lock_order.json" in doc
+        assert "make lint" in doc and "make lock-order" in doc
+
+    def test_span_vocabulary_documented(self):
+        """SPAN_NAMES joins REASON_CODES/TRIGGERS in the pinned-doc
+        contract: every span name the code may emit is documented."""
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        from vodascheduler_tpu.obs import SPAN_NAMES
+        for name in sorted(SPAN_NAMES):
+            assert f"`{name}`" in doc, f"span name {name!r} undocumented"
+
+    def test_observability_cross_links_static_analysis(self):
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            assert "static-analysis.md" in f.read()
+
+    def test_lock_order_artifact_pinned(self):
+        """doc/lock_order.json is committed, schema-valid, and acyclic
+        (a cyclic pinned graph would bless a deadlock)."""
+        import json
+
+        from vodascheduler_tpu.analysis.lockwitness import assert_acyclic
+        with open(os.path.join(REPO, "doc", "lock_order.json")) as f:
+            graph = json.load(f)
+        assert graph["schema"] == 1
+        assert set(graph) == {"schema", "nodes", "edges"}
+        assert graph["edges"]
+        assert_acyclic(graph)
+        for src, dsts in graph["edges"].items():
+            assert src in graph["nodes"]
+            assert all(d in graph["nodes"] for d in dsts)
+
+
 def test_helm_chart_values_references_resolve():
     """deploy/helm/voda-tpu (reference parity: helm/voda-scheduler):
     Chart/values parse, and every `.Values.<path>` referenced by a

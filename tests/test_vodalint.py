@@ -1,0 +1,452 @@
+"""vodalint: the linter's rules, suppressions, baseline, and — most
+importantly — the live tree. Each rule gets a positive (fires), a
+negative (stays quiet), and a suppressed fixture; then the real package
+must lint clean, and re-introducing a known-fixed violation (raw
+time.time() in cluster/gke.py, an unknown reason code) must fail again —
+the "deleting any one enforced invariant breaks the build" guarantee."""
+
+import json
+import os
+import textwrap
+
+from vodascheduler_tpu.analysis import vodalint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "vodascheduler_tpu")
+
+
+def findings(src: str, rel: str):
+    return vodalint.lint_source(textwrap.dedent(src), rel)
+
+
+def rules_of(fs):
+    return [f.rule for f in fs]
+
+
+class TestClockDiscipline:
+    def test_time_time_flagged_in_clocked_module(self):
+        fs = findings("""
+            import time
+            def g():
+                return time.time()
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["clock-discipline"]
+
+    def test_aliased_import_still_flagged(self):
+        fs = findings("""
+            import time as _walltime
+            def g():
+                _walltime.sleep(1)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["clock-discipline"]
+
+    def test_datetime_now_flagged(self):
+        fs = findings("""
+            import datetime
+            def g():
+                return datetime.datetime.now()
+            """, "obs/x.py")
+        assert rules_of(fs) == ["clock-discipline"]
+
+    def test_monotonic_allowed(self):
+        assert findings("""
+            import time
+            def g():
+                return time.monotonic()
+            """, "cluster/x.py") == []
+
+    def test_unclocked_module_out_of_scope(self):
+        assert findings("""
+            import time
+            def g():
+                return time.time()
+            """, "benchrunner/x.py") == []
+
+    def test_suppression_with_reason(self):
+        assert findings("""
+            import time
+            def g():
+                time.sleep(1)  # vodalint: ignore[clock-discipline] modeled wall pause
+            """, "cluster/x.py") == []
+
+    def test_suppression_in_comment_block_above(self):
+        assert findings("""
+            import time
+            def g():
+                # vodalint: ignore[clock-discipline] models the real
+                # blocking round trip; must not advance virtual time
+                time.sleep(1)
+            """, "cluster/x.py") == []
+
+    def test_suppression_without_reason_is_a_finding(self):
+        fs = findings("""
+            import time
+            def g():
+                time.sleep(1)  # vodalint: ignore[clock-discipline]
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["suppression-empty-reason"]
+
+    def test_suppression_for_wrong_rule_does_not_apply(self):
+        fs = findings("""
+            import time
+            def g():
+                time.sleep(1)  # vodalint: ignore[thread-daemon] wrong rule
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["clock-discipline"]
+
+
+class TestLockDiscipline:
+    def test_backend_mutator_under_lock_flagged(self):
+        fs = findings("""
+            class S:
+                def bad(self):
+                    with self._lock:
+                        self.backend.start_job(spec, 4)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_emit_under_state_lock_flagged(self):
+        fs = findings("""
+            class B:
+                def bad(self):
+                    with self._state_lock:
+                        self.emit(ev)
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_indirect_via_self_method_flagged(self):
+        fs = findings("""
+            class B:
+                def bad(self):
+                    with self._lock:
+                        self._boom()
+                def _boom(self):
+                    self.emit(ev)
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+        assert "_boom" in fs[0].message
+
+    def test_locked_or_deferred_target_checked(self):
+        fs = findings("""
+            class S:
+                def handler(self):
+                    self._locked_or_deferred(self._mutator)
+                def _mutator(self):
+                    self.backend.stop_job("j")
+                    return []
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_emit_after_lock_release_clean(self):
+        assert findings("""
+            class B:
+                def good(self):
+                    with self._lock:
+                        ev = make()
+                    self.emit(ev)
+            """, "cluster/x.py") == []
+
+    def test_deferred_lambda_under_lock_clean(self):
+        # A lambda DEFINED under the lock runs later, on a timer thread
+        # — the fake backend's epoch timers do exactly this.
+        assert findings("""
+            class B:
+                def good(self):
+                    with self._state_lock:
+                        self.clock.call_at(5.0, lambda: self.emit(ev))
+            """, "cluster/x.py") == []
+
+    def test_read_only_backend_call_allowed(self):
+        assert findings("""
+            class S:
+                def good(self):
+                    with self._lock:
+                        hosts = self.backend.list_hosts()
+            """, "scheduler/x.py") == []
+
+
+class TestVocab:
+    def test_unknown_reason_code_flagged(self):
+        fs = findings("""
+            class S:
+                def g(self, j):
+                    self._add_reason(j, "cosmic_ray_flip")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["vocab"]
+
+    def test_known_reason_code_clean(self):
+        assert findings("""
+            class S:
+                def g(self, j):
+                    self._add_reason(j, "scale_out")
+            """, "scheduler/x.py") == []
+
+    def test_conditional_reason_codes_both_checked(self):
+        fs = findings("""
+            class S:
+                def g(self, j, fast):
+                    self._add_reason(j, "resize_inplace" if fast
+                                     else "cold_fusion")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["vocab"]
+        assert "cold_fusion" in fs[0].message
+
+    def test_unknown_trigger_flagged(self):
+        fs = findings("""
+            def g(s):
+                s.trigger_resched("vibes")
+            """, "service/x.py")
+        assert rules_of(fs) == ["vocab"]
+
+    def test_unknown_span_name_flagged(self):
+        fs = findings("""
+            def g(t):
+                with t.span("backend.teleport", component="backend"):
+                    pass
+            """, "cluster/x.py")
+        assert rules_of(fs) == ["vocab"]
+
+    def test_known_span_name_clean(self):
+        assert findings("""
+            def g(t):
+                with t.span("backend.start", component="backend"):
+                    pass
+            """, "cluster/x.py") == []
+
+    def test_dead_vocabulary_entry_flagged(self, tmp_path):
+        # A one-sided vocab edit: entry exists in obs/audit.py but no
+        # code ever emits it. lint_package's reverse sweep catches it.
+        pkg = tmp_path / "pkg"
+        (pkg / "obs").mkdir(parents=True)
+        (pkg / "obs" / "audit.py").write_text("# vocab lives here\n")
+        (pkg / "scheduler").mkdir()
+        (pkg / "scheduler" / "s.py").write_text(
+            'class S:\n    def g(self, j):\n'
+            '        self._add_reason(j, "started")\n')
+        fs = vodalint.lint_package(str(pkg))
+        dead = [f for f in fs if "used nowhere" in f.message]
+        assert dead and all(f.path == "obs/audit.py" for f in dead)
+        # "started" IS used by the fixture tree, so it is not dead.
+        assert not any("'started'" in f.message for f in dead)
+
+
+class TestMetricsLock:
+    SRC = """
+        import threading
+        class C:
+            def __init__(self):
+                self._values = {}
+                self._lock = threading.Lock()
+            def unlocked(self):
+                return self._values.get(1)
+            def locked(self):
+                with self._lock:
+                    return self._values.get(1)
+        """
+
+    def test_unlocked_access_flagged_in_metrics_module(self):
+        fs = findings(self.SRC, "common/metrics.py")
+        assert rules_of(fs) == ["metrics-lock"]
+        assert "unlocked" in fs[0].message
+
+    def test_rule_scoped_to_metrics_module(self):
+        assert findings(self.SRC, "common/other.py") == []
+
+    def test_class_without_any_lock_flagged(self):
+        """The canonical regression: a new instrument class that never
+        creates the lock at all."""
+        fs = findings("""
+            class NewInstrument:
+                def __init__(self):
+                    self._values = {}
+                def observe(self, v):
+                    self._values[()] = v
+            """, "common/metrics.py")
+        assert rules_of(fs) == ["metrics-lock"]
+        assert "no self._lock" in fs[0].message
+
+    def test_lockless_class_without_state_clean(self):
+        assert findings("""
+            class Helper:
+                def fmt(self, v):
+                    return str(v)
+            """, "common/metrics.py") == []
+
+
+class TestThreadHygiene:
+    def test_thread_without_daemon_flagged(self):
+        fs = findings("""
+            import threading
+            def g():
+                t = threading.Thread(target=g)
+                t.start()
+            """, "service/x.py")
+        assert rules_of(fs) == ["thread-daemon"]
+
+    def test_daemon_kwarg_clean(self):
+        assert findings("""
+            import threading
+            def g():
+                threading.Thread(target=g, daemon=True).start()
+            """, "service/x.py") == []
+
+    def test_daemon_attribute_after_construction_clean(self):
+        assert findings("""
+            import threading
+            def g():
+                timer = threading.Timer(1.0, g)
+                timer.daemon = True
+                timer.start()
+            """, "common/x.py") == []
+
+    def test_submit_without_context_flagged(self):
+        fs = findings("""
+            def g(pool, fn):
+                return pool.submit(fn)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["executor-context"]
+
+    def test_submit_with_context_propagation_clean(self):
+        assert findings("""
+            def g(pool, fn, parent, tracer):
+                def run():
+                    with use_context(parent, tracer):
+                        fn()
+                return pool.submit(run)
+            """, "scheduler/x.py") == []
+
+
+class TestLiveTree:
+    def test_package_lints_clean(self):
+        fs = vodalint.lint_package(PKG)
+        assert fs == [], "\n".join(
+            f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in fs)
+
+    def test_reintroducing_wall_clock_in_gke_fails(self):
+        """The exact drift this PR fixed: raw time.time() event stamps
+        in cluster/gke.py. Undo the fix in memory — the linter must
+        catch it again."""
+        with open(os.path.join(PKG, "cluster", "gke.py")) as f:
+            src = f.read()
+        assert "timestamp=self.clock.now()" in src
+        broken = src.replace("timestamp=self.clock.now()",
+                             "timestamp=time.time()")
+        fs = vodalint.lint_source(broken, "cluster/gke.py")
+        assert {f.rule for f in fs} == {"clock-discipline"}
+        assert len(fs) >= 6  # one per event-emission site
+
+    def test_unknown_reason_code_in_scheduler_fails(self):
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        broken = src.replace('self._add_reason(job, "started")',
+                             'self._add_reason(job, "vibes_based")')
+        assert broken != src
+        fs = vodalint.lint_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "vocab" and "vibes_based" in f.message
+                   for f in fs)
+
+    def test_stripping_a_suppression_reason_fails(self):
+        """Every inline suppression in the tree must carry a reason;
+        blanking one turns it into a finding."""
+        with open(os.path.join(PKG, "cluster", "fake.py")) as f:
+            src = f.read()
+        assert "vodalint: ignore[clock-discipline]" in src
+        broken = src.replace(
+            "vodalint: ignore[clock-discipline] models the REAL blocking",
+            "vodalint: ignore[clock-discipline]")
+        fs = vodalint.lint_source(broken, "cluster/fake.py")
+        assert any(f.rule == "suppression-empty-reason" for f in fs)
+
+
+class TestBaselineAndCli:
+    def test_baseline_round_trip(self, tmp_path):
+        bad = tmp_path / "pkg" / "cluster"
+        bad.mkdir(parents=True)
+        (bad / "x.py").write_text(
+            "import time\ndef g():\n    return time.time()\n")
+        base = tmp_path / "baseline.jsonl"
+        # 1) without a baseline: non-zero exit, jsonl findings parse
+        import io
+        out = io.StringIO()
+        rc = vodalint.run([str(tmp_path / "pkg")], fmt="jsonl",
+                          stream=out)
+        assert rc == 1
+        recs = [json.loads(line) for line in
+                out.getvalue().strip().splitlines()]
+        assert recs and recs[0]["rule"] == "clock-discipline"
+        # 2) write the baseline, re-run against it: exit 0
+        rc = vodalint.run([str(tmp_path / "pkg")],
+                          write_baseline_path=str(base), stream=io.StringIO())
+        assert rc == 0
+        loaded = vodalint.load_baseline(str(base))
+        assert len(loaded) == 1
+        rc = vodalint.run([str(tmp_path / "pkg")], baseline=str(base),
+                          stream=io.StringIO())
+        assert rc == 0
+        # 3) a NEW violation is not masked by the old baseline
+        (bad / "y.py").write_text(
+            "import time\ndef h():\n    time.sleep(2)\n")
+        rc = vodalint.run([str(tmp_path / "pkg")], baseline=str(base),
+                          stream=io.StringIO())
+        assert rc == 1
+
+    def test_baseline_is_a_multiset(self, tmp_path):
+        """A second, IDENTICAL violation in an already-baselined file
+        (same rule, same message — every time.time() in one file shares
+        both) must not be masked by the first one's baseline entry."""
+        bad = tmp_path / "pkg" / "cluster"
+        bad.mkdir(parents=True)
+        (bad / "x.py").write_text(
+            "import time\ndef g():\n    return time.time()\n")
+        base = tmp_path / "baseline.jsonl"
+        import io
+        assert vodalint.run([str(tmp_path / "pkg")],
+                            write_baseline_path=str(base),
+                            stream=io.StringIO()) == 0
+        assert vodalint.run([str(tmp_path / "pkg")], baseline=str(base),
+                            stream=io.StringIO()) == 0
+        (bad / "x.py").write_text(
+            "import time\ndef g():\n    return time.time()\n"
+            "def h():\n    return time.time()\n")
+        assert vodalint.run([str(tmp_path / "pkg")], baseline=str(base),
+                            stream=io.StringIO()) == 1
+
+    def test_linting_a_package_subdirectory_keeps_rule_scope(
+            self, tmp_path, monkeypatch):
+        """Rel paths anchor at the PACKAGE root even when only a
+        subdirectory is linted — otherwise every path-scoped rule
+        silently disables itself and a dirty subtree lints clean."""
+        import shutil
+        broken = tmp_path / "vodascheduler_tpu"
+        shutil.copytree(PKG, broken)
+        gke = broken / "cluster" / "gke.py"
+        gke.write_text(gke.read_text().replace(
+            "timestamp=self.clock.now()", "timestamp=time.time()"))
+        monkeypatch.setattr(vodalint, "_package_dir", lambda: str(broken))
+        # Lint ONLY the cluster/ subdirectory of the (broken) package:
+        # the clock-discipline findings must still fire, with
+        # package-rooted paths.
+        fs = vodalint.lint_package(str(broken / "cluster"))
+        hits = [f for f in fs if f.rule == "clock-discipline"]
+        assert len(hits) >= 6
+        assert all(f.path.startswith("cluster/") for f in hits)
+        # And the partial sweep must NOT declare the vocabulary dead.
+        assert not any("used nowhere" in f.message for f in fs)
+
+    def test_parse_error_has_its_own_rule(self, tmp_path):
+        fs = vodalint.lint_source("def broken(:\n", "cluster/x.py")
+        assert rules_of(fs) == ["parse-error"]
+
+    def test_committed_baseline_matches_tree(self):
+        """`make lint` contract: current findings minus the committed
+        baseline must be empty (the tree itself is clean, so the
+        committed baseline is empty too — every exception is inline)."""
+        base_path = os.path.join(REPO, "vodalint_baseline.jsonl")
+        assert os.path.exists(base_path)
+        remaining = vodalint.subtract_baseline(
+            vodalint.lint_package(PKG), vodalint.load_baseline(base_path))
+        assert remaining == []
+
+    def test_rule_registry_has_descriptions(self):
+        for rule, doc in vodalint.RULES.items():
+            assert doc and len(doc) > 20, rule

@@ -1,0 +1,28 @@
+"""Invariant-enforcement plane: static + dynamic checkers for the
+concurrency/determinism contracts the control plane is built on.
+
+PR 4 made the scheduler genuinely concurrent (decide-under-lock /
+actuate-unlocked waves, events emitted outside locks, Clock-injected
+determinism), but those invariants lived only in doc/observability.md
+prose. This package machine-checks them:
+
+- `vodalint`: an AST-based project-native linter (stdlib `ast`, no
+  dependencies) with a rule registry and per-rule inline suppressions
+  (`# vodalint: ignore[rule-id] reason`). Run as
+  `python -m vodascheduler_tpu.analysis.vodalint` or `make lint`.
+- `lockwitness`: a runtime lock-order witness tier-1 tests opt into —
+  it records the global lock-acquisition-order graph, fails on cycles
+  and on locks held across backend calls, and pins the witnessed graph
+  as doc/lock_order.json.
+
+Rule catalog and artifact formats: doc/static-analysis.md.
+"""
+
+# NOTE: vodalint is deliberately NOT imported here — it doubles as the
+# `python -m vodascheduler_tpu.analysis.vodalint` entry point, and an
+# eager package import would shadow the runpy execution (RuntimeWarning,
+# two module objects). Import it explicitly where needed.
+from vodascheduler_tpu.analysis.lockwitness import (  # noqa: F401
+    LockOrderViolation,
+    LockOrderWitness,
+)

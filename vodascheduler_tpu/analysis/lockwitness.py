@@ -1,0 +1,244 @@
+"""Runtime lock-order witness: the dynamic half of the enforcement plane.
+
+vodalint proves lexical properties (no emit under a `with self._lock:`
+block); this witness proves the *global* property those local rules
+exist for — that the process's lock-acquisition order forms a DAG
+(deadlock-freedom) and that no thread ever enters a backend mutator
+while holding a witnessed lock (the decide/actuate contract, observed
+at runtime rather than inferred from syntax).
+
+Usage (tests opt in via the `lock_witness` conftest fixture):
+
+    witness = LockOrderWitness()
+    witness.instrument(sched, "_lock", "scheduler._lock")
+    witness.instrument(backend, "_state_lock", "fake_backend._state_lock")
+    witness.guard_backend(backend, "fake_backend")
+    ... run the scenario ...
+    witness.check()          # raises LockOrderViolation on any problem
+
+The witnessed graph is a pinned, reviewable artifact: the concurrency
+stress test asserts its edges are a subset of doc/lock_order.json, so a
+NEW nesting (scheduler lock held around something it never was before)
+fails tier-1 until the artifact — and therefore a reviewer — has seen
+it. Regenerate with `make lock-order` (or VODA_LOCKWITNESS_WRITE=1 on
+the stress test).
+
+Wrapped locks delegate everything else (`held_by_me`, `locked`, ...) to
+the wrapped object, so `_OwnedRLock` introspection keeps working.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Set
+
+SCHEMA_VERSION = 1
+
+# The backend mutators whose callers must hold no witnessed lock — the
+# same set vodalint's lock-discipline rule matches lexically.
+BOUNDARY_METHODS = ("start_job", "scale_job", "stop_job",
+                    "migrate_workers")
+
+
+class LockOrderViolation(AssertionError):
+    """A lock-order cycle or a lock held across a backend boundary."""
+
+
+class _WitnessedLock:
+    """Transparent lock proxy reporting acquire/release to the witness."""
+
+    def __init__(self, witness: "LockOrderWitness", name: str, inner):
+        self._witness = witness
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, *args, **kwargs):
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok:
+            self._witness._on_acquired(self._name)
+        return ok
+
+    def release(self):
+        self._witness._on_released(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __getattr__(self, item):
+        # held_by_me(), locked(), ... keep working on the real lock.
+        return getattr(self._inner, item)
+
+
+class LockOrderWitness:
+    """Thread-safe recorder of the global lock-acquisition-order graph."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # src -> {dst}: "dst was acquired while src was held".
+        self._edges: Dict[str, Set[str]] = {}
+        self._nodes: Set[str] = set()
+        self._tls = threading.local()
+        self.violations: List[str] = []
+
+    # ---- instrumentation -------------------------------------------------
+
+    def wrap(self, name: str, lock) -> _WitnessedLock:
+        with self._mu:
+            self._nodes.add(name)
+        return _WitnessedLock(self, name, lock)
+
+    def instrument(self, obj, attr: str, name: str) -> _WitnessedLock:
+        """Replace `obj.<attr>` with a witnessed proxy of itself."""
+        wrapped = self.wrap(name, getattr(obj, attr))
+        setattr(obj, attr, wrapped)
+        return wrapped
+
+    def guard_backend(self, backend, name: str = "backend",
+                      methods: Iterable[str] = BOUNDARY_METHODS):
+        """Wrap the backend's mutators: entering one while this thread
+        holds ANY witnessed lock is a recorded violation (the
+        decide/actuate contract — a held lock across a blocking backend
+        call freezes every reader for the drain)."""
+        for method in methods:
+            orig = getattr(backend, method, None)
+            if orig is None or not callable(orig):
+                continue
+            setattr(backend, method,
+                    self._boundary(name, method, orig))
+        return backend
+
+    def _boundary(self, name: str, method: str,
+                  orig: Callable) -> Callable:
+        def call(*args, **kwargs):
+            held = sorted(set(self._stack()))
+            if held:
+                with self._mu:
+                    self.violations.append(
+                        f"{name}.{method}() entered while holding "
+                        f"lock(s) {held} — backend calls must run with "
+                        f"every table lock released")
+            return orig(*args, **kwargs)
+
+        call.__name__ = getattr(orig, "__name__", method)
+        return call
+
+    # ---- recording -------------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _on_acquired(self, name: str) -> None:
+        stack = self._stack()
+        if name not in stack:  # reentrant re-acquire records no edges
+            held = set(stack)
+            if held:
+                with self._mu:
+                    for src in held:
+                        self._edges.setdefault(src, set()).add(name)
+        stack.append(name)
+
+    def _on_released(self, name: str) -> None:
+        stack = self._stack()
+        # Remove the most recent acquisition of this lock; tolerate a
+        # release the witness never saw acquired (instrumented
+        # mid-flight) rather than corrupting the stack.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # ---- queries ---------------------------------------------------------
+
+    def edges(self) -> Dict[str, List[str]]:
+        with self._mu:
+            return {src: sorted(dsts)
+                    for src, dsts in sorted(self._edges.items())}
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A lock-order cycle (as a node path), or None. Any cycle in
+        the witnessed acquisition-order graph is a deadlock waiting for
+        the right interleaving."""
+        edges = self.edges()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(edges) | {d for ds in edges.values() for d in ds}}
+        path: List[str] = []
+
+        def dfs(node: str) -> Optional[List[str]]:
+            color[node] = GRAY
+            path.append(node)
+            for nxt in edges.get(node, ()):
+                if color[nxt] == GRAY:
+                    return path[path.index(nxt):] + [nxt]
+                if color[nxt] == WHITE:
+                    found = dfs(nxt)
+                    if found:
+                        return found
+            path.pop()
+            color[node] = BLACK
+            return None
+
+        for node in sorted(color):
+            if color[node] == WHITE:
+                found = dfs(node)
+                if found:
+                    return found
+        return None
+
+    def problems(self) -> List[str]:
+        out: List[str] = []
+        cycle = self.find_cycle()
+        if cycle:
+            out.append("lock-order cycle (deadlock potential): "
+                       + " -> ".join(cycle))
+        with self._mu:
+            out.extend(self.violations)
+        return out
+
+    def check(self) -> None:
+        problems = self.problems()
+        if problems:
+            raise LockOrderViolation("; ".join(problems))
+
+    # ---- pinned artifact -------------------------------------------------
+
+    def graph(self) -> Dict[str, object]:
+        return {"schema": SCHEMA_VERSION,
+                "nodes": sorted(self._nodes),
+                "edges": self.edges()}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.graph(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def new_edges_vs(self, pinned: Dict[str, object]) -> List[str]:
+        """Witnessed edges absent from a pinned lock_order.json graph —
+        each is a lock nesting no reviewer has signed off on."""
+        allowed = {(src, dst)
+                   for src, dsts in (pinned.get("edges") or {}).items()
+                   for dst in dsts}
+        return sorted(f"{src} -> {dst}"
+                      for src, dsts in self.edges().items()
+                      for dst in dsts if (src, dst) not in allowed)
+
+
+def assert_acyclic(graph: Dict[str, object]) -> None:
+    """Validate a pinned lock_order.json graph is itself a DAG."""
+    witness = LockOrderWitness()
+    with witness._mu:
+        for src, dsts in (graph.get("edges") or {}).items():
+            witness._edges[src] = set(dsts)
+    cycle = witness.find_cycle()
+    if cycle:
+        raise LockOrderViolation(
+            "pinned lock-order graph has a cycle: " + " -> ".join(cycle))

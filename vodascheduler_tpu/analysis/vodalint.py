@@ -1,0 +1,842 @@
+"""vodalint: the project-native concurrency/determinism linter.
+
+Every rule here is an invariant the control plane's correctness rests on
+— deterministic replay (clock discipline), deadlock-free actuation (lock
+discipline), a closed audit vocabulary, locked metric instruments, and
+daemonized/context-propagating threads. Generic linters can't know these
+contracts; this one encodes them over stdlib `ast` with zero
+dependencies, so the invariants that previously lived in
+doc/observability.md prose fail the build instead of a code review.
+
+Usage:
+    python -m vodascheduler_tpu.analysis.vodalint [paths...]
+        [--format text|jsonl] [--baseline FILE] [--write-baseline FILE]
+
+Suppression (inline, per finding line, reason REQUIRED):
+    time.sleep(x)  # vodalint: ignore[clock-discipline] modeled wall pause
+
+A suppression with an empty reason is itself a finding
+(`suppression-empty-reason`), so every accepted exception carries its
+justification in the tree. `--baseline` subtracts a committed set of
+accepted findings (matched on file+rule+message, line-insensitive, so
+unrelated edits don't churn it); `--write-baseline` regenerates it.
+
+Rule catalog with rationale: doc/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ---- rule registry ---------------------------------------------------------
+
+RULES: Dict[str, str] = {
+    "clock-discipline": (
+        "No wall-clock reads/sleeps (time.time, time.sleep, datetime.now/"
+        "utcnow/today) in Clock-injected modules (scheduler/, cluster/, "
+        "obs/, replay/) — they silently break VirtualClock replay "
+        "determinism. Use the injected Clock; time.monotonic() is allowed "
+        "for latency measurement."),
+    "lock-discipline": (
+        "No backend mutator call (start_job/scale_job/stop_job/"
+        "migrate_workers) and no event emit() inside a `with self._lock:`/"
+        "`with self._state_lock:` block in scheduler/ or cluster/ — the "
+        "decide/actuate split's contract; emitting under a lock inverts "
+        "lock order against scheduler→backend calls. Checked through one "
+        "level of self-method indirection (call-graph-lite)."),
+    "vocab": (
+        "Audit vocabulary is closed: every literal reason code "
+        "(_add_reason), trigger (trigger_resched) and span name "
+        "(tracer.span/start_span) must be in obs/audit.py's REASON_CODES/"
+        "TRIGGERS/SPAN_NAMES — and every vocabulary entry must be used "
+        "somewhere in the package (one-sided edits fail)."),
+    "metrics-lock": (
+        "Instrument methods in common/metrics.py must access shared "
+        "mutable state (_values/_value/_sum/_count/_counts/_total/"
+        "_metrics) only under `with self._lock:` — scrapes run "
+        "concurrently with scheduler/daemon writes."),
+    "thread-daemon": (
+        "Every threading.Thread/threading.Timer must be daemonized "
+        "(daemon=True kwarg, or an immediate `.daemon = True` on the "
+        "assigned name) — a non-daemon control-plane thread blocks "
+        "process exit and wedges the tier-1 driver."),
+    "executor-context": (
+        "Executor submissions (.submit) must propagate the tracer "
+        "context into the worker (obs_tracer.use_context/"
+        "current_context in the enclosing function) — the ambient trace "
+        "context is thread-local, and an unpropagated worker orphans "
+        "every downstream span."),
+    "suppression-empty-reason": (
+        "A `# vodalint: ignore[...]` comment must carry a non-empty "
+        "reason after the bracket — accepted exceptions document why."),
+    "parse-error": (
+        "The module failed to parse — nothing in it was checked, so a "
+        "syntax error can never masquerade as a clean lint."),
+}
+
+# Modules whose code runs under an injected Clock (relative to the
+# package root). common/clock.py itself is the Clock implementation and
+# is outside these prefixes by construction.
+CLOCKED_PREFIXES = ("scheduler/", "cluster/", "obs/", "replay/")
+
+# Where the lock-discipline rule applies.
+LOCKED_PREFIXES = ("scheduler/", "cluster/")
+
+# Lock attribute names the lock-discipline rule recognizes.
+LOCK_ATTRS = {"_lock", "_state_lock"}
+
+# The backend mutators that must never run under a scheduler/backend
+# table lock (reads like list_hosts/running_jobs are allowed).
+BACKEND_MUTATORS = {"start_job", "scale_job", "stop_job", "migrate_workers"}
+
+# Shared mutable state of metric instruments (common/metrics.py).
+METRICS_PROTECTED = {"_values", "_value", "_sum", "_count", "_counts",
+                     "_total", "_metrics"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*vodalint:\s*ignore\[([a-z\-,\s]+)\]\s*(.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str       # repo/package-relative path
+    line: int
+    rule: str
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"file": self.path, "line": self.line,
+                "rule": self.rule, "message": self.message}
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        # Line-insensitive: unrelated edits shift lines but should not
+        # churn the accepted baseline.
+        return (self.path, self.rule, self.message)
+
+
+# ---- per-module import tracking -------------------------------------------
+
+
+class _Imports:
+    """Alias maps for the modules/names the rules care about."""
+
+    def __init__(self, tree: ast.AST):
+        self.modules: Dict[str, str] = {}   # local name -> module
+        self.names: Dict[str, str] = {}     # local name -> module.attr
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.modules[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.names[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def flat_call_name(self, func: ast.AST) -> Optional[str]:
+        """Dotted name of a call target with its first segment
+        de-aliased, e.g. `_walltime.sleep` -> `time.sleep`."""
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        else:
+            return None
+        parts.reverse()
+        head = parts[0]
+        if head in self.modules:
+            parts[0] = self.modules[head]
+        elif head in self.names:
+            parts[0] = self.names[head]
+        return ".".join(parts)
+
+
+_BANNED_WALL_CLOCK = {
+    "time.time": "time.time()",
+    "time.sleep": "time.sleep()",
+    "datetime.datetime.now": "datetime.now()",
+    "datetime.datetime.utcnow": "datetime.utcnow()",
+    "datetime.datetime.today": "datetime.today()",
+    "datetime.date.today": "date.today()",
+}
+
+
+# ---- rule implementations --------------------------------------------------
+
+
+def _check_clock_discipline(tree: ast.AST, imports: _Imports,
+                            rel: str, out: List[Finding]) -> None:
+    if not rel.startswith(CLOCKED_PREFIXES):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        flat = imports.flat_call_name(node.func)
+        if flat in _BANNED_WALL_CLOCK:
+            out.append(Finding(rel, node.lineno, "clock-discipline",
+                               f"{_BANNED_WALL_CLOCK[flat]} in a "
+                               f"Clock-injected module; use the injected "
+                               f"Clock (clock.now()/clock.sleep())"))
+
+
+def _is_self_attr(node: ast.AST, attr: str) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == attr
+            and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+
+def _self_method_name(func: ast.AST) -> Optional[str]:
+    """`self.foo` -> 'foo' (the call-graph-lite edge)."""
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"):
+        return func.attr
+    return None
+
+
+def _direct_danger(call: ast.Call) -> Optional[str]:
+    """Why a single call is forbidden under a table lock, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "emit":
+            return "event emit() under a table lock (handler re-enters " \
+                   "the scheduler lock: lock-order inversion)"
+        if func.attr in BACKEND_MUTATORS:
+            value = func.value
+            # self.backend.start_job(...) in the scheduler, or a
+            # backend's own self.scale_job(...) — both block the table.
+            if (_is_self_attr(value, "backend")
+                    or (isinstance(value, ast.Name)
+                        and value.id in ("self", "backend"))):
+                return (f"backend mutator {func.attr}() under a table "
+                        f"lock (can block for a checkpoint drain; "
+                        f"freezes every reader)")
+    return None
+
+
+class _MethodInfo:
+    __slots__ = ("dangers", "callees")
+
+    def __init__(self) -> None:
+        self.dangers: List[Tuple[int, str]] = []   # (line, why)
+        self.callees: Set[str] = set()
+
+
+def _class_method_map(cls: ast.ClassDef) -> Dict[str, _MethodInfo]:
+    """Per-method direct dangers + self-call edges, then a fixpoint so a
+    method 'is dangerous' if anything it (transitively) calls on self
+    is. One file, one class at a time: deliberately 'call-graph-lite'."""
+    methods: Dict[str, _MethodInfo] = {}
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _MethodInfo()
+
+        def collect(node: ast.AST, info: _MethodInfo = info) -> None:
+            # Nested defs/lambdas are DEFERRED work (timer callbacks,
+            # wave tasks): they don't run in this method's frame, so
+            # they contribute no call-graph edges and no dangers here.
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                why = _direct_danger(node)
+                if why is not None:
+                    info.dangers.append((node.lineno, why))
+                callee = _self_method_name(node.func)
+                if callee:
+                    info.callees.add(callee)
+            for child in ast.iter_child_nodes(node):
+                collect(child, info)
+
+        for stmt in item.body:
+            collect(stmt)
+        methods[item.name] = info
+    # Fixpoint: propagate danger through self-call edges.
+    changed = True
+    while changed:
+        changed = False
+        for name, info in methods.items():
+            if info.dangers:
+                continue
+            for callee in info.callees:
+                sub = methods.get(callee)
+                if sub is not None and sub.dangers:
+                    line, why = sub.dangers[0]
+                    info.dangers.append(
+                        (line, f"calls self.{callee}() which {why}"))
+                    changed = True
+                    break
+    return methods
+
+
+def _lock_items(node: ast.With) -> bool:
+    for item in node.items:
+        if (isinstance(item.context_expr, ast.Attribute)
+                and item.context_expr.attr in LOCK_ATTRS
+                and isinstance(item.context_expr.value, ast.Name)
+                and item.context_expr.value.id == "self"):
+            return True
+    return False
+
+
+def _walk_lock_block(stmts: Iterable[ast.stmt], rel: str,
+                     methods: Dict[str, _MethodInfo],
+                     out: List[Finding]) -> None:
+    """Scan a lock block's statements for dangerous calls, NOT
+    descending into nested function/lambda definitions (those are
+    defined under the lock, not executed under it)."""
+    for stmt in stmts:
+        _scan_stmt_for_dangers(stmt, rel, methods, out)
+
+
+def _scan_stmt_for_dangers(stmt: ast.stmt, rel: str,
+                           methods: Dict[str, _MethodInfo],
+                           out: List[Finding]) -> None:
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # defined under the lock, not called under it
+        if isinstance(node, ast.Call):
+            why = _direct_danger(node)
+            if why is not None:
+                out.append(Finding(rel, node.lineno, "lock-discipline",
+                                   why))
+            else:
+                callee = _self_method_name(node.func)
+                if callee and callee in methods and \
+                        methods[callee].dangers:
+                    _, sub_why = methods[callee].dangers[0]
+                    out.append(Finding(
+                        rel, node.lineno, "lock-discipline",
+                        f"self.{callee}() under a table lock: {sub_why}"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(stmt)
+
+
+def _check_lock_discipline(tree: ast.AST, rel: str,
+                           out: List[Finding]) -> None:
+    if not rel.startswith(LOCKED_PREFIXES):
+        return
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = _class_method_map(cls)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.With) and _lock_items(node):
+                _walk_lock_block(node.body, rel, methods, out)
+            # _locked_or_deferred(self._fn, ...) runs its target under
+            # the scheduler lock WHEREVER the call itself sits — check
+            # the referenced mutator's closure too.
+            if (isinstance(node, ast.Call)
+                    and _self_method_name(node.func)
+                    == "_locked_or_deferred" and node.args):
+                target = _self_method_name(node.args[0])
+                if target and target in methods and \
+                        methods[target].dangers:
+                    _, sub_why = methods[target].dangers[0]
+                    out.append(Finding(
+                        rel, node.lineno, "lock-discipline",
+                        f"self.{target}() (via _locked_or_deferred) "
+                        f"runs under the lock: {sub_why}"))
+
+
+def _literal_strings(node: ast.AST) -> Optional[List[Tuple[int, str]]]:
+    """Resolve an expression to its possible string constants (handles
+    plain constants and conditional expressions of constants); None if
+    not statically resolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.lineno, node.value)]
+    if isinstance(node, ast.IfExp):
+        a = _literal_strings(node.body)
+        b = _literal_strings(node.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
+                 out: List[Finding]) -> None:
+    reason_codes = vocab["REASON_CODES"]
+    triggers = vocab["TRIGGERS"]
+    span_names = vocab["SPAN_NAMES"]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name == "_add_reason" and len(node.args) >= 2:
+            for line, code in _literal_strings(node.args[1]) or []:
+                if code not in reason_codes:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"reason code {code!r} not in "
+                        f"obs.audit.REASON_CODES"))
+        elif name == "trigger_resched" and node.args:
+            for line, code in _literal_strings(node.args[0]) or []:
+                if code not in triggers:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"trigger {code!r} not in obs.audit.TRIGGERS"))
+        elif name in ("span", "start_span") and node.args:
+            for line, code in _literal_strings(node.args[0]) or []:
+                if code not in span_names:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"span name {code!r} not in "
+                        f"obs.audit.SPAN_NAMES"))
+
+
+def _check_metrics_lock(tree: ast.AST, rel: str,
+                        out: List[Finding]) -> None:
+    if rel != "common/metrics.py":
+        return
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        has_lock = any(
+            isinstance(n, ast.Attribute) and n.attr == "_lock"
+            and isinstance(getattr(n, "ctx", None), ast.Store)
+            for item in cls.body
+            if isinstance(item, ast.FunctionDef) and item.name == "__init__"
+            for n in ast.walk(item))
+        if not has_lock:
+            # The canonical form of this bug is forgetting the lock
+            # ENTIRELY: a new instrument class touching shared state
+            # with no self._lock would otherwise pass unexamined.
+            for item in cls.body:
+                if (not isinstance(item, ast.FunctionDef)
+                        or item.name == "__init__"):
+                    continue
+                touched = [n for n in ast.walk(item)
+                           if isinstance(n, ast.Attribute)
+                           and n.attr in METRICS_PROTECTED
+                           and isinstance(n.value, ast.Name)
+                           and n.value.id == "self"]
+                if touched:
+                    out.append(Finding(
+                        rel, touched[0].lineno, "metrics-lock",
+                        f"class {cls.name} touches "
+                        f"self.{touched[0].attr} but defines no "
+                        f"self._lock in __init__ — instruments are "
+                        f"scraped concurrently"))
+                    break
+            continue
+        for item in cls.body:
+            if (not isinstance(item, ast.FunctionDef)
+                    or item.name == "__init__"):
+                continue
+            _scan_metrics_method(item, rel, out)
+
+
+def _scan_metrics_method(fn: ast.FunctionDef, rel: str,
+                         out: List[Finding]) -> None:
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With):
+            inner_locked = locked or any(
+                isinstance(i.context_expr, ast.Attribute)
+                and i.context_expr.attr == "_lock"
+                and isinstance(i.context_expr.value, ast.Name)
+                and i.context_expr.value.id == "self"
+                for i in node.items)
+            for i in node.items:
+                visit(i.context_expr, locked)
+            for child in node.body:
+                visit(child, inner_locked)
+            return
+        if (isinstance(node, ast.Attribute)
+                and node.attr in METRICS_PROTECTED
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and not locked):
+            out.append(Finding(
+                rel, node.lineno, "metrics-lock",
+                f"self.{node.attr} accessed outside `with self._lock:` "
+                f"in {fn.name}() — scrapes race this"))
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+
+
+def _check_thread_daemon(tree: ast.AST, imports: _Imports, rel: str,
+                         out: List[Finding]) -> None:
+    def is_thread_call(call: ast.Call) -> bool:
+        flat = imports.flat_call_name(call.func)
+        return flat in ("threading.Thread", "threading.Timer")
+
+    def daemon_kwarg(call: ast.Call) -> bool:
+        return any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in call.keywords)
+
+    def daemonized_later(body: List[ast.stmt], idx: int,
+                         target_names: Set[str]) -> bool:
+        for follow in body[idx + 1:idx + 4]:  # "immediately after"
+            if isinstance(follow, ast.Assign):
+                for t in follow.targets:
+                    if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in target_names
+                            and isinstance(follow.value, ast.Constant)
+                            and follow.value.value is True):
+                        return True
+        return False
+
+    def shallow_calls(stmt: ast.stmt) -> List[ast.Call]:
+        """Calls in this statement's own expressions only — calls inside
+        nested statement blocks are scanned with their own block (so
+        each construction is judged exactly once, against the right
+        following-statements window)."""
+        out: List[ast.Call] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                visit(child)
+
+        visit(stmt)
+        return out
+
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for block in (node.body,
+                      getattr(node, "orelse", []) or [],
+                      getattr(node, "finalbody", []) or []):
+            if not isinstance(block, list):
+                continue
+            for idx, stmt in enumerate(block):
+                for call in [n for n in shallow_calls(stmt)
+                             if is_thread_call(n)]:
+                    if daemon_kwarg(call):
+                        continue
+                    targets: Set[str] = set()
+                    if isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                targets.add(t.id)
+                    if daemonized_later(block, idx, targets):
+                        continue
+                    out.append(Finding(
+                        rel, call.lineno, "thread-daemon",
+                        "threading.Thread/Timer without daemon=True "
+                        "(non-daemon control-plane threads block exit)"))
+
+
+def _check_executor_context(tree: ast.AST, rel: str,
+                            out: List[Finding]) -> None:
+    def fn_propagates(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and node.attr in (
+                    "use_context", "current_context"):
+                return True
+            if isinstance(node, ast.Name) and node.id in (
+                    "use_context", "current_context"):
+                return True
+        return False
+
+    cache: Dict[int, bool] = {}
+
+    def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node]
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"):
+            ok = False
+            for fn in stack:
+                key = id(fn)
+                if key not in cache:
+                    cache[key] = fn_propagates(fn)
+                if cache[key]:
+                    ok = True
+                    break
+            if not ok:
+                out.append(Finding(
+                    rel, node.lineno, "executor-context",
+                    ".submit() without tracer-context propagation "
+                    "(use obs_tracer.use_context(...) in the submitted "
+                    "callable) — worker spans orphan"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(tree, [])
+
+
+# ---- suppression handling --------------------------------------------------
+
+
+def _apply_suppressions(findings: List[Finding], src: str,
+                        rel: str) -> List[Finding]:
+    lines = src.splitlines()
+
+    def suppression_for(lineno: int) -> Optional[Tuple[Set[str], str, int]]:
+        """Same-line suppression, else one inside the contiguous
+        pure-comment block directly above (multi-line reasons).
+        Returns (rules, reason, suppression_line)."""
+        if 1 <= lineno <= len(lines):
+            m = _SUPPRESS_RE.search(lines[lineno - 1])
+            if m:
+                return ({r.strip() for r in m.group(1).split(",")},
+                        m.group(2).strip(), lineno)
+        cand = lineno - 1
+        while 1 <= cand <= len(lines) and \
+                lines[cand - 1].lstrip().startswith("#"):
+            m = _SUPPRESS_RE.search(lines[cand - 1])
+            if m:
+                return ({r.strip() for r in m.group(1).split(",")},
+                        m.group(2).strip(), cand)
+            cand -= 1
+        return None
+
+    out: List[Finding] = []
+    empty_reason_lines: Set[int] = set()
+    for f in findings:
+        sup = suppression_for(f.line)
+        if sup is None or f.rule not in sup[0]:
+            out.append(f)
+            continue
+        rules, reason, sup_line = sup
+        if not reason:
+            if sup_line not in empty_reason_lines:
+                empty_reason_lines.add(sup_line)
+                out.append(Finding(
+                    rel, sup_line, "suppression-empty-reason",
+                    f"suppression of [{f.rule}] has no reason — say why"))
+    return out
+
+
+# ---- entry points ----------------------------------------------------------
+
+
+def _load_vocab() -> Dict[str, frozenset]:
+    from vodascheduler_tpu.obs import audit
+    return {"REASON_CODES": audit.REASON_CODES,
+            "TRIGGERS": audit.TRIGGERS,
+            "SPAN_NAMES": audit.SPAN_NAMES}
+
+
+def lint_source(src: str, rel: str,
+                vocab: Optional[Dict[str, frozenset]] = None,
+                tree: Optional[ast.AST] = None) -> List[Finding]:
+    """Lint one module's source. `rel` is its package-relative path
+    (e.g. 'cluster/gke.py') — it selects which rules apply. Pass a
+    pre-parsed `tree` to avoid re-parsing (lint_package does)."""
+    vocab = vocab or _load_vocab()
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 1, "parse-error",
+                            f"unparseable module: {e.msg}")]
+    imports = _Imports(tree)
+    findings: List[Finding] = []
+    _check_clock_discipline(tree, imports, rel, findings)
+    _check_lock_discipline(tree, rel, findings)
+    _check_vocab(tree, rel, vocab, findings)
+    _check_metrics_lock(tree, rel, findings)
+    _check_thread_daemon(tree, imports, rel, findings)
+    _check_executor_context(tree, rel, findings)
+    findings = _apply_suppressions(findings, src, rel)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_py_files(root: str, rel_root: Optional[str] = None
+                   ) -> Iterable[Tuple[str, str]]:
+    rel_root = rel_root or root
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                yield full, os.path.relpath(full, rel_root).replace(
+                    os.sep, "/")
+
+
+def _rel_root(root: str) -> str:
+    """The directory rel paths are computed against. Linting a
+    SUBDIRECTORY of the installed package must still see package-rooted
+    rel paths ('cluster/gke.py', not 'gke.py') or every path-scoped rule
+    silently disables itself; fixture trees outside the package keep
+    their own root (so a tmp tree with a cluster/ dir exercises the
+    cluster rules)."""
+    pkg = _package_dir()
+    try:
+        if os.path.commonpath([root, pkg]) == pkg:
+            return pkg
+    except ValueError:
+        pass  # different drives (windows) — fall through
+    return root
+
+
+def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
+    """Lint the whole package, including the reverse vocabulary check
+    (every REASON_CODES/TRIGGERS/SPAN_NAMES entry must be used as a
+    string literal somewhere outside obs/audit.py). The reverse sweep
+    only runs when the linted tree actually carries the vocabulary
+    module — linting a partial tree must not declare everything dead."""
+    pkg_dir = os.path.abspath(pkg_dir or _package_dir())
+    rel_root = _rel_root(pkg_dir)
+    vocab = _load_vocab()
+    findings: List[Finding] = []
+    used_literals: Set[str] = set()
+    audit_rel = "obs/audit.py"
+    # Reverse sweep only when the linted tree ITSELF carries the vocab
+    # module — a subdirectory lint sees a fraction of the literals and
+    # must not declare the rest of the vocabulary dead.
+    has_vocab_module = os.path.exists(
+        os.path.join(pkg_dir, "obs", "audit.py"))
+    for full, rel in _iter_py_files(pkg_dir, rel_root):
+        with open(full, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "parse-error",
+                                    f"unparseable module: {e.msg}"))
+            continue
+        findings.extend(lint_source(src, rel, vocab, tree=tree))
+        if rel != audit_rel:
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    used_literals.add(node.value)
+    if not has_vocab_module:
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+    for vocab_name, entries in (("REASON_CODES", vocab["REASON_CODES"]),
+                                ("TRIGGERS", vocab["TRIGGERS"]),
+                                ("SPAN_NAMES", vocab["SPAN_NAMES"])):
+        for entry in sorted(entries):
+            if entry not in used_literals:
+                findings.append(Finding(
+                    audit_rel, 1, "vocab",
+                    f"{vocab_name} entry {entry!r} is used nowhere in "
+                    f"the package — dead vocabulary"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---- baseline --------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Accepted findings as a MULTISET (key -> count): identical
+    violations repeat their key (every time.time() in one file shares a
+    message), and a set would let one baselined finding mask every
+    future identical one in that file."""
+    keys: Dict[Tuple[str, str, str], int] = {}
+    if not os.path.exists(path):
+        return keys
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            key = (rec["file"], rec["rule"], rec["message"])
+            keys[key] = keys.get(key, 0) + 1
+    return keys
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        for finding in findings:
+            f.write(json.dumps(finding.to_dict(), sort_keys=True) + "\n")
+
+
+def subtract_baseline(findings: List[Finding],
+                      baseline: Dict[Tuple[str, str, str], int]
+                      ) -> List[Finding]:
+    remaining = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        key = f.baseline_key()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+# ---- CLI -------------------------------------------------------------------
+
+
+def run(paths: List[str], fmt: str = "text",
+        baseline: Optional[str] = None,
+        write_baseline_path: Optional[str] = None,
+        stream=None) -> int:
+    stream = stream or sys.stdout
+    findings: List[Finding] = []
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isdir(path):
+            findings.extend(lint_package(path))
+        else:
+            rel = os.path.relpath(path, _package_dir()).replace(os.sep, "/")
+            if rel.startswith(".."):
+                rel = os.path.basename(path)
+            with open(path, encoding="utf-8") as f:
+                findings.extend(lint_source(f.read(), rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if write_baseline_path:
+        write_baseline(write_baseline_path, findings)
+        print(f"wrote {len(findings)} accepted finding(s) to "
+              f"{write_baseline_path}", file=stream)
+        return 0
+    if baseline:
+        findings = subtract_baseline(findings, load_baseline(baseline))
+    for f in findings:
+        if fmt == "jsonl":
+            print(json.dumps(f.to_dict(), sort_keys=True), file=stream)
+        else:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}",
+                  file=stream)
+    if fmt == "text":
+        print(f"vodalint: {len(findings)} finding(s)", file=stream)
+    return 1 if findings else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vodalint",
+        description="Voda's project-native concurrency/determinism "
+                    "linter (doc/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or package dirs (default: the "
+                             "installed vodascheduler_tpu package)")
+    parser.add_argument("--format", choices=("text", "jsonl"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help="JSONL baseline of accepted findings to "
+                             "subtract")
+    parser.add_argument("--write-baseline", default=None,
+                        help="regenerate the baseline from current "
+                             "findings and exit 0")
+    args = parser.parse_args(argv)
+    paths = args.paths or [_package_dir()]
+    return run(paths, fmt=args.format, baseline=args.baseline,
+               write_baseline_path=args.write_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

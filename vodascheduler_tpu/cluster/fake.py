@@ -243,6 +243,9 @@ class FakeClusterBackend(ClusterBackend):
         (serializing the sleeps would turn a parallel wave back into the
         sum the wave exists to avoid)."""
         if self.actuation_latency_seconds > 0:
+            # vodalint: ignore[clock-discipline] models the REAL blocking
+            # round trip of a backend call; a clock.sleep would advance
+            # virtual time and break the max-vs-sum wave pinning
             time.sleep(self.actuation_latency_seconds)
 
     def _start_job_traced(self, spec: JobSpec, num_workers: int,

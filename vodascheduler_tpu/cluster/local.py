@@ -42,6 +42,7 @@ from vodascheduler_tpu.cluster.backend import (
     ResizePath,
 )
 from vodascheduler_tpu import config
+from vodascheduler_tpu.common.clock import Clock
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
 from vodascheduler_tpu.cluster.backend import spec_dict_with_trace
@@ -72,8 +73,14 @@ class LocalBackend(ClusterBackend):
                  host_name: str = "localhost",
                  stop_grace_seconds: Optional[float] = None,
                  poll_interval_seconds: float = 0.2,
-                 topology: Optional[object] = None):
+                 topology: Optional[object] = None,
+                 clock: Optional[Clock] = None):
         self.workdir = os.path.abspath(workdir)
+        # Event timestamps go through the injected Clock so a
+        # VirtualClock harness sees virtual-time stamps — the
+        # clock-discipline invariant vodalint enforces. (Subprocess
+        # pacing stays wall-clock: it waits on a real OS process.)
+        self.clock = clock or Clock()
         self.metrics_dir = metrics_dir or os.path.join(self.workdir, "metrics")
         self.hermetic_devices = hermetic_devices
         self.host_name = host_name
@@ -259,6 +266,10 @@ class LocalBackend(ClusterBackend):
                 return False
             if proc.popen.poll() is not None:
                 return False  # died mid-request: cold path handles it
+            # vodalint: ignore[clock-discipline] paces a REAL subprocess
+            # ack poll (monotonic deadline): under a VirtualClock,
+            # clock.sleep would busy-spin and fire unrelated virtual
+            # timers re-entrantly from this backend thread
             time.sleep(min(0.05, self.poll_interval_seconds))
         return False
 
@@ -299,7 +310,8 @@ class LocalBackend(ClusterBackend):
                 if code == 0:
                     self._specs.pop(name, None)
                     self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED,
-                                           name, timestamp=time.time()))
+                                           name,
+                                           timestamp=self.clock.now()))
                 else:
                     # Includes a PREEMPTED exit the backend did not request
                     # (external SIGTERM): surface it rather than stranding
@@ -311,7 +323,7 @@ class LocalBackend(ClusterBackend):
                               else f"exit code {code}")
                     self.emit(ClusterEvent(
                         ClusterEventKind.JOB_FAILED, name,
-                        detail=detail, timestamp=time.time()))
+                        detail=detail, timestamp=self.clock.now()))
             with self._lock:
                 # Idle-exit decided under the same lock that registers new
                 # processes, so a job started after the poll above cannot be
@@ -320,7 +332,9 @@ class LocalBackend(ClusterBackend):
                 if not self._procs:
                     self._monitor = None
                     return
-            time.sleep(self.poll_interval_seconds)
+            # Interruptible pause: close() wakes the monitor immediately
+            # instead of letting it finish a poll-interval sleep.
+            self._closed.wait(self.poll_interval_seconds)
 
     def close(self) -> None:
         """Stop all jobs (checkpoints preserved) and the monitor."""

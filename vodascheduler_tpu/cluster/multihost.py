@@ -51,6 +51,7 @@ from vodascheduler_tpu.cluster.backend import (
     ResizePath,
     spec_dict_with_trace,
 )
+from vodascheduler_tpu.common.clock import Clock
 from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.types import PREEMPTED_EXIT_CODE
 from vodascheduler_tpu.obs import tracer as obs_tracer
@@ -82,8 +83,12 @@ class MultiHostBackend(ClusterBackend):
                  metrics_dir: Optional[str] = None,
                  stop_grace_seconds: Optional[float] = None,
                  poll_interval_seconds: float = 0.2,
-                 topology: Optional[object] = None):
+                 topology: Optional[object] = None,
+                 clock: Optional[Clock] = None):
         self.workdir = os.path.abspath(workdir)
+        # Event timestamps come from the injected Clock (vodalint
+        # clock-discipline): a VirtualClock harness gets virtual stamps.
+        self.clock = clock or Clock()
         self.metrics_dir = metrics_dir or os.path.join(self.workdir, "metrics")
         self.hosts = dict(hosts) if hosts is not None else {
             f"host-{i}": chips_per_host for i in range(num_hosts)}
@@ -175,7 +180,7 @@ class MultiHostBackend(ClusterBackend):
         with self._lock:
             self.hosts[name] = chips
         self.emit(ClusterEvent(ClusterEventKind.HOST_ADDED, name,
-                               timestamp=time.time()))
+                               timestamp=self.clock.now()))
 
     def remove_host(self, name: str) -> None:
         """Remove a host; jobs with processes on it die like on a real
@@ -187,7 +192,7 @@ class MultiHostBackend(ClusterBackend):
         for j in doomed:
             self._stop_set(j)  # checkpointed stop; scheduler restarts it
         self.emit(ClusterEvent(ClusterEventKind.HOST_REMOVED, name,
-                               timestamp=time.time()))
+                               timestamp=self.clock.now()))
 
     # ---- process management ----------------------------------------------
 
@@ -341,16 +346,18 @@ class MultiHostBackend(ClusterBackend):
             for name in completed:
                 self._specs.pop(name, None)
                 self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED, name,
-                                       timestamp=time.time()))
+                                       timestamp=self.clock.now()))
             for name, detail in failed:
                 self._specs.pop(name, None)
                 self.emit(ClusterEvent(ClusterEventKind.JOB_FAILED, name,
-                                       detail=detail, timestamp=time.time()))
+                                       detail=detail, timestamp=self.clock.now()))
             with self._lock:
                 if not self._jobs:
                     self._monitor = None
                     return
-            time.sleep(self.poll_interval_seconds)
+            # Interruptible pause: close() wakes the monitor
+            # immediately instead of finishing a full interval.
+            self._closed.wait(self.poll_interval_seconds)
 
     def _reap_locked(self, name: str, pset: _ProcSet) -> None:
         """Kill a job's remaining processes after one of them failed."""

@@ -274,9 +274,14 @@ def _merge_labels(const: Dict[str, str], names: Tuple[str, ...],
 class Registry:
     def __init__(self) -> None:
         self._metrics: List[object] = []
+        # Multi-pool apps register instruments while scrape threads run
+        # exposition(): same locked-access contract as the instruments
+        # themselves (vodalint metrics-lock).
+        self._lock = threading.Lock()
 
     def register(self, metric):
-        self._metrics.append(metric)
+        with self._lock:
+            self._metrics.append(metric)
         return metric
 
     def counter(self, name: str, help_: str, labels: Tuple[str, ...] = (),
@@ -310,7 +315,9 @@ class Registry:
         headers: Dict[str, List[str]] = {}
         samples: Dict[str, List[str]] = {}
         order: List[str] = []
-        for m in self._metrics:
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
             name = m.name
             if name not in samples:
                 order.append(name)

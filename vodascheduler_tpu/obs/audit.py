@@ -61,6 +61,21 @@ REASON_CODES = frozenset({
     "reverted_release_failure",  # pass aborted: booking reverted wholesale
 })
 
+# Every span name the package may emit (the trace file's third closed
+# vocabulary, alongside TRIGGERS and REASON_CODES). Enforced statically
+# by vodalint's `vocab` rule — NOT by validate_record, because tests
+# legitimately build throwaway spans with scratch names; what must stay
+# closed is what *shipped code* emits. A new boundary adds its span name
+# HERE (and to doc/observability.md) before it can ship.
+SPAN_NAMES = frozenset({
+    "resched",               # scheduler: one pass's root span
+    "allocator.allocate",
+    "placement.place",
+    "job.start", "job.scale", "job.halt", "job.migrate",
+    "backend.start", "backend.scale", "backend.stop",
+    "supervisor.start", "supervisor.resize",
+})
+
 _REQUIRED_AUDIT_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
                           "triggers", "algorithm", "total_chips", "queue",
                           "deltas", "duration_ms")

@@ -189,14 +189,19 @@ class VodaApp:
                                     "VODA_NAMESPACE", DEFAULT_NAMESPACE),
                                 topology=ps.topology,
                                 pool="" if single else ps.name,
-                                pod_metrics_dir=pod_metrics)
+                                pod_metrics_dir=pod_metrics,
+                                clock=self.clock)
                 be.metrics_dir = os.path.join(
                     self.workdir, *pod_metrics.split("/")[2:])
                 os.makedirs(be.metrics_dir, exist_ok=True)
             else:
+                # The backends stamp events with the SAME injected clock
+                # as the scheduler — one time base across the app
+                # (vodalint clock-discipline; a private Clock() fallback
+                # here would silently drift a future virtual-time mode).
                 be = LocalBackend(jobs_dir, chips=pool_chips,
                                   hermetic_devices=hermetic_devices,
-                                  topology=ps.topology)
+                                  topology=ps.topology, clock=self.clock)
             pm = PlacementManager(pool_id=ps.name, topology=ps.topology,
                                   registry=self.registry)
             sched = Scheduler(
