@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline lock-order bench bench-dryrun trace-dryrun native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-selftest lock-order bench bench-dryrun trace-dryrun native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -36,6 +36,31 @@ lint:
 lint-baseline:
 	$(PY) -m vodascheduler_tpu.analysis.vodalint vodascheduler_tpu \
 		--write-baseline vodalint_baseline.jsonl
+
+# vodacheck: the static transition audit (doc/static-analysis.md) —
+# every job.status store goes through lifecycle.transition(), every
+# transition() call names a declared TRANSITIONS edge, every declared
+# edge is used, and every backend claim has a dominating booking
+# release on its exception edge. No baseline, no suppressions.
+vodacheck:
+	$(PY) -m vodascheduler_tpu.analysis.vodacheck vodascheduler_tpu
+
+# Bounded exhaustive model check: BFS the REAL scheduler + fake backend
+# + VirtualClock over every interleaving of events and injected faults
+# up to the bounded profile (3 jobs / 2 hosts / depth 12, a few
+# thousand states, seconds). Prints state/transition counts and FAILS
+# if fewer than 2,000 states were explored (the bound can't silently
+# collapse) or any invariant breaks — the counterexample is a
+# deterministic, replayable action list.
+modelcheck:
+	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
+		--profile bounded
+
+# Prove the checker has teeth: every seeded-bug scheduler variant must
+# be caught AND its counterexample must replay deterministically.
+modelcheck-selftest:
+	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
+		--selftest
 
 # Regenerate the pinned lock-acquisition-order artifact
 # (doc/lock_order.json) from a witnessed concurrency-stress run.

@@ -87,13 +87,19 @@ class TestApisDoc:
         vocabulary, and the retention knobs."""
         with open(os.path.join(REPO, "doc", "observability.md")) as f:
             doc = f.read()
-        from vodascheduler_tpu.obs import REASON_CODES, TRIGGERS
-        for code in sorted(REASON_CODES) + sorted(TRIGGERS):
+        from vodascheduler_tpu.obs import (
+            REASON_CODES,
+            STATUS_REASONS,
+            TRIGGERS,
+        )
+        for code in (sorted(REASON_CODES) + sorted(TRIGGERS)
+                     + sorted(STATUS_REASONS)):
             assert code in doc, f"reason/trigger {code!r} undocumented"
         for knob in ("VODA_TRACE_DIR", "VODA_TRACE_RING",
                      "VODA_TRACE_MAX_MB"):
             assert knob in doc, f"retention knob {knob} undocumented"
-        for kind in ("resched_audit", "span", "http_access"):
+        for kind in ("resched_audit", "span", "http_access",
+                     "status_transition", "modelcheck_counterexample"):
             assert kind in doc, f"record kind {kind} undocumented"
 
     def test_observability_doc_covers_concurrency_model(self):
@@ -111,18 +117,80 @@ class TestApisDoc:
             assert term in doc, f"concurrency-model term {term!r} missing"
 
 
+def _modelcheck_invariants():
+    from vodascheduler_tpu.analysis import modelcheck
+    return modelcheck.INVARIANTS
+
+
+class TestLifecycleDoc:
+    """doc/design/lifecycle.md is pinned against the live transition
+    table — both directions, same pattern as vodalint.RULES."""
+
+    def _doc(self):
+        with open(os.path.join(REPO, "doc", "design",
+                               "lifecycle.md")) as f:
+            return f.read()
+
+    def test_every_declared_edge_documented(self):
+        from vodascheduler_tpu.common.lifecycle import TRANSITIONS
+        doc = self._doc()
+        for (frm, to), spec in TRANSITIONS.items():
+            edge = f"`{frm.value} -> {to.value}`"
+            assert edge in doc, f"edge {edge} undocumented"
+            row = next(ln for ln in doc.splitlines() if edge in ln)
+            for reason in spec.reasons:
+                assert f"`{reason}`" in row, \
+                    f"{edge}: reason {reason!r} missing from its row"
+
+    def test_no_documented_edge_is_undeclared(self):
+        from vodascheduler_tpu.common.lifecycle import TRANSITIONS
+        from vodascheduler_tpu.common.types import JobStatus
+        doc = self._doc()
+        documented = set(re.findall(r"`(\w+) -> (\w+)`", doc))
+        assert documented, "no edges found in lifecycle.md"
+        live = {(f.value, t.value) for (f, t) in TRANSITIONS}
+        stale = documented - live
+        assert not stale, f"documented but undeclared edges: {stale}"
+        for frm, to in documented:
+            JobStatus(frm), JobStatus(to)  # raises on a typo'd status
+
+    def test_contracts_documented(self):
+        doc = self._doc()
+        for term in ("TRANSITIONS", "transition(", "BookingLedger",
+                     "commit_pass", "release", "InvalidTransition",
+                     "status_transition", "STATUS_REASONS",
+                     "recovery_pending", "self-loop"):
+            assert term in doc, f"lifecycle contract term {term!r} missing"
+
+
 class TestStaticAnalysisDoc:
     def test_rule_catalog_matches_linter_registry(self):
-        """doc/static-analysis.md documents every vodalint rule id, and
-        names no rule the linter doesn't have."""
+        """doc/static-analysis.md documents every vodalint AND vodacheck
+        rule id, and names no rule neither tool has."""
         with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:
             doc = f.read()
-        from vodascheduler_tpu.analysis import vodalint
+        from vodascheduler_tpu.analysis import vodacheck, vodalint
         for rule in vodalint.RULES:
-            assert f"`{rule}`" in doc, f"rule {rule!r} undocumented"
-        documented = set(re.findall(r"\| `([a-z\-]+)` \|", doc))
-        unknown = documented - set(vodalint.RULES)
-        assert not unknown, f"documented but not in RULES: {unknown}"
+            assert f"`{rule}`" in doc, f"vodalint rule {rule!r} undocumented"
+        for rule in vodacheck.RULES:
+            assert f"`{rule}`" in doc, f"vodacheck rule {rule!r} undocumented"
+        documented = set(re.findall(r"\| `([a-z\-_]+)` \|", doc))
+        known = (set(vodalint.RULES) | set(vodacheck.RULES)
+                 | set(_modelcheck_invariants()))
+        unknown = documented - known
+        assert not unknown, f"documented but not in any registry: {unknown}"
+
+    def test_modelcheck_invariants_documented(self):
+        """The invariant catalog is pinned like the rule catalogs:
+        every modelcheck.INVARIANTS id appears in static-analysis.md."""
+        with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:
+            doc = f.read()
+        for inv in _modelcheck_invariants():
+            assert f"`{inv}`" in doc, f"invariant {inv!r} undocumented"
+        for target in ("make vodacheck", "make modelcheck",
+                       "modelcheck-selftest", "replay_counterexample",
+                       "2,000"):
+            assert target in doc, f"{target!r} missing"
 
     def test_suppression_syntax_and_artifacts_documented(self):
         with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:

@@ -1,0 +1,265 @@
+"""The reified job lifecycle: the transition table's shape, the
+transition() API contract (including the explicit self-loop policy —
+the regression for the old `_set_status`-style silent same-status
+no-op), the BookingLedger, and the scheduler-level audit trail."""
+
+import pytest
+
+from vodascheduler_tpu.common import lifecycle
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
+from vodascheduler_tpu.common.lifecycle import (
+    BookingContractViolation,
+    BookingLedger,
+    InvalidTransition,
+    TRANSITIONS,
+    transition,
+)
+from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
+
+
+def make_job(name="j", status=JobStatus.SUBMITTED):
+    spec = JobSpec(name=name, config=JobConfig(min_num_chips=1,
+                                               max_num_chips=4, epochs=2))
+    job = TrainingJob.from_spec(spec, submit_time=0.0)
+    job.status = status
+    return job
+
+
+def ring_tracer():
+    return obs_tracer.Tracer(clock=VirtualClock(start=100.0))
+
+
+class TestTransitionTable:
+    def test_every_reason_is_in_the_closed_vocabulary(self):
+        for spec in TRANSITIONS.values():
+            assert spec.reasons <= obs_audit.STATUS_REASONS
+
+    def test_terminal_states_have_no_outgoing_edges(self):
+        for (frm, to) in TRANSITIONS:
+            assert not frm.is_terminal, (frm, to)
+
+    def test_submitted_is_the_birth_state(self):
+        assert not any(to is JobStatus.SUBMITTED for _, to in TRANSITIONS)
+
+    def test_self_loop_policy_is_explicit(self):
+        """Satellite regression: the allowed self-loops are exactly the
+        crash-resume re-assertions; everything else is undeclared (and
+        transition() raises on it, instead of silently no-opping like
+        the old same-status guard did)."""
+        loops = {(f, t) for (f, t) in TRANSITIONS if f == t}
+        assert loops == {(JobStatus.WAITING, JobStatus.WAITING),
+                         (JobStatus.RUNNING, JobStatus.RUNNING)}
+
+
+class TestTransitionApi:
+    def test_valid_transition_changes_status_and_emits(self):
+        tracer = ring_tracer()
+        job = make_job()
+        changed = transition(job, JobStatus.WAITING, reason="accepted",
+                             chips=0, tracer=tracer, pool="p")
+        assert changed and job.status == JobStatus.WAITING
+        recs = tracer.records(kind="status_transition")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert (rec["from"], rec["to"]) == ("Submitted", "Waiting")
+        assert rec["reason"] == "accepted" and rec["pool"] == "p"
+        assert obs_audit.validate_record(rec) == []
+
+    def test_undeclared_edge_raises(self):
+        job = make_job()  # Submitted
+        with pytest.raises(InvalidTransition):
+            transition(job, JobStatus.RUNNING, reason="scheduled",
+                       tracer=ring_tracer())
+        assert job.status == JobStatus.SUBMITTED  # unchanged on raise
+
+    def test_undeclared_reason_raises(self):
+        job = make_job(status=JobStatus.WAITING)
+        with pytest.raises(InvalidTransition):
+            transition(job, JobStatus.RUNNING, reason="completed",
+                       chips=2, tracer=ring_tracer())
+
+    def test_allowed_self_loop_emits_and_returns_false(self):
+        """The other half of the satellite regression: a DECLARED
+        self-loop (resume re-assertion) emits its audit record — the
+        trail the silent no-op used to drop."""
+        tracer = ring_tracer()
+        job = make_job(status=JobStatus.WAITING)
+        changed = transition(job, JobStatus.WAITING, reason="resume",
+                             chips=0, tracer=tracer)
+        assert changed is False
+        recs = tracer.records(kind="status_transition")
+        assert len(recs) == 1 and recs[0]["from"] == recs[0]["to"]
+        assert obs_audit.validate_record(recs[0]) == []
+
+    def test_undeclared_self_loop_raises(self):
+        job = make_job(status=JobStatus.COMPLETED)
+        with pytest.raises(InvalidTransition):
+            transition(job, JobStatus.COMPLETED, reason="completed",
+                       tracer=ring_tracer())
+
+    def test_booking_contract_nonzero(self):
+        job = make_job(status=JobStatus.WAITING)
+        with pytest.raises(BookingContractViolation):
+            transition(job, JobStatus.RUNNING, reason="scheduled",
+                       chips=0, tracer=ring_tracer())
+
+    def test_booking_contract_zero(self):
+        job = make_job(status=JobStatus.RUNNING)
+        with pytest.raises(BookingContractViolation):
+            transition(job, JobStatus.WAITING, reason="preempted",
+                       chips=3, tracer=ring_tracer())
+
+    def test_omitted_chips_skips_the_contract(self):
+        job = make_job(status=JobStatus.RUNNING)
+        assert transition(job, JobStatus.CANCELED, reason="user_delete",
+                          tracer=ring_tracer())
+
+    def test_validator_rejects_undeclared_edge_record(self):
+        rec = {"kind": "status_transition", "schema": 1, "ts": 1.0,
+               "pool": "p", "job": "j", "from": "Completed",
+               "to": "Running", "reason": "scheduled"}
+        problems = obs_audit.validate_record(rec)
+        assert any("undeclared transition" in p for p in problems)
+
+    def test_validator_rejects_unknown_reason(self):
+        rec = {"kind": "status_transition", "schema": 1, "ts": 1.0,
+               "pool": "p", "job": "j", "from": "Waiting",
+               "to": "Running", "reason": "vibes"}
+        problems = obs_audit.validate_record(rec)
+        assert any("unknown status reason" in p for p in problems)
+
+
+class TestBookingLedger:
+    def test_mapping_reads_and_dict_equality(self):
+        ledger = BookingLedger({"a": 2})
+        ledger.commit("b", 3)
+        assert ledger["a"] == 2 and ledger.get("c") == 0
+        assert "b" in ledger and len(ledger) == 2
+        assert sorted(ledger) == ["a", "b"]
+        assert dict(ledger) == {"a": 2, "b": 3}
+        assert ledger == {"a": 2, "b": 3}
+        assert ledger != {"a": 2}
+        assert sum(ledger.values()) == 5
+        assert set(ledger.items()) == {("a", 2), ("b", 3)}
+
+    def test_release_returns_freed_chips(self):
+        ledger = BookingLedger({"a": 4})
+        assert ledger.release("a") == 4
+        assert ledger.release("a") == 0
+        assert ledger == {}
+
+    def test_commit_pass_replaces_wholesale(self):
+        ledger = BookingLedger({"a": 4, "b": 1})
+        ledger.commit_pass({"b": 2, "c": 1})
+        assert ledger == {"b": 2, "c": 1}
+
+    def test_negative_bookings_rejected(self):
+        ledger = BookingLedger()
+        with pytest.raises(ValueError):
+            ledger.commit("a", -1)
+        with pytest.raises(ValueError):
+            ledger.commit_pass({"a": -2})
+
+
+class TestSchedulerAuditTrail:
+    """Integration: the scheduler's whole lifecycle leaves a validated
+    status_transition trail in its tracer ring."""
+
+    def _world(self, tracer, store=None, backend=None, resume=False):
+        from vodascheduler_tpu.allocator import ResourceAllocator
+        from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+        from vodascheduler_tpu.common.events import EventBus
+        from vodascheduler_tpu.common.store import JobStore
+        from vodascheduler_tpu.placement import PlacementManager
+        from vodascheduler_tpu.scheduler import Scheduler
+        from vodascheduler_tpu.service import AdmissionService
+
+        clock = tracer.clock
+        store = store if store is not None else JobStore()
+        bus = EventBus()
+        if backend is None:
+            backend = FakeClusterBackend(clock,
+                                         restart_overhead_seconds=1.0)
+            backend.add_host("h0", 4, announce=False)
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus,
+                          placement_manager=PlacementManager("pool"),
+                          rate_limit_seconds=1.0, tracer=tracer,
+                          resume=resume)
+        admission = AdmissionService(store, bus, clock)
+        return clock, store, backend, sched, admission
+
+    def test_full_lifecycle_trail_validates(self):
+        tracer = ring_tracer()
+        clock, store, backend, sched, admission = self._world(tracer)
+        name = admission.create_training_job(
+            JobSpec(name="j", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=4,
+                                     epochs=1)))
+        clock.advance(3600.0)
+        assert store.get_job(name).status == JobStatus.COMPLETED
+        recs = tracer.records(kind="status_transition")
+        trail = [(r["from"], r["to"], r["reason"]) for r in recs
+                 if r["job"] == name]
+        assert trail == [("Submitted", "Waiting", "accepted"),
+                         ("Waiting", "Running", "scheduled"),
+                         ("Running", "Completed", "completed")]
+        for r in recs:
+            assert obs_audit.validate_record(r) == []
+
+    def test_duplicate_create_event_is_idempotent(self):
+        tracer = ring_tracer()
+        clock, store, backend, sched, admission = self._world(tracer)
+        name = admission.create_training_job(
+            JobSpec(name="j", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=4,
+                                     epochs=5)))
+        created = sched.m_jobs_created.value()
+        sched.create_training_job(name)  # re-delivered announcement
+        assert sched.m_jobs_created.value() == created
+        accepted = [r for r in tracer.records(kind="status_transition")
+                    if r["reason"] == "accepted"]
+        assert len(accepted) == 1
+
+    def test_create_redelivered_after_terminal_is_dropped(self):
+        """A create event re-delivered after the job already finished
+        must be ignored, not raise an undeclared terminal -> Waiting
+        transition — and must not lose the events queued behind it."""
+        tracer = ring_tracer()
+        clock, store, backend, sched, admission = self._world(tracer)
+        name = admission.create_training_job(
+            JobSpec(name="j", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=4,
+                                     epochs=1)))
+        clock.advance(3600.0)
+        assert store.get_job(name).status == JobStatus.COMPLETED
+        sched.create_training_job(name)  # stale re-delivery
+        assert store.get_job(name).status == JobStatus.COMPLETED
+        assert name not in sched.ready_jobs
+
+    def test_resume_reassertion_emits_self_loop_records(self):
+        """Scheduler-level satellite regression: crash resume
+        re-asserts each job's status as a DECLARED self-loop that
+        emits — the audit trail shows the re-assertion instead of
+        silence."""
+        tracer = ring_tracer()
+        clock, store, backend, sched, admission = self._world(tracer)
+        running = admission.create_training_job(
+            JobSpec(name="longjob", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=4,
+                                     epochs=500)))
+        clock.advance(10.0)
+        assert store.get_job(running).status == JobStatus.RUNNING
+
+        tracer2 = obs_tracer.Tracer(clock=clock)
+        clock2, store2, backend2, sched2, _ = self._world(
+            tracer2, store=store, backend=backend, resume=True)
+        recs = [r for r in tracer2.records(kind="status_transition")
+                if r["reason"] == "resume"]
+        assert [(r["from"], r["to"]) for r in recs
+                if r["job"] == running] == [("Running", "Running")]
+        for r in recs:
+            assert obs_audit.validate_record(r) == []

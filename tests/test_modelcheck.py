@@ -1,0 +1,177 @@
+"""The small-scope model checker: the real scheduler holds every
+invariant over the bounded profile (≥ 2,000 states, the CI gate), the
+seeded-bug variants are each CAUGHT with a deterministic replayable
+counterexample, and exploration itself is deterministic."""
+
+import json
+
+import pytest
+
+from vodascheduler_tpu.analysis import modelcheck
+from vodascheduler_tpu.analysis.modelcheck import (
+    JobShape,
+    ModelConfig,
+    bounded_config,
+    deep_config,
+    explore,
+    replay_counterexample,
+)
+from vodascheduler_tpu.obs import audit as obs_audit
+
+
+def small_config(**overrides) -> ModelConfig:
+    base = dict(
+        jobs=(JobShape("j0", min_chips=1, max_chips=4, epochs=1),
+              JobShape("j1", min_chips=2, max_chips=4, epochs=1)),
+        hosts=(("host-0", 4),),
+        depth=6,
+        max_states=300,
+        faults=("start",),
+        deletable=("j0",),
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+class TestBoundedProfile:
+    def test_real_scheduler_holds_invariants_at_scale(self):
+        """The acceptance criterion: the bounded profile passes on main
+        AND explores non-trivially (≥ 2,000 unique states) so the bound
+        cannot silently collapse."""
+        result = explore(bounded_config())
+        assert result.counterexample is None, json.dumps(
+            result.counterexample, indent=1)
+        assert result.states >= modelcheck.MIN_BOUNDED_STATES
+        assert result.transitions > result.states
+        assert result.leaves_drained > 0
+
+    def test_exploration_is_deterministic(self):
+        r1 = explore(small_config())
+        r2 = explore(small_config())
+        assert (r1.states, r1.transitions, r1.leaves_drained) == \
+            (r2.states, r2.transitions, r2.leaves_drained)
+        assert r1.counterexample is None and r2.counterexample is None
+
+
+class TestSeededBugs:
+    """The checker's teeth: deliberately broken scheduler variants must
+    be caught, and their counterexamples must replay."""
+
+    def test_keep_booking_on_revert_caught(self):
+        result = explore(bounded_config(variant="keep-booking-on-revert"))
+        ce = result.counterexample
+        assert ce is not None
+        assert ce["violation"].startswith("waiting_holds_chips")
+        # The failing interleaving necessarily armed the start fault
+        # whose revert path carries the seeded bug.
+        assert any(a == "fault:start" for a in ce["path"])
+
+    def test_eager_free_on_delete_caught(self):
+        result = explore(bounded_config(variant="eager-free-on-delete"))
+        ce = result.counterexample
+        assert ce is not None
+        assert ce["violation"].startswith("double_booked_host")
+        assert any(a.startswith("delete:") for a in ce["path"])
+
+    def test_counterexample_replays_deterministically(self):
+        result = explore(bounded_config(variant="keep-booking-on-revert"))
+        ce = result.counterexample
+        first = replay_counterexample(ce)
+        second = replay_counterexample(ce)
+        assert first and first == second
+        assert any(p.startswith("waiting_holds_chips") for p in first)
+
+    def test_counterexample_survives_json_round_trip(self):
+        """The record is a plain replayable artifact: through JSON and
+        back, it still reproduces."""
+        result = explore(bounded_config(variant="eager-free-on-delete"))
+        rec = json.loads(json.dumps(result.counterexample))
+        problems = replay_counterexample(rec)
+        assert any(p.startswith("double_booked_host") for p in problems)
+
+    def test_counterexample_satisfies_the_closed_schema(self):
+        result = explore(bounded_config(variant="keep-booking-on-revert"))
+        assert obs_audit.validate_record(result.counterexample) == []
+
+
+class TestWorldMechanics:
+    def test_config_round_trips(self):
+        cfg = bounded_config()
+        assert ModelConfig.from_dict(
+            json.loads(json.dumps(cfg.to_dict()))) == cfg
+
+    def test_fingerprint_ignores_absolute_time(self):
+        w1 = modelcheck._World(small_config())
+        w2 = modelcheck._World(small_config())
+        w2.clock.advance(1e-7)  # below any timer; logical state equal
+        assert w1.fingerprint() == w2.fingerprint()
+
+    def test_fault_actions_disabled_until_first_submit(self):
+        w = modelcheck._World(small_config())
+        assert not any(a.startswith("fault:") for a in w.enabled())
+        w.apply("submit:j0")
+        assert any(a.startswith("fault:") for a in w.enabled())
+
+    def test_drain_reaches_quiescence_on_clean_run(self):
+        w = modelcheck._World(small_config())
+        w.apply("submit:j0")
+        assert w.drain() == []
+        assert "j0" in w.backend.completed
+
+
+class TestFaultInjection:
+    """The fake backend's deterministic fault hooks (the chaos plane's
+    unit of adversity, ROADMAP item 5)."""
+
+    def test_one_shot_start_fault(self):
+        from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+        from vodascheduler_tpu.common.clock import VirtualClock
+        from vodascheduler_tpu.common.job import JobConfig, JobSpec
+
+        backend = FakeClusterBackend(VirtualClock(start=0.0))
+        backend.add_host("h", 4, announce=False)
+        spec = JobSpec(name="x", config=JobConfig(min_num_chips=1,
+                                                  max_num_chips=4,
+                                                  epochs=1))
+        backend.inject_fault("start")
+        assert backend.armed_faults() == ["start"]
+        with pytest.raises(RuntimeError, match="injected backend fault"):
+            backend.start_job(spec, 2)
+        assert backend.armed_faults() == []
+        backend.start_job(spec, 2)  # one-shot: second attempt succeeds
+        assert "x" in backend.running_jobs()
+
+    def test_ack_fault_applies_then_raises(self):
+        from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+        from vodascheduler_tpu.common.clock import VirtualClock
+        from vodascheduler_tpu.common.job import JobConfig, JobSpec
+
+        backend = FakeClusterBackend(VirtualClock(start=0.0))
+        backend.add_host("h", 4, announce=False)
+        spec = JobSpec(name="x", config=JobConfig(min_num_chips=1,
+                                                  max_num_chips=4,
+                                                  epochs=1))
+        backend.start_job(spec, 2)
+        backend.inject_fault("scale_ack")
+        with pytest.raises(RuntimeError):
+            backend.scale_job("x", 4)
+        # The resize APPLIED before the ack crashed: backend truth
+        # diverged from what the caller saw.
+        assert backend.running_jobs()["x"].num_workers == 4
+
+    def test_unknown_fault_kind_rejected(self):
+        from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+        from vodascheduler_tpu.common.clock import VirtualClock
+
+        backend = FakeClusterBackend(VirtualClock(start=0.0))
+        with pytest.raises(ValueError):
+            backend.inject_fault("gremlins")
+
+
+@pytest.mark.slow
+class TestDeepProfile:
+    def test_deep_profile_holds_invariants(self):
+        result = explore(deep_config())
+        assert result.counterexample is None, json.dumps(
+            result.counterexample, indent=1)
+        assert result.states >= 4 * modelcheck.MIN_BOUNDED_STATES

@@ -258,7 +258,7 @@ class TestStitchedTraceRoundTrip:
         # Force a smaller live size so the next pass computes a small grow
         # inside the (infinite) cooldown window — the hysteresis gate must
         # fire and record which way it went.
-        sched.job_num_chips[a] = 6
+        sched.job_num_chips.commit(a, 6)
         backend.jobs[a].num_workers = 6
         sched._last_resize_at[a] = clock.now()
         sched.trigger_resched("manual")

@@ -1,0 +1,265 @@
+"""vodacheck: the static transition audit — per-rule fixtures, the live
+tree, and the re-introduction guarantee (a reverted `job.status =` store
+or a blinded booking-release path in scheduler.py must fail the build
+again)."""
+
+import io
+import json
+import os
+import textwrap
+
+from vodascheduler_tpu.analysis import vodacheck
+from vodascheduler_tpu.common.lifecycle import TRANSITIONS
+from vodascheduler_tpu.common.types import JobStatus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "vodascheduler_tpu")
+
+
+def findings(src: str, rel: str):
+    return vodacheck.check_source(textwrap.dedent(src), rel)
+
+
+def rules_of(fs):
+    return [f.rule for f in fs]
+
+
+class TestTransitionLiteral:
+    def test_valid_call_clean(self):
+        assert findings("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job, tracer):
+                lifecycle.transition(job, JobStatus.RUNNING,
+                                     reason="scheduled", tracer=tracer)
+            """, "scheduler/x.py") == []
+
+    def test_conditional_target_resolved(self):
+        # The crash-resume idiom: both literal arms are checked.
+        assert findings("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job, n):
+                lifecycle.transition(
+                    job,
+                    JobStatus.RUNNING if n > 0 else JobStatus.WAITING,
+                    reason="resume")
+            """, "scheduler/x.py") == []
+
+    def test_unknown_reason_for_target_flagged(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job):
+                lifecycle.transition(job, JobStatus.RUNNING,
+                                     reason="completed")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["transition-literal"]
+        assert "completed" in fs[0].message
+
+    def test_target_with_no_inbound_edge_flagged(self):
+        # Nothing transitions INTO Submitted — it is the birth state.
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job):
+                lifecycle.transition(job, JobStatus.SUBMITTED,
+                                     reason="resume")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["transition-literal"]
+        assert "no declared transition" in fs[0].message
+
+    def test_nonliteral_target_is_itself_a_finding(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            def f(job, to):
+                lifecycle.transition(job, to, reason="resume")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["transition-literal"]
+        assert "not a literal" in fs[0].message
+
+    def test_nonliteral_reason_is_itself_a_finding(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job, why):
+                lifecycle.transition(job, JobStatus.RUNNING, reason=why)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["transition-literal"]
+
+
+class TestTransitionCoverage:
+    def test_live_table_fully_claimed(self):
+        # check_package on the real tree (below) already proves this;
+        # here the unit form documents the mechanism.
+        claims = set()
+        for (frm, to), spec in TRANSITIONS.items():
+            for r in spec.reasons:
+                claims.add((to, r))
+        assert vodacheck._coverage_findings(TRANSITIONS, claims) == []
+
+    def test_unclaimed_edge_flagged(self):
+        claims = {(to, r) for (frm, to), spec in TRANSITIONS.items()
+                  for r in spec.reasons if to is not JobStatus.CANCELED}
+        fs = vodacheck._coverage_findings(TRANSITIONS, claims)
+        assert fs and all(f.rule == "transition-unused" for f in fs)
+        assert all("Canceled" in f.message for f in fs)
+
+    def test_package_level_coverage_on_fixture_tree(self, tmp_path):
+        """End to end: a tree that declares the lifecycle module but
+        only ever starts jobs leaves every other edge dead."""
+        pkg = tmp_path / "pkg"
+        (pkg / "common").mkdir(parents=True)
+        (pkg / "common" / "lifecycle.py").write_text("# tables\n")
+        (pkg / "scheduler").mkdir()
+        (pkg / "scheduler" / "s.py").write_text(textwrap.dedent("""
+            from vodascheduler_tpu.common import lifecycle
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job):
+                lifecycle.transition(job, JobStatus.RUNNING,
+                                     reason="scheduled")
+            """))
+        fs = vodacheck.check_package(str(pkg))
+        dead = [f for f in fs if f.rule == "transition-unused"]
+        assert dead
+        # The claimed edge is covered; unclaimed ones are dead.
+        assert not any("'Waiting' -> 'Running'" in f.message
+                       for f in dead)
+        assert any("'Canceled'" in f.message for f in dead)
+
+    def test_fixture_tree_without_lifecycle_skips_coverage(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        (pkg / "scheduler").mkdir(parents=True)
+        (pkg / "scheduler" / "s.py").write_text("x = 1\n")
+        assert vodacheck.check_package(str(pkg)) == []
+
+
+class TestBookingRelease:
+    def test_unprotected_claim_flagged(self):
+        fs = findings("""
+            class S:
+                def go(self, spec, n):
+                    self.backend.start_job(spec, n)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["booking-release"]
+
+    def test_locally_protected_claim_clean(self):
+        assert findings("""
+            class S:
+                def go(self, spec, n, name):
+                    try:
+                        self.backend.scale_job(name, n)
+                    except Exception:
+                        self.job_num_chips.commit(name, 0)
+            """, "scheduler/x.py") == []
+
+    def test_caller_protected_claim_clean(self):
+        assert findings("""
+            class S:
+                def _start(self, spec, n):
+                    self.backend.start_job(spec, n)
+                def apply(self, spec, n, name):
+                    try:
+                        self._start(spec, n)
+                    except Exception:
+                        self._revert(name)
+                def _revert(self, name):
+                    self.job_num_chips.commit(name, 0)
+            """, "scheduler/x.py") == []
+
+    def test_one_unprotected_call_site_flagged(self):
+        fs = findings("""
+            class S:
+                def _start(self, spec, n):
+                    self.backend.start_job(spec, n)
+                def safe(self, spec, n, name):
+                    try:
+                        self._start(spec, n)
+                    except Exception:
+                        self.job_num_chips.release(name)
+                def unsafe(self, spec, n):
+                    self._start(spec, n)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["booking-release"]
+        assert "unsafe" in fs[0].message
+
+    def test_handler_without_ledger_write_flagged(self):
+        fs = findings("""
+            class S:
+                def go(self, spec, n):
+                    try:
+                        self.backend.start_job(spec, n)
+                    except Exception:
+                        pass
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["booking-release"]
+
+    def test_release_side_stop_exempt(self):
+        # stop_job RELEASES chips; a failed stop deliberately keeps the
+        # booking for the retry.
+        assert findings("""
+            class S:
+                def go(self, name):
+                    self.backend.stop_job(name)
+            """, "scheduler/x.py") == []
+
+    def test_rule_scoped_to_scheduler(self):
+        assert findings("""
+            class B:
+                def go(self, spec, n):
+                    self.backend.start_job(spec, n)
+            """, "cluster/x.py") == []
+
+
+class TestLiveTree:
+    def test_package_checks_clean(self):
+        fs = vodacheck.check_package(PKG)
+        assert fs == [], "\n".join(
+            f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in fs)
+
+    def test_reintroduced_status_store_fails(self):
+        """The acceptance criterion: revert one of the eight removed
+        `job.status =` sites (in memory) — vodacheck must fail."""
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        broken = src + (
+            "\n\ndef _backslide(job):\n"
+            "    job.status = JobStatus.WAITING\n")
+        fs = vodacheck.check_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "status-store" for f in fs)
+
+    def test_undeclared_transition_reason_fails(self):
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        broken = src.replace('reason="scheduled"', 'reason="because"')
+        assert broken != src
+        fs = vodacheck.check_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "transition-literal"
+                   and "because" in f.message for f in fs)
+
+    def test_blinding_a_booking_release_fails(self):
+        """Append a claim path with no dominating release to the REAL
+        Scheduler class — the exception-edge contract must fail."""
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        # scheduler.py ends inside `class Scheduler`; this continues it.
+        broken = src + (
+            "\n    def _unreleased_claim(self, spec, n):\n"
+            "        self.backend.start_job(spec, n)\n")
+        fs = vodacheck.check_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "booking-release"
+                   and "_unreleased_claim" in f.message for f in fs)
+
+    def test_cli_jsonl_output(self, tmp_path):
+        bad = tmp_path / "pkg" / "scheduler"
+        bad.mkdir(parents=True)
+        (bad / "x.py").write_text(
+            "class S:\n    def go(self, spec, n):\n"
+            "        self.backend.start_job(spec, n)\n")
+        out = io.StringIO()
+        rc = vodacheck.run([str(tmp_path / "pkg")], fmt="jsonl",
+                           stream=out)
+        assert rc == 1
+        recs = [json.loads(line) for line in
+                out.getvalue().strip().splitlines()]
+        assert recs and recs[0]["rule"] == "booking-release"

@@ -450,3 +450,134 @@ class TestBaselineAndCli:
     def test_rule_registry_has_descriptions(self):
         for rule, doc in vodalint.RULES.items():
             assert doc and len(doc) > 20, rule
+
+
+class TestStatusStore:
+    """Satellite of the lifecycle PR: direct `job.status` stores outside
+    common/lifecycle.py are findings (the tentpole refactor removed all
+    of them, so the rule ships with a zero-entry baseline)."""
+
+    def test_job_status_store_flagged_anywhere_in_package(self):
+        fs = findings("""
+            from vodascheduler_tpu.common.types import JobStatus
+            def f(job):
+                job.status = JobStatus.WAITING
+            """, "benchrunner/x.py")
+        assert rules_of(fs) == ["status-store"]
+
+    def test_laundered_store_flagged_in_strict_modules(self):
+        # No JobStatus literal in sight — but scheduler/service/replay
+        # are strict: any non-self .status store is a lifecycle bypass.
+        fs = findings("""
+            def f(job, status):
+                job.status = status
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["status-store"]
+
+    def test_laundered_store_out_of_scope_elsewhere(self):
+        assert findings("""
+            def f(job, status):
+                job.status = status
+            """, "benchrunner/x.py") == []
+
+    def test_self_status_store_clean(self):
+        # obs spans set self.status = "ok"/"error" — their own field,
+        # not a job lifecycle store.
+        assert findings("""
+            class Span:
+                def ok(self):
+                    self.status = "ok"
+            """, "obs/x.py") == []
+
+    def test_lifecycle_module_is_the_one_blessed_store(self):
+        assert findings("""
+            from vodascheduler_tpu.common.types import JobStatus
+            def transition(job, to):
+                job.status = to
+                job.status = JobStatus.WAITING
+            """, "common/lifecycle.py") == []
+
+    def test_reintroducing_raw_status_store_in_scheduler_fails(self):
+        """The re-introduction guarantee: put one of the eight removed
+        `job.status =` sites back and the build fails again."""
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        # The tentpole refactor held: stores are gone (comparisons
+        # like `job.status == ...` remain and are fine).
+        assert "job.status = JobStatus" not in src
+        broken = src + (
+            "\n\ndef _backslide(job):\n"
+            "    job.status = JobStatus.WAITING\n")
+        fs = vodalint.lint_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "status-store" for f in fs)
+
+    def test_rule_ships_with_zero_entry_baseline(self):
+        """The committed baseline must not accept ANY status-store
+        finding — the refactor removed every site, and new ones must
+        fail, not baseline away."""
+        baseline = vodalint.load_baseline(
+            os.path.join(REPO, "vodalint_baseline.jsonl"))
+        assert not any(rule == "status-store"
+                       for (_, rule, _) in baseline)
+
+
+class TestStatusReasonVocab:
+    """STATUS_REASONS joins the closed vocabularies: unknown codes fail
+    at the call site, unused codes fail the reverse sweep."""
+
+    def test_unknown_status_reason_flagged(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            def f(job, to):
+                lifecycle.transition(job, to, reason="vibes")
+            """, "scheduler/x.py")
+        assert "vocab" in rules_of(fs)
+        assert any("vibes" in f.message for f in fs)
+
+    def test_known_status_reason_clean(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            def f(job, to):
+                lifecycle.transition(job, to, reason="preempted")
+            """, "scheduler/x.py")
+        assert "vocab" not in rules_of(fs)
+
+    def test_conditional_status_reasons_both_checked(self):
+        fs = findings("""
+            from vodascheduler_tpu.common import lifecycle
+            def f(job, to, done):
+                lifecycle.transition(
+                    job, to, reason="completed" if done else "imploded")
+            """, "scheduler/x.py")
+        assert any(f.rule == "vocab" and "imploded" in f.message
+                   for f in fs)
+
+    def test_unused_status_reason_fails_reverse_sweep(self, tmp_path):
+        """Declaration sites (audit.py's vocab, lifecycle.py's
+        TRANSITIONS) do NOT count as usage — only call sites do."""
+        pkg = tmp_path / "pkg"
+        (pkg / "obs").mkdir(parents=True)
+        (pkg / "obs" / "audit.py").write_text("# vocab lives here\n")
+        (pkg / "common").mkdir()
+        # lifecycle.py declares every reason — and must not satisfy
+        # the sweep by itself.
+        (pkg / "common" / "lifecycle.py").write_text(
+            'TRANSITIONS = {"x": ("accepted", "scheduled", "preempted",'
+            ' "backend_lost", "resume", "completed", "failed",'
+            ' "user_delete")}\n')
+        (pkg / "scheduler").mkdir()
+        (pkg / "scheduler" / "s.py").write_text(
+            'class S:\n    def g(self, job, lifecycle, to):\n'
+            '        lifecycle.transition(job, to, reason="accepted")\n')
+        fs = vodalint.lint_package(str(pkg))
+        dead = [f.message for f in fs
+                if "STATUS_REASONS" in f.message
+                and "used nowhere" in f.message]
+        # "accepted" is genuinely used; the rest are dead despite the
+        # lifecycle.py declarations.
+        assert dead and not any("'accepted'" in m for m in dead)
+        assert any("'preempted'" in m for m in dead)
+
+    def test_live_tree_uses_every_status_reason(self):
+        fs = vodalint.lint_package(PKG)
+        assert not any("STATUS_REASONS" in f.message for f in fs)

@@ -10,18 +10,30 @@ prose. This package machine-checks them:
   dependencies) with a rule registry and per-rule inline suppressions
   (`# vodalint: ignore[rule-id] reason`). Run as
   `python -m vodascheduler_tpu.analysis.vodalint` or `make lint`.
+- `vodacheck`: the static transition audit over the reified job
+  lifecycle (common/lifecycle.py) — status stores, transition-literal
+  pairs, edge coverage, and the booking release-on-failure contract.
+  Run as `python -m vodascheduler_tpu.analysis.vodacheck` or
+  `make vodacheck`.
+- `modelcheck`: an exhaustive small-scope model checker driving the
+  REAL Scheduler + FakeClusterBackend + VirtualClock through every
+  bounded interleaving of events and injected faults, with replayable
+  counterexamples. Run as `make modelcheck` (bounded CI profile) /
+  `make modelcheck-selftest` (seeded-bug teeth).
 - `lockwitness`: a runtime lock-order witness tier-1 tests opt into —
   it records the global lock-acquisition-order graph, fails on cycles
   and on locks held across backend calls, and pins the witnessed graph
   as doc/lock_order.json.
 
-Rule catalog and artifact formats: doc/static-analysis.md.
+Rule catalogs, the invariant catalog, and artifact formats:
+doc/static-analysis.md; the transition relation itself:
+doc/design/lifecycle.md.
 """
 
-# NOTE: vodalint is deliberately NOT imported here — it doubles as the
-# `python -m vodascheduler_tpu.analysis.vodalint` entry point, and an
-# eager package import would shadow the runpy execution (RuntimeWarning,
-# two module objects). Import it explicitly where needed.
+# NOTE: vodalint/vodacheck/modelcheck are deliberately NOT imported
+# here — each doubles as a `python -m ...` entry point, and an eager
+# package import would shadow the runpy execution (RuntimeWarning, two
+# module objects). Import them explicitly where needed.
 from vodascheduler_tpu.analysis.lockwitness import (  # noqa: F401
     LockOrderViolation,
     LockOrderWitness,

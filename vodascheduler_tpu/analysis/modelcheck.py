@@ -1,0 +1,750 @@
+"""Exhaustive small-scope model checker for scheduler invariants.
+
+vodacheck (static) proves every status store takes a declared edge;
+this module proves the *composition* — the real `Scheduler`, the real
+`FakeClusterBackend`, the real `PlacementManager`, all under a
+`VirtualClock` — keeps its booking/status invariants across every
+bounded interleaving of events and injected faults, not just the
+hand-written scenario tests.
+
+Small-scope hypothesis (Alloy's bet, applied to the control plane):
+most scheduler bugs manifest within a handful of jobs, hosts and
+events. The checker runs a breadth-first search over *action
+sequences* (submit, delete, advance-to-next-timer, host churn, a
+deterministic one-shot backend fault, an event-storm burst) from a
+bounded `ModelConfig` (≤4 jobs, ≤2 hosts, depth ≤ ~12). States are
+deduplicated on a logical fingerprint (statuses, bookings, backend
+truth, armed faults — not absolute clock values, the documented
+abstraction), and each frontier node is reconstructed by replaying its
+action prefix from scratch: no snapshotting, no pickling of live locks,
+and — critically — every explored state is *reachable by construction*
+and every counterexample is a plain replayable action list.
+
+After every action the checker asserts:
+
+- `double_booked_host` / `placement_oversubscribed`: no live host runs
+  more chips than it has (backend truth) and no placement slot count
+  goes negative;
+- `running_zero_chips` / `waiting_holds_chips`: a RUNNING job books > 0
+  chips, a WAITING job books exactly 0 (the booking contract
+  `lifecycle.TRANSITIONS` declares, observed live);
+- `terminal_holds_booking`: done jobs hold nothing in the ledger;
+- `lease_monotonicity`: cumulative time accounting never runs
+  backwards and the preemption lease never goes negative;
+
+and at every depth-bound leaf it *drains* (advances through timers
+until the fingerprint is stable) and asserts:
+
+- `non_quiescent`: the drain reaches a fixed point at all;
+- `stranded_job`: no stable state leaves a WAITING job unscheduled
+  with enough free chips and no pending pass (the phantom-running
+  failure class found live in r5).
+
+A violation produces a `modelcheck_counterexample` record (closed
+schema, obs/audit.py) emitted through the obs plane and returned to
+the caller; `replay_counterexample()` re-executes it deterministically.
+
+Profiles: `bounded` runs in CI (`make modelcheck`, a few thousand
+states, seconds — the CLI *fails* if fewer than `min_states` states
+were explored, so the bound can't silently collapse); `deep` is the
+`slow`-marked tier-2 sweep.
+
+`VARIANTS` carries deliberately-buggy Scheduler subclasses — the
+seeded-bug fixtures proving the checker has teeth (tests/
+test_modelcheck.py): each must be caught with a deterministic
+counterexample, and `--selftest` re-proves it from the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import sys
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, WorkloadProfile
+from vodascheduler_tpu.common import lifecycle
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
+from vodascheduler_tpu.placement import PlacementManager
+from vodascheduler_tpu.scheduler import Scheduler
+
+# The invariant catalog (documented in doc/static-analysis.md; the
+# per-step checks and the drain checks reference these ids verbatim).
+INVARIANTS: Dict[str, str] = {
+    "double_booked_host": (
+        "No live host runs more chips than it has: for every host in "
+        "the backend's fleet, the chips of running jobs placed on it "
+        "sum to at most its capacity."),
+    "placement_oversubscribed": (
+        "The placement manager's per-host free-slot accounting never "
+        "goes negative."),
+    "running_zero_chips": (
+        "Every RUNNING job books at least one chip in the ledger."),
+    "waiting_holds_chips": (
+        "Every WAITING job books exactly zero chips — an unreleased "
+        "booking strands capacity (phantom-running, found live in r5)."),
+    "terminal_holds_booking": (
+        "Completed/failed/canceled jobs hold nothing in the ledger."),
+    "lease_monotonicity": (
+        "Cumulative time accounting (running/waiting/chip/total "
+        "seconds) never decreases, and the preemption lease "
+        "(seconds_since_restart) never goes negative."),
+    "non_quiescent": (
+        "Every explored path reaches a stable state: draining the "
+        "timer queue converges to a fingerprint fixed point."),
+    "stranded_job": (
+        "No stable state leaves a WAITING job unscheduled while enough "
+        "chips sit free and no pass is pending."),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobShape:
+    """One bounded job: elasticity bounds + length."""
+
+    name: str
+    min_chips: int = 1
+    max_chips: int = 4
+    epochs: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """One bounded configuration — everything a replay needs.
+
+    `faults` is the injected-fault alphabet (FakeClusterBackend
+    FAULT_KINDS); `churn_hosts` lists hosts the search may take down /
+    bring back; `deletable` lists jobs the search may cancel. Keeping
+    these explicit keeps the branching factor—and therefore the state
+    space—engineered, not accidental."""
+
+    jobs: Tuple[JobShape, ...]
+    hosts: Tuple[Tuple[str, int], ...]
+    depth: int = 10
+    max_states: int = 3000
+    faults: Tuple[str, ...] = ("start", "scale")
+    churn_hosts: Tuple[str, ...] = ()
+    deletable: Tuple[str, ...] = ()
+    storm: bool = False
+    algorithm: str = "ElasticFIFO"
+    rate_limit_seconds: float = 1.0
+    restart_overhead_seconds: float = 2.0
+    epoch_seconds: float = 8.0
+    variant: str = "default"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["jobs"] = [dataclasses.asdict(j) for j in self.jobs]
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ModelConfig":
+        d = dict(d)
+        d["jobs"] = tuple(JobShape(**j) for j in d["jobs"])
+        d["hosts"] = tuple((h, int(c)) for h, c in d["hosts"])
+        for key in ("faults", "churn_hosts", "deletable"):
+            d[key] = tuple(d.get(key, ()))
+        return ModelConfig(**d)
+
+
+# ---- seeded-bug fixtures (the checker's teeth) -----------------------------
+
+
+class _KeepBookingOnRevert(Scheduler):
+    """Seeded bug: the start-failure revert forgets BOTH the booking
+    release and the status revert — exactly the phantom-running class
+    the r5 incident was. The checker must catch `waiting_holds_chips`
+    on any interleaving that arms a start fault."""
+
+    def _revert_to_waiting(self, name: str) -> None:
+        pass  # seeded bug: booking survives the failed claim
+
+
+class _EagerFreeOnDelete(Scheduler):
+    """Seeded bug: a delete frees the chips at delete-accept and stops
+    the backend on a timer — the drain window in which the next pass
+    places a new job onto slots the dying job still occupies. The
+    checker must catch `double_booked_host` on submit→delete→submit."""
+
+    _EAGER_STOP_GRACE_SECONDS = 5.0
+
+    def _delete_job_locked(self, name: str) -> List[str]:
+        job = self.ready_jobs.pop(name, None)
+        if job is None:
+            return []
+        chips = self.job_num_chips.release(name)
+        lifecycle.transition(job, JobStatus.CANCELED, reason="user_delete",
+                             tracer=self.tracer, pool=self.pool_id)
+        job.finish_time = self.clock.now()
+        self.store.update_job(job)
+        self.done_jobs[name] = job
+        self.m_jobs_deleted.inc()
+        if chips > 0:
+            # SEEDED BUG: no _stops_in_flight reservation, no drain
+            # before the trigger — the backend keeps running the job
+            # until this timer fires, but its chips look free now.
+            self.clock.call_later(self._EAGER_STOP_GRACE_SECONDS,
+                                  lambda: self._eager_stop(name))
+        return ["job_deleted"]
+
+    def _eager_stop(self, name: str) -> None:
+        try:
+            self.backend.stop_job(name)
+        except Exception:  # noqa: BLE001 - fixture: mirror best-effort stop
+            pass
+
+
+VARIANTS: Dict[str, type] = {
+    "default": Scheduler,
+    "keep-booking-on-revert": _KeepBookingOnRevert,
+    "eager-free-on-delete": _EagerFreeOnDelete,
+}
+
+
+# ---- the executable world --------------------------------------------------
+
+
+class Violation(Exception):
+    def __init__(self, problems: List[str], step: int, action: str):
+        super().__init__(f"step {step} ({action}): {problems}")
+        self.problems = problems
+        self.step = step
+        self.action = action
+
+
+class _World:
+    """One live control plane built from a ModelConfig, plus the action
+    alphabet, the fingerprint, and the invariant checks."""
+
+    START = 1753760000.0
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+        self.clock = VirtualClock(start=self.START)
+        self.tracer = obs_tracer.Tracer(clock=self.clock, ring_size=64)
+        self.store = JobStore()
+        self.bus = EventBus()
+        self.backend = FakeClusterBackend(
+            self.clock,
+            restart_overhead_seconds=config.restart_overhead_seconds)
+        for host, chips in config.hosts:
+            self.backend.add_host(host, chips, announce=False)
+        for shape in config.jobs:
+            self.backend.register_profile(
+                shape.name,
+                WorkloadProfile(epoch_seconds_at_1=config.epoch_seconds))
+        self.pm = PlacementManager("mc-pool")
+        self.allocator = ResourceAllocator(self.store)
+        cls = VARIANTS[config.variant]
+        self.sched: Scheduler = cls(
+            "mc-pool", self.backend, self.store, self.allocator,
+            self.clock, bus=self.bus, placement_manager=self.pm,
+            algorithm=config.algorithm,
+            rate_limit_seconds=config.rate_limit_seconds,
+            tracer=self.tracer)
+        self._specs = {
+            shape.name: JobSpec(
+                name=shape.name, pool="mc-pool",
+                config=JobConfig(min_num_chips=shape.min_chips,
+                                 max_num_chips=shape.max_chips,
+                                 epochs=shape.epochs))
+            for shape in config.jobs}
+        self.submitted: set = set()
+        self.deleted: set = set()
+        self.down_hosts: set = set()
+        self._host_chips = dict(config.hosts)
+        self._prev_metrics: Dict[str, Tuple[float, ...]] = {}
+
+    # -- actions ------------------------------------------------------------
+
+    def enabled(self) -> List[str]:
+        acts = ["advance"]
+        unsubmitted = [s.name for s in self.config.jobs
+                       if s.name not in self.submitted]
+        # Symmetry reduction: jobs are interchangeable until submitted,
+        # so only the NEXT unsubmitted job is offered (submitting j2
+        # before j1 explores a relabeling of the same space).
+        if unsubmitted:
+            acts.append(f"submit:{unsubmitted[0]}")
+        for name in self.config.deletable:
+            if name in self.submitted and name not in self.deleted \
+                    and name in self.sched.ready_jobs:
+                acts.append(f"delete:{name}")
+        if self.submitted:
+            armed = set(self.backend.armed_faults())
+            for kind in self.config.faults:
+                if kind not in armed:
+                    acts.append(f"fault:{kind}")
+        for host in self.config.churn_hosts:
+            if host in self.down_hosts:
+                acts.append(f"host_up:{host}")
+            elif len(self.backend.list_hosts()) > 1:
+                acts.append(f"host_down:{host}")
+        if self.config.storm and len(unsubmitted) > 1:
+            acts.append("storm")
+        return acts
+
+    def apply(self, action: str) -> None:
+        kind, _, arg = action.partition(":")
+        if kind == "submit":
+            self._submit(arg)
+        elif kind == "delete":
+            self.deleted.add(arg)
+            self.sched.delete_training_job(arg)
+        elif kind == "advance":
+            nxt = self.clock.next_timer()
+            if nxt is None:
+                self.clock.advance(self.config.rate_limit_seconds)
+            else:
+                self.clock.advance_to(max(nxt, self.clock.now()) + 1e-6)
+        elif kind == "fault":
+            self.backend.inject_fault(arg)
+        elif kind == "host_down":
+            self.down_hosts.add(arg)
+            self.backend.remove_host(arg)
+        elif kind == "host_up":
+            self.down_hosts.discard(arg)
+            self.backend.add_host(arg, self._host_chips[arg])
+        elif kind == "storm":
+            # Event-storm burst: every remaining job submitted in one
+            # no-time-passing volley — the coalescing/rate-limit path.
+            for shape in self.config.jobs:
+                if shape.name not in self.submitted:
+                    self._submit(shape.name)
+        else:
+            raise ValueError(f"unknown action {action!r}")
+
+    def _submit(self, name: str) -> None:
+        job = TrainingJob.from_spec(self._specs[name],
+                                    submit_time=self.clock.now())
+        self.store.insert_job(job)
+        self.submitted.add(name)
+        self.sched.create_training_job(name)
+
+    # -- fingerprint --------------------------------------------------------
+
+    def fingerprint(self) -> Tuple:
+        """The logical state, independent of absolute clock values (two
+        paths reaching the same logical state at different times merge —
+        the small-scope abstraction this checker is honest about)."""
+        sched, backend = self.sched, self.backend
+        booked = tuple(sorted(sched.job_num_chips.snapshot().items()))
+        ready = tuple(sorted(
+            (n, j.status.value, j.priority)
+            for n, j in sched.ready_jobs.items()))
+        done = tuple(sorted(
+            (n, j.status.value) for n, j in sched.done_jobs.items()))
+        with backend._state_lock:
+            bjobs = tuple(sorted(
+                (n, sim.num_workers, tuple(sorted(sim.placements)),
+                 sim.epochs_done)
+                for n, sim in backend.jobs.items()))
+        hosts = tuple(sorted(backend.list_hosts().items()))
+        faults = tuple(backend.armed_faults())
+        flags = (sched.resched_pending,
+                 # recovery_pending ⊃ resched_pending: a retry armed as
+                 # a bare clock timer must NOT merge with the same-
+                 # looking state without one, or BFS prunes exactly the
+                 # interleavings where the recovery window matters.
+                 sched.recovery_pending,
+                 tuple(sorted(sched._stops_in_flight.items())),
+                 tuple(sorted(self.submitted)),
+                 tuple(sorted(self.deleted)),
+                 tuple(sorted(backend.completed)),
+                 tuple(sorted(backend.failed)))
+        return (booked, ready, done, bjobs, hosts, faults, flags)
+
+    # -- invariants ---------------------------------------------------------
+
+    def check(self) -> List[str]:
+        problems: List[str] = []
+        sched, backend = self.sched, self.backend
+        booked = sched.job_num_chips.snapshot()
+        hosts = backend.list_hosts()
+        with backend._state_lock:
+            live = {n: (sim.num_workers, list(sim.placements))
+                    for n, sim in backend.jobs.items()}
+        per_host: Dict[str, int] = {}
+        for name, (workers, placements) in live.items():
+            if workers <= 0:
+                continue
+            for host, slots in placements:
+                per_host[host] = per_host.get(host, 0) + slots
+        # A backend overlap is legal exactly while the scheduler still
+        # owns a corrective step for it (failed scale/migrate → re-book
+        # from live truth → retry pass re-places); once recovery_pending
+        # clears, an overlap is a genuine double-book. The excuse is
+        # per host, not global: it applies only where some overlapping
+        # job's LIVE placement diverges from the placement manager's
+        # intent (the divergence the retry exists to fix) — an overlap
+        # among jobs that all sit exactly where placement put them is a
+        # real double-book even mid-recovery (and would equally surface
+        # as placement_oversubscribed). Checked per step here AND at
+        # every drain step, so a strand that outlives its recovery is
+        # always caught.
+        recovering = sched.recovery_pending
+        for host, used in sorted(per_host.items()):
+            if host not in hosts or used <= hosts[host]:
+                continue
+            if recovering and any(
+                    self._live_diverges_from_intent(name, placements)
+                    for name, (workers, placements) in live.items()
+                    if workers > 0 and any(h == host
+                                           for h, _ in placements)):
+                continue
+            problems.append(
+                f"double_booked_host: {host} runs {used} chips "
+                f"of {hosts[host]}")
+        for name, state in sorted(self.pm.host_states.items()):
+            if state.free_slots < 0:
+                problems.append(
+                    f"placement_oversubscribed: {name} free_slots="
+                    f"{state.free_slots}")
+        for name, job in sorted(sched.ready_jobs.items()):
+            chips = booked.get(name, 0)
+            if job.status == JobStatus.RUNNING and chips <= 0:
+                problems.append(f"running_zero_chips: {name}")
+            if job.status == JobStatus.WAITING and chips != 0:
+                problems.append(
+                    f"waiting_holds_chips: {name} books {chips}")
+        for name in sorted(sched.done_jobs):
+            if booked.get(name, 0) != 0:
+                problems.append(
+                    f"terminal_holds_booking: {name} books "
+                    f"{booked[name]}")
+        for name, job in sorted(sched.ready_jobs.items()):
+            m = job.metrics
+            if m.seconds_since_restart < 0:
+                problems.append(f"lease_monotonicity: {name} lease "
+                                f"{m.seconds_since_restart}")
+            cur = (m.running_seconds, m.waiting_seconds, m.chip_seconds,
+                   m.total_seconds)
+            prev = self._prev_metrics.get(name)
+            if prev is not None and any(c < p - 1e-9
+                                        for c, p in zip(cur, prev)):
+                problems.append(
+                    f"lease_monotonicity: {name} accounting ran "
+                    f"backwards {prev} -> {cur}")
+            self._prev_metrics[name] = cur
+        return problems
+
+    def _live_diverges_from_intent(self, name: str,
+                                   live_placements) -> bool:
+        """Whether a job's backend-live host binding differs from the
+        placement manager's current intent for it — the divergence a
+        failed scale/migrate leaves behind and a retry pass repairs."""
+        intent = self.pm.job_placements.get(name)
+        intent_by_host: Dict[str, int] = {}
+        if intent is not None:
+            intent_by_host = intent.as_dict()
+        live_by_host: Dict[str, int] = {}
+        for host, slots in live_placements:
+            live_by_host[host] = live_by_host.get(host, 0) + slots
+        return live_by_host != intent_by_host
+
+    # -- quiescence ---------------------------------------------------------
+
+    def drain(self, max_events: int = 400,
+              stable_needed: int = 12) -> List[str]:
+        """Advance through timers until the fingerprint is stable for
+        `stable_needed` consecutive firings (the scheduler ticker
+        re-arms forever, so 'no timers left' never happens). Returns the
+        violations found — `non_quiescent` if no fixed point emerges,
+        `stranded_job` if the fixed point leaves schedulable work
+        waiting, plus any per-step invariant break during the drain."""
+        last = None
+        stable = 0
+        for _ in range(max_events):
+            problems = self.check()
+            if problems:
+                return problems
+            fp = self.fingerprint()
+            if fp == last:
+                stable += 1
+                if stable >= stable_needed:
+                    return self._stable_state_problems()
+            else:
+                stable = 0
+                last = fp
+            nxt = self.clock.next_timer()
+            if nxt is None:
+                return self._stable_state_problems()
+            self.clock.advance_to(max(nxt, self.clock.now()) + 1e-6)
+        return ["non_quiescent: no fingerprint fixed point within "
+                f"{max_events} timer events"]
+
+    def _stable_state_problems(self) -> List[str]:
+        problems = []
+        booked = self.sched.job_num_chips.snapshot()
+        free = self.sched.total_chips - sum(booked.values())
+        pending = self.sched.resched_pending
+        for name, job in sorted(self.sched.ready_jobs.items()):
+            if (job.status == JobStatus.WAITING and not pending
+                    and job.config.min_num_chips <= free):
+                problems.append(
+                    f"stranded_job: {name} waits with {free} chips free "
+                    f"(needs {job.config.min_num_chips}) and no pass "
+                    f"pending")
+        return problems
+
+
+# ---- exploration -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    states: int
+    transitions: int
+    leaves_drained: int
+    counterexample: Optional[dict]  # modelcheck_counterexample record
+
+    @property
+    def ok(self) -> bool:
+        return self.counterexample is None
+
+
+def _execute(config: ModelConfig, path: Tuple[str, ...]) -> _World:
+    """Replay an action prefix from scratch, checking invariants after
+    every step (raises Violation). Reconstruction-by-replay is what
+    makes every explored state reachable-by-construction and every
+    counterexample a plain action list."""
+    world = _World(config)
+    problems = world.check()
+    if problems:
+        raise Violation(problems, 0, "<init>")
+    for i, action in enumerate(path):
+        world.apply(action)
+        problems = world.check()
+        if problems:
+            raise Violation(problems, i + 1, action)
+    return world
+
+
+def _counterexample(config: ModelConfig, path: Tuple[str, ...],
+                    problems: List[str], step: int,
+                    states: int, transitions: int) -> dict:
+    rec = {
+        "kind": "modelcheck_counterexample",
+        "schema": obs_audit.SCHEMA_VERSION,
+        "violation": problems[0],
+        "problems": list(problems),
+        "step": step,
+        "path": list(path),
+        "config": config.to_dict(),
+        "states_explored": states,
+        "transitions_explored": transitions,
+    }
+    # Through the obs plane: the ring (and the JSONL sink when
+    # VODA_TRACE_DIR is configured) keeps the counterexample with the
+    # same durability as any resched audit record.
+    tracer = obs_tracer.get_tracer()
+    tracer.emit(dict(rec))
+    rec.setdefault("ts", tracer.clock.now())
+    assert not obs_audit.validate_record(rec), \
+        "counterexample record must satisfy its own schema"
+    return rec
+
+
+def explore(config: ModelConfig) -> ExploreResult:
+    """Breadth-first search over action sequences up to config.depth,
+    deduplicating on the logical fingerprint and stopping at
+    config.max_states unique states. Depth-bound (and budget-bound)
+    leaves are drained and checked for quiescence."""
+    # The search replays thousands of failure paths; the scheduler's
+    # log.exception calls would dominate the runtime with traceback
+    # formatting. Silence below-CRITICAL for the duration.
+    prev_disable = logging.root.manager.disable
+    logging.disable(logging.CRITICAL)
+    try:
+        return _explore_inner(config)
+    finally:
+        logging.disable(prev_disable)
+
+
+def _explore_inner(config: ModelConfig) -> ExploreResult:
+    try:
+        root = _execute(config, ())
+    except Violation as e:
+        return ExploreResult(1, 0, 0, _counterexample(
+            config, (), e.problems, e.step, 1, 0))
+    seen = {root.fingerprint()}
+    frontier: deque = deque([((), root.enabled())])
+    states = 1
+    transitions = 0
+    leaves_drained = 0
+    while frontier:
+        path, actions = frontier.popleft()
+        for action in actions:
+            child = path + (action,)
+            transitions += 1
+            try:
+                world = _execute(config, child)
+            except Violation as e:
+                return ExploreResult(states, transitions, leaves_drained,
+                                     _counterexample(config, child,
+                                                     e.problems, e.step,
+                                                     states, transitions))
+            fp = world.fingerprint()
+            if fp in seen:
+                continue
+            seen.add(fp)
+            states += 1
+            if len(child) < config.depth and states < config.max_states:
+                frontier.append((child, world.enabled()))
+            else:
+                problems = world.drain()
+                leaves_drained += 1
+                if problems:
+                    return ExploreResult(
+                        states, transitions, leaves_drained,
+                        _counterexample(config, child + ("<drain>",),
+                                        problems, len(child) + 1,
+                                        states, transitions))
+    return ExploreResult(states, transitions, leaves_drained, None)
+
+
+def replay_counterexample(rec: dict) -> List[str]:
+    """Deterministically re-execute a counterexample record; returns
+    the violations observed at its failing step (empty = it did NOT
+    reproduce, which itself is a determinism bug worth failing on)."""
+    config = ModelConfig.from_dict(rec["config"])
+    path = tuple(rec["path"])
+    drain = path and path[-1] == "<drain>"
+    if drain:
+        path = path[:-1]
+    prev_disable = logging.root.manager.disable
+    logging.disable(logging.CRITICAL)
+    try:
+        # The drain phase runs inside the silenced scope too — it can
+        # replay hundreds of injected-fault failure paths, the exact
+        # traceback-formatting cost explore() disables logging to avoid.
+        try:
+            world = _execute(config, path)
+        except Violation as e:
+            return e.problems
+        return world.drain() if drain else []
+    finally:
+        logging.disable(prev_disable)
+
+
+# ---- profiles + CLI --------------------------------------------------------
+
+
+def bounded_config(variant: str = "default") -> ModelConfig:
+    """The CI profile: 3 jobs, 2 hosts, start/scale/ack faults, one
+    churnable host, deletable first job — a few thousand states in
+    seconds."""
+    return ModelConfig(
+        jobs=(JobShape("j0", min_chips=1, max_chips=4, epochs=2),
+              JobShape("j1", min_chips=2, max_chips=4, epochs=1),
+              JobShape("j2", min_chips=1, max_chips=2, epochs=2)),
+        hosts=(("host-0", 4), ("host-1", 4)),
+        depth=12,
+        max_states=2600,
+        faults=("start", "scale", "scale_ack"),
+        churn_hosts=("host-1",),
+        deletable=("j0",),
+        storm=True,
+        variant=variant,
+    )
+
+
+def deep_config(variant: str = "default") -> ModelConfig:
+    """The slow-tier profile: 4 jobs, full fault alphabet minus "stop"
+    (the fake backend has no straggler reaper, so a failed DELETE drain
+    strands a pod a real backend's monitor would collect — a modeling
+    gap, not a scheduler bug), deeper and wider."""
+    return ModelConfig(
+        jobs=(JobShape("j0", min_chips=1, max_chips=8, epochs=2),
+              JobShape("j1", min_chips=2, max_chips=4, epochs=1),
+              JobShape("j2", min_chips=1, max_chips=2, epochs=3),
+              JobShape("j3", min_chips=4, max_chips=4, epochs=1)),
+        hosts=(("host-0", 4), ("host-1", 4)),
+        depth=14,
+        max_states=20000,
+        faults=("start", "scale", "scale_ack"),
+        churn_hosts=("host-0", "host-1"),
+        deletable=("j0", "j1"),
+        storm=True,
+        variant=variant,
+    )
+
+
+PROFILES = {"bounded": bounded_config, "deep": deep_config}
+
+# The CI gate: a bounded run exploring fewer unique states than this
+# means the scenario (or the dedup) silently collapsed — fail loudly.
+MIN_BOUNDED_STATES = 2000
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        prog="modelcheck",
+        description="Exhaustive small-scope model checker for scheduler "
+                    "invariants (doc/static-analysis.md)")
+    parser.add_argument("--profile", choices=sorted(PROFILES),
+                        default="bounded")
+    parser.add_argument("--variant", choices=sorted(VARIANTS),
+                        default="default")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run every seeded-bug variant and require "
+                             "each to be CAUGHT (the checker's teeth)")
+    parser.add_argument("--replay", default=None,
+                        help="replay a counterexample JSON file instead "
+                             "of exploring")
+    args = parser.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay, encoding="utf-8") as f:
+            rec = json.load(f)
+        problems = replay_counterexample(rec)
+        print(json.dumps({"reproduced": bool(problems),
+                          "problems": problems}, indent=1))
+        return 0 if problems else 1
+
+    if args.selftest:
+        ok = True
+        for name in sorted(VARIANTS):
+            if name == "default":
+                continue
+            result = explore(PROFILES[args.profile](variant=name))
+            caught = result.counterexample is not None
+            reproduced = caught and bool(
+                replay_counterexample(result.counterexample))
+            print(f"selftest {name}: "
+                  f"{'CAUGHT' if caught else 'MISSED'}"
+                  f"{' +replayed' if reproduced else ''} "
+                  f"({result.states} states)")
+            ok = ok and caught and reproduced
+        return 0 if ok else 1
+
+    t0 = time.monotonic()
+    result = explore(PROFILES[args.profile](variant=args.variant))
+    took = time.monotonic() - t0
+    print(f"modelcheck[{args.profile}/{args.variant}]: "
+          f"{result.states} states, {result.transitions} transitions, "
+          f"{result.leaves_drained} leaves drained in {took:.1f}s")
+    if result.counterexample is not None:
+        print(json.dumps(result.counterexample, indent=1))
+        return 1
+    if args.profile == "bounded" and result.states < MIN_BOUNDED_STATES:
+        print(f"modelcheck: bound collapsed — only {result.states} "
+              f"states explored (< {MIN_BOUNDED_STATES})")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,419 @@
+"""vodacheck: the static transition audit over the reified lifecycle.
+
+vodalint (PR 5) checks lexical discipline — clocks, locks, closed
+vocabularies. This pass checks *semantic* state-machine correctness
+against the tables in `common/lifecycle.py`:
+
+- every `job.status` store goes through `lifecycle.transition()`
+  (`status-store`, shared with vodalint's rule of the same id);
+- every `transition()` call site names a statically resolvable
+  `(to, reason)` literal pair admitted by a declared `TRANSITIONS` edge
+  (`transition-literal`) — a call the checker cannot resolve is itself
+  a finding, so the relation can't be bypassed through variables;
+- every declared `TRANSITIONS` edge is claimed by at least one call
+  site (`transition-unused`) — both one-sided edits fail, mirroring the
+  SPAN_NAMES rule. Coverage matches on the (target, reason) pair: two
+  edges sharing both (e.g. Running→Completed and Waiting→Completed,
+  which differ only in the runtime `job.status`) are covered together,
+  the documented precision limit of a static from-state.
+- every backend *claim* (`start_job`/`scale_job`/`migrate_workers`) in
+  `scheduler/` has a dominating `BookingLedger` write on its exception
+  edge (`booking-release`): either the claim sits in a `try` whose
+  handler writes the ledger (directly or via one self-method level,
+  call-graph-lite like vodalint's lock rule), or EVERY call site of the
+  claiming method does. An unreleased booking strands chips
+  (phantom-running, found live in r5); an unbooked claim double-books
+  the next pass.
+
+Usage:
+    python -m vodascheduler_tpu.analysis.vodacheck [paths...]
+        [--format text|jsonl]
+
+No baseline and no suppressions: the transition relation is exact, so
+the tree is either clean or wrong. Rule catalog: doc/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+from vodascheduler_tpu.analysis.vodalint import (
+    Finding,
+    _check_status_store,
+    _iter_py_files,
+    _literal_strings,
+    _package_dir,
+    _rel_root,
+    _self_method_name,
+)
+from vodascheduler_tpu.common.types import JobStatus
+
+RULES: Dict[str, str] = {
+    "status-store": (
+        "No direct `.status` store outside common/lifecycle.py "
+        "(same detector as vodalint's rule — vodacheck fails on it "
+        "too so the transition audit is self-contained)."),
+    "transition-literal": (
+        "Every lifecycle.transition() call must carry a statically "
+        "resolvable literal target status and reason, and the "
+        "(target, reason) pair must be admitted by a declared "
+        "TRANSITIONS edge. Unresolvable call sites are findings — the "
+        "relation cannot be bypassed through variables."),
+    "transition-unused": (
+        "Every declared TRANSITIONS edge must be claimed by at least "
+        "one transition() call site (matched on target + reason). A "
+        "dead edge means the table and the code diverged — both "
+        "one-sided edits fail, mirroring the SPAN_NAMES rule."),
+    "booking-release": (
+        "Every backend claim (start_job/scale_job/migrate_workers) in "
+        "scheduler/ must have a dominating BookingLedger write "
+        "(commit/release/commit_pass, directly or via one self-method "
+        "level) on an exception edge — in an enclosing try, or in "
+        "every caller's. The release-on-failure contract of "
+        "common/lifecycle.py."),
+    "parse-error": (
+        "The module failed to parse — nothing in it was audited."),
+}
+
+# The backend mutators that CLAIM chips (stop_job releases them and is
+# exempt: a failed stop keeps the booking deliberately, retried by the
+# next pass).
+CLAIM_MUTATORS = {"start_job", "scale_job", "migrate_workers"}
+
+# The BookingLedger mutators that satisfy the release contract.
+LEDGER_MUTATORS = {"commit", "release", "commit_pass"}
+
+BOOKING_PREFIXES = ("scheduler/",)
+
+
+# ---- transition-literal / transition-unused --------------------------------
+
+
+def _status_literals(node: ast.AST) -> Optional[List[JobStatus]]:
+    """Resolve an expression to the JobStatus members it can denote:
+    `JobStatus.X` attributes and conditional expressions of them; None
+    if not statically resolvable."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "JobStatus"):
+        try:
+            return [JobStatus[node.attr]]
+        except KeyError:
+            return None
+    if isinstance(node, ast.IfExp):
+        a = _status_literals(node.body)
+        b = _status_literals(node.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _is_transition_call(node: ast.Call) -> bool:
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    return name == "transition"
+
+
+def _check_transition_calls(tree: ast.AST, rel: str, transitions,
+                            out: List[Finding],
+                            claims: Set[Tuple[JobStatus, str]]) -> None:
+    """Per-module half of the transition audit: validate each call
+    site's literals against `transitions` and record its
+    (target, reason) claims for the package-level coverage pass."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_transition_call(node)):
+            continue
+        if len(node.args) < 2:
+            out.append(Finding(
+                rel, node.lineno, "transition-literal",
+                "transition() call without a positional target status"))
+            continue
+        tos = _status_literals(node.args[1])
+        if tos is None:
+            out.append(Finding(
+                rel, node.lineno, "transition-literal",
+                "transition() target is not a literal JobStatus (or a "
+                "conditional of literals) — the static audit cannot "
+                "check this edge"))
+            continue
+        reason_lits: List[str] = []
+        unresolved_reason = True
+        for kw in node.keywords:
+            if kw.arg != "reason":
+                continue
+            lits = _literal_strings(kw.value)
+            if lits is not None:
+                reason_lits = [code for _, code in lits]
+                unresolved_reason = False
+        if unresolved_reason:
+            out.append(Finding(
+                rel, node.lineno, "transition-literal",
+                "transition() reason is not a literal string — the "
+                "static audit cannot check this edge"))
+            continue
+        for to in tos:
+            edges = {frm: spec for (frm, tgt), spec in transitions.items()
+                     if tgt is to}
+            if not edges:
+                out.append(Finding(
+                    rel, node.lineno, "transition-literal",
+                    f"no declared transition into {to.value!r} in "
+                    f"lifecycle.TRANSITIONS"))
+                continue
+            admitted = [r for r in reason_lits
+                        if any(r in spec.reasons for spec in edges.values())]
+            for r in reason_lits:
+                if r not in admitted:
+                    out.append(Finding(
+                        rel, node.lineno, "transition-literal",
+                        f"reason {r!r} not allowed by any declared "
+                        f"transition into {to.value!r}"))
+            for r in admitted:
+                claims.add((to, r))
+
+
+def _coverage_findings(transitions,
+                       claims: Set[Tuple[JobStatus, str]]) -> List[Finding]:
+    out: List[Finding] = []
+    for (frm, to), spec in sorted(transitions.items(),
+                                  key=lambda kv: (kv[0][0].value,
+                                                  kv[0][1].value)):
+        if not any((to, r) in claims for r in spec.reasons):
+            out.append(Finding(
+                "common/lifecycle.py", 1, "transition-unused",
+                f"declared transition {frm.value!r} -> {to.value!r} is "
+                f"claimed by no transition() call site — dead edge"))
+    return out
+
+
+# ---- booking-release -------------------------------------------------------
+
+
+def _is_claim_call(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if not (isinstance(func, ast.Attribute)
+            and func.attr in CLAIM_MUTATORS):
+        return None
+    value = func.value
+    if (isinstance(value, ast.Attribute) and value.attr == "backend") or \
+            (isinstance(value, ast.Name) and value.id == "backend"):
+        return func.attr
+    return None
+
+
+def _is_ledger_write(node: ast.Call) -> bool:
+    func = node.func
+    return (isinstance(func, ast.Attribute)
+            and func.attr in LEDGER_MUTATORS
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "job_num_chips")
+
+
+def _method_writes_ledger(methods: Dict[str, ast.AST]) -> Set[str]:
+    """Which methods (transitively over self-call edges) contain a
+    BookingLedger write."""
+    direct: Set[str] = set()
+    callees: Dict[str, Set[str]] = {}
+    for name, fn in methods.items():
+        edges: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if _is_ledger_write(node):
+                    direct.add(name)
+                callee = _self_method_name(node.func)
+                if callee:
+                    edges.add(callee)
+        callees[name] = edges
+    writers = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for name, edges in callees.items():
+            if name not in writers and edges & writers:
+                writers.add(name)
+                changed = True
+    return writers
+
+
+def _handler_releases(handler: ast.ExceptHandler,
+                      writers: Set[str]) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            if _is_ledger_write(node):
+                return True
+            callee = _self_method_name(node.func)
+            if callee and callee in writers:
+                return True
+    return False
+
+
+def _protected_positions(fn: ast.AST, writers: Set[str]) -> Set[int]:
+    """Line numbers inside `fn` covered by a try whose handler writes
+    the ledger (the 'dominating release on the exception edge')."""
+    covered: Set[int] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Try) and any(
+                _handler_releases(h, writers) for h in node.handlers):
+            for stmt in node.body + node.orelse:
+                for sub in ast.walk(stmt):
+                    line = getattr(sub, "lineno", None)
+                    if line is not None:
+                        covered.add(line)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(fn)
+    return covered
+
+
+def _check_booking_release(tree: ast.AST, rel: str,
+                           out: List[Finding]) -> None:
+    if not rel.startswith(BOOKING_PREFIXES):
+        return
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {item.name: item for item in cls.body
+                   if isinstance(item, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        writers = _method_writes_ledger(methods)
+        protected = {name: _protected_positions(fn, writers)
+                     for name, fn in methods.items()}
+        # Claims that are not protected inside their own method need
+        # every call site of that method protected instead (one level,
+        # call-graph-lite — deeper chains are findings by design).
+        unprotected: Dict[str, Tuple[int, str]] = {}
+        for name, fn in methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                claim = _is_claim_call(node)
+                if claim is None:
+                    continue
+                if node.lineno not in protected[name]:
+                    unprotected.setdefault(name, (node.lineno, claim))
+        for name, (line, claim) in sorted(unprotected.items()):
+            call_sites: List[Tuple[str, int]] = []
+            for caller, fn in methods.items():
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and _self_method_name(node.func) == name):
+                        call_sites.append((caller, node.lineno))
+            if not call_sites:
+                out.append(Finding(
+                    rel, line, "booking-release",
+                    f"backend claim {claim}() in {name}() has no "
+                    f"dominating BookingLedger release on its exception "
+                    f"edge (and no caller to provide one)"))
+                continue
+            bad = [(c, ln) for c, ln in call_sites
+                   if ln not in protected[c]]
+            if bad:
+                caller, ln = bad[0]
+                out.append(Finding(
+                    rel, line, "booking-release",
+                    f"backend claim {claim}() in {name}() is not "
+                    f"released on failure: call site {caller}():{ln} "
+                    f"has no enclosing try whose handler writes the "
+                    f"BookingLedger"))
+
+
+# ---- entry points ----------------------------------------------------------
+
+
+def _load_transitions():
+    from vodascheduler_tpu.common.lifecycle import TRANSITIONS
+    return TRANSITIONS
+
+
+def check_source(src: str, rel: str, transitions=None,
+                 claims: Optional[Set[Tuple[JobStatus, str]]] = None,
+                 tree: Optional[ast.AST] = None) -> List[Finding]:
+    """Audit one module. `claims` (when given) accumulates the
+    (target, reason) pairs the module's transition() calls claim, for
+    the package-level transition-unused pass."""
+    transitions = transitions if transitions is not None \
+        else _load_transitions()
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 1, "parse-error",
+                            f"unparseable module: {e.msg}")]
+    findings: List[Finding] = []
+    # status-store shares vodalint's detector (and rule id) verbatim.
+    _check_status_store(tree, rel, findings)
+    _check_transition_calls(tree, rel, transitions, findings,
+                            claims if claims is not None else set())
+    _check_booking_release(tree, rel, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def check_package(pkg_dir: Optional[str] = None) -> List[Finding]:
+    """Audit the whole package, including edge coverage. The coverage
+    half only runs when the audited tree carries lifecycle.py itself —
+    checking a fixture subtree must not declare every edge dead."""
+    pkg_dir = os.path.abspath(pkg_dir or _package_dir())
+    rel_root = _rel_root(pkg_dir)
+    transitions = _load_transitions()
+    findings: List[Finding] = []
+    claims: Set[Tuple[JobStatus, str]] = set()
+    for full, rel in _iter_py_files(pkg_dir, rel_root):
+        with open(full, encoding="utf-8") as f:
+            src = f.read()
+        findings.extend(check_source(src, rel, transitions, claims))
+    if os.path.exists(os.path.join(pkg_dir, "common", "lifecycle.py")):
+        findings.extend(_coverage_findings(transitions, claims))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run(paths: List[str], fmt: str = "text", stream=None) -> int:
+    import json
+
+    stream = stream or sys.stdout
+    findings: List[Finding] = []
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isdir(path):
+            findings.extend(check_package(path))
+        else:
+            rel = os.path.relpath(path, _package_dir()).replace(os.sep, "/")
+            if rel.startswith(".."):
+                rel = os.path.basename(path)
+            with open(path, encoding="utf-8") as f:
+                findings.extend(check_source(f.read(), rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    for f in findings:
+        if fmt == "jsonl":
+            print(json.dumps(f.to_dict(), sort_keys=True), file=stream)
+        else:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}",
+                  file=stream)
+    if fmt == "text":
+        print(f"vodacheck: {len(findings)} finding(s)", file=stream)
+    return 1 if findings else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vodacheck",
+        description="Voda's static transition audit: the reified job "
+                    "state machine, checked (doc/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or package dirs (default: the "
+                             "installed vodascheduler_tpu package)")
+    parser.add_argument("--format", choices=("text", "jsonl"),
+                        default="text")
+    args = parser.parse_args(argv)
+    paths = args.paths or [_package_dir()]
+    return run(paths, fmt=args.format)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,10 +53,21 @@ RULES: Dict[str, str] = {
         "level of self-method indirection (call-graph-lite)."),
     "vocab": (
         "Audit vocabulary is closed: every literal reason code "
-        "(_add_reason), trigger (trigger_resched) and span name "
-        "(tracer.span/start_span) must be in obs/audit.py's REASON_CODES/"
-        "TRIGGERS/SPAN_NAMES — and every vocabulary entry must be used "
-        "somewhere in the package (one-sided edits fail)."),
+        "(_add_reason), trigger (trigger_resched), span name "
+        "(tracer.span/start_span) and status-transition reason "
+        "(lifecycle.transition(..., reason=...)) must be in "
+        "obs/audit.py's REASON_CODES/TRIGGERS/SPAN_NAMES/STATUS_REASONS "
+        "— and every vocabulary entry must be used somewhere in the "
+        "package (one-sided edits fail)."),
+    "status-store": (
+        "No direct `<job>.status = ...` store outside common/"
+        "lifecycle.py — every status change goes through "
+        "lifecycle.transition(), which validates the edge against "
+        "TRANSITIONS and emits the status_transition audit record. "
+        "Fires on any .status store whose value references JobStatus, "
+        "and on ANY non-self .status store in scheduler/, service/ or "
+        "replay/ (where a laundered variable store would otherwise "
+        "slip through)."),
     "metrics-lock": (
         "Instrument methods in common/metrics.py must access shared "
         "mutable state (_values/_value/_sum/_count/_counts/_total/"
@@ -187,6 +198,51 @@ def _check_clock_discipline(tree: ast.AST, imports: _Imports,
                                f"{_BANNED_WALL_CLOCK[flat]} in a "
                                f"Clock-injected module; use the injected "
                                f"Clock (clock.now()/clock.sleep())"))
+
+
+# Where the reified lifecycle (the ONE blessed job.status store) lives.
+LIFECYCLE_MODULE = "common/lifecycle.py"
+
+# Modules where jobs are the domain objects: ANY non-self `.status`
+# store there is a lifecycle bypass even if it launders the value
+# through a variable (obs spans set self.status = "ok" legitimately).
+STATUS_STRICT_PREFIXES = ("scheduler/", "service/", "replay/")
+
+
+def _mentions_job_status(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "JobStatus":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "JobStatus":
+            return True
+    return False
+
+
+def _check_status_store(tree: ast.AST, rel: str,
+                        out: List[Finding]) -> None:
+    if rel == LIFECYCLE_MODULE:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+            value = node.value if node.value is not None else node.target
+        else:
+            continue
+        for target in targets:
+            if not (isinstance(target, ast.Attribute)
+                    and target.attr == "status"):
+                continue
+            is_self = (isinstance(target.value, ast.Name)
+                       and target.value.id == "self")
+            if _mentions_job_status(value) or (
+                    rel.startswith(STATUS_STRICT_PREFIXES) and not is_self):
+                out.append(Finding(
+                    rel, node.lineno, "status-store",
+                    "direct .status store outside common/lifecycle.py — "
+                    "use lifecycle.transition(job, to, reason=...) so the "
+                    "edge is validated and audited"))
 
 
 def _is_self_attr(node: ast.AST, attr: str) -> bool:
@@ -369,6 +425,7 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
     reason_codes = vocab["REASON_CODES"]
     triggers = vocab["TRIGGERS"]
     span_names = vocab["SPAN_NAMES"]
+    status_reasons = vocab["STATUS_REASONS"]
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -395,6 +452,19 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
                         rel, line, "vocab",
                         f"span name {code!r} not in "
                         f"obs.audit.SPAN_NAMES"))
+        elif name == "transition":
+            # lifecycle.transition(job, to, reason=...): the status-
+            # change reason is keyword-only and must come from the
+            # closed STATUS_REASONS vocabulary.
+            for kw in node.keywords:
+                if kw.arg != "reason":
+                    continue
+                for line, code in _literal_strings(kw.value) or []:
+                    if code not in status_reasons:
+                        out.append(Finding(
+                            rel, line, "vocab",
+                            f"status reason {code!r} not in "
+                            f"obs.audit.STATUS_REASONS"))
 
 
 def _check_metrics_lock(tree: ast.AST, rel: str,
@@ -627,7 +697,8 @@ def _load_vocab() -> Dict[str, frozenset]:
     from vodascheduler_tpu.obs import audit
     return {"REASON_CODES": audit.REASON_CODES,
             "TRIGGERS": audit.TRIGGERS,
-            "SPAN_NAMES": audit.SPAN_NAMES}
+            "SPAN_NAMES": audit.SPAN_NAMES,
+            "STATUS_REASONS": audit.STATUS_REASONS}
 
 
 def lint_source(src: str, rel: str,
@@ -647,6 +718,7 @@ def lint_source(src: str, rel: str,
     findings: List[Finding] = []
     _check_clock_discipline(tree, imports, rel, findings)
     _check_lock_discipline(tree, rel, findings)
+    _check_status_store(tree, rel, findings)
     _check_vocab(tree, rel, vocab, findings)
     _check_metrics_lock(tree, rel, findings)
     _check_thread_daemon(tree, imports, rel, findings)
@@ -699,6 +771,7 @@ def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
     vocab = _load_vocab()
     findings: List[Finding] = []
     used_literals: Set[str] = set()
+    used_outside_lifecycle: Set[str] = set()
     audit_rel = "obs/audit.py"
     # Reverse sweep only when the linted tree ITSELF carries the vocab
     # module — a subdirectory lint sees a fraction of the literals and
@@ -720,14 +793,23 @@ def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
                 if (isinstance(node, ast.Constant)
                         and isinstance(node.value, str)):
                     used_literals.add(node.value)
+                    # STATUS_REASONS are *declared* twice (the vocab in
+                    # audit.py, the per-edge sets in lifecycle.py's
+                    # TRANSITIONS) — usage means a transition() CALL
+                    # site, so the declaration modules don't count.
+                    if rel != LIFECYCLE_MODULE:
+                        used_outside_lifecycle.add(node.value)
     if not has_vocab_module:
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
         return findings
-    for vocab_name, entries in (("REASON_CODES", vocab["REASON_CODES"]),
-                                ("TRIGGERS", vocab["TRIGGERS"]),
-                                ("SPAN_NAMES", vocab["SPAN_NAMES"])):
+    for vocab_name, entries, used in (
+            ("REASON_CODES", vocab["REASON_CODES"], used_literals),
+            ("TRIGGERS", vocab["TRIGGERS"], used_literals),
+            ("SPAN_NAMES", vocab["SPAN_NAMES"], used_literals),
+            ("STATUS_REASONS", vocab["STATUS_REASONS"],
+             used_outside_lifecycle)):
         for entry in sorted(entries):
-            if entry not in used_literals:
+            if entry not in used:
                 findings.append(Finding(
                     audit_rel, 1, "vocab",
                     f"{vocab_name} entry {entry!r} is used nowhere in "

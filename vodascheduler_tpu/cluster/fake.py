@@ -162,6 +162,38 @@ class FakeClusterBackend(ClusterBackend):
         # (timestamp, total_chips) after each fleet change — lets callers
         # integrate capacity over time (preemption changes the denominator)
         self.capacity_history: List[Tuple[float, int]] = []
+        # One-shot deterministic faults (inject_fault): the chaos plane's
+        # unit of adversity. Ordered, consumed first-match, so a replayed
+        # action sequence reproduces the exact same failure.
+        self._armed_faults: List[str] = []
+
+    # ---- deterministic fault injection (ROADMAP item 5; the model
+    # checker's fault alphabet, analysis/modelcheck.py) -------------------
+
+    FAULT_KINDS = ("start", "scale", "scale_ack", "stop")
+
+    def inject_fault(self, kind: str) -> None:
+        """Arm a one-shot fault: the next matching backend call fails
+        deterministically. Kinds: "start" (start_job raises before
+        applying anything), "scale" (scale_job raises before applying —
+        the resize never happened), "scale_ack" (scale_job APPLIES the
+        resize, then raises — the supervisor crashed after resharding
+        but before the ack, so backend truth and the caller's view
+        diverge), "stop" (stop_job raises before applying)."""
+        if kind not in self.FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._state_lock:
+            self._armed_faults.append(kind)
+
+    def armed_faults(self) -> List[str]:
+        with self._state_lock:
+            return list(self._armed_faults)
+
+    def _consume_fault(self, kind: str) -> None:
+        with self._state_lock:
+            if kind in self._armed_faults:
+                self._armed_faults.remove(kind)
+                raise RuntimeError(f"injected backend fault: {kind}")
 
     # ---- fleet management -------------------------------------------------
 
@@ -223,6 +255,7 @@ class FakeClusterBackend(ClusterBackend):
 
     def start_job(self, spec: JobSpec, num_workers: int,
                   placements: Optional[List[Tuple[str, int]]] = None) -> None:
+        self._consume_fault("start")
         # Simulated counterparts of the real chain's backend + supervisor
         # spans (cluster/local.py, runtime/supervisor.py): same
         # names/components/attrs, parented on the ambient resched context
@@ -289,9 +322,16 @@ class FakeClusterBackend(ClusterBackend):
         with self._state_lock:
             if name not in self.jobs:
                 return None
+        self._consume_fault("scale")
         self._actuation_sleep()
         with self._state_lock:
-            return self._scale_job_locked(name, num_workers, placements)
+            path = self._scale_job_locked(name, num_workers, placements)
+        # The ack-crash fault class: the resize was APPLIED above, but
+        # the caller sees a failure — backend truth and scheduler
+        # bookkeeping diverge until the failure path re-reads
+        # running_jobs().
+        self._consume_fault("scale_ack")
+        return path
 
     def _scale_job_locked(self, name: str, num_workers: int,
                           placements: Optional[List[Tuple[str, int]]]
@@ -367,6 +407,7 @@ class FakeClusterBackend(ClusterBackend):
         with self._state_lock:
             if name not in self.jobs:
                 return
+        self._consume_fault("stop")
         self._actuation_sleep()
         with obs_tracer.active_tracer().span(
                 "backend.stop", component="backend", attrs={"job": name}), \

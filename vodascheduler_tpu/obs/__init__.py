@@ -16,6 +16,7 @@ See doc/observability.md.
 from vodascheduler_tpu.obs.audit import (  # noqa: F401
     REASON_CODES,
     SPAN_NAMES,
+    STATUS_REASONS,
     TRIGGERS,
     validate_jsonl,
     validate_record,

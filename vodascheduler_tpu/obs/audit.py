@@ -61,6 +61,24 @@ REASON_CODES = frozenset({
     "reverted_release_failure",  # pass aborted: booking reverted wholesale
 })
 
+# Why a job's STATUS changed (the lifecycle plane, common/lifecycle.py):
+# every `transition()` call names one of these, the edge it takes must
+# allow it (lifecycle.TRANSITIONS), and every `status_transition` record
+# carries it. Closed both ways like the other vocabularies: an unknown
+# code fails validation and vodalint's vocab rule; an unused code fails
+# the reverse sweep (usage is counted OUTSIDE audit.py and lifecycle.py,
+# where the vocabulary is merely declared).
+STATUS_REASONS = frozenset({
+    "accepted",      # Submitted -> Waiting: scheduler took the job
+    "scheduled",     # Waiting -> Running: pass granted chips, backend started
+    "preempted",     # Running -> Waiting: halted back to the queue by a pass
+    "backend_lost",  # Running -> Waiting: backend failed/lost the job; reverted
+    "resume",        # crash resume re-asserted status from store+backend truth
+    "completed",     # -> Completed
+    "failed",        # -> Failed
+    "user_delete",   # -> Canceled
+})
+
 # Every span name the package may emit (the trace file's third closed
 # vocabulary, alongside TRIGGERS and REASON_CODES). Enforced statically
 # by vodalint's `vocab` rule — NOT by validate_record, because tests
@@ -83,6 +101,10 @@ _REQUIRED_SPAN_FIELDS = ("kind", "trace_id", "span_id", "name", "component",
                          "start", "end", "duration_ms", "status")
 _REQUIRED_ACCESS_FIELDS = ("kind", "ts", "method", "path", "status",
                            "duration_ms")
+_REQUIRED_STATUS_FIELDS = ("kind", "schema", "ts", "pool", "job", "from",
+                           "to", "reason")
+_REQUIRED_COUNTEREXAMPLE_FIELDS = ("kind", "schema", "ts", "violation",
+                                   "step", "path", "config")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -98,7 +120,37 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _check_fields(rec, _REQUIRED_SPAN_FIELDS)
     if kind == "http_access":
         return _check_fields(rec, _REQUIRED_ACCESS_FIELDS)
+    if kind == "status_transition":
+        return _validate_status_transition(rec)
+    if kind == "modelcheck_counterexample":
+        return _check_fields(rec, _REQUIRED_COUNTEREXAMPLE_FIELDS)
     return [f"unknown record kind {kind!r}"]
+
+
+def _validate_status_transition(rec: Dict[str, Any]) -> List[str]:
+    problems = _check_fields(rec, _REQUIRED_STATUS_FIELDS)
+    if rec.get("reason") not in STATUS_REASONS:
+        problems.append(f"unknown status reason {rec.get('reason')!r}")
+    # The edge itself must be declared. Lazy import: lifecycle imports
+    # this module for the vocabulary, so the dependency inverts here at
+    # call time (no import cycle at module load).
+    from vodascheduler_tpu.common.lifecycle import TRANSITIONS
+    from vodascheduler_tpu.common.types import JobStatus
+    try:
+        edge = (JobStatus(rec.get("from")), JobStatus(rec.get("to")))
+    except ValueError:
+        problems.append(f"invalid status in {rec.get('from')!r} -> "
+                        f"{rec.get('to')!r}")
+        return problems
+    spec = TRANSITIONS.get(edge)
+    if spec is None:
+        problems.append(f"undeclared transition {rec['from']!r} -> "
+                        f"{rec['to']!r}")
+    elif rec.get("reason") in STATUS_REASONS \
+            and rec["reason"] not in spec.reasons:
+        problems.append(f"reason {rec['reason']!r} not allowed for "
+                        f"{rec['from']!r} -> {rec['to']!r}")
+    return problems
 
 
 def _check_fields(rec: Dict[str, Any], required) -> List[str]:

@@ -41,6 +41,8 @@ from vodascheduler_tpu.cluster.backend import (
 from vodascheduler_tpu.common.clock import Clock, VirtualClock
 from vodascheduler_tpu.common.events import EventBus, JobEvent
 from vodascheduler_tpu.common.job import TrainingJob
+from vodascheduler_tpu.common import lifecycle
+from vodascheduler_tpu.common.lifecycle import BookingLedger
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import (
@@ -169,10 +171,14 @@ class Scheduler:
         self._placement_dirty = False
 
         # Job state (reference: ReadyJobsMap / DoneJobsMap / JobNumGPU,
-        # scheduler.go:81-93).
+        # scheduler.go:81-93). Chip bookings live in the ledger
+        # (common/lifecycle.py): reads behave like the plain dict this
+        # used to be; writes go through commit/release/commit_pass so
+        # the booking discipline is auditable (vodacheck's
+        # booking-release rule).
         self.ready_jobs: Dict[str, TrainingJob] = {}
         self.done_jobs: Dict[str, TrainingJob] = {}
-        self.job_num_chips: ScheduleResult = {}
+        self.job_num_chips: BookingLedger = BookingLedger()
 
         # Host capacity (reference: TotalGpus via node informer).
         self.total_chips = 0
@@ -185,6 +191,14 @@ class Scheduler:
         self.resched_blocked_until = -float("inf")
         self._resched_pending = False
         self._in_resched = False
+        # Failure-recovery introspection: retries armed as clock timers
+        # (VirtualClock mode arms a timer WITHOUT setting
+        # _resched_pending, so pending alone under-reports). The model
+        # checker keys its double-booking invariant on this: a backend
+        # overlap is legal exactly while the scheduler still owns a
+        # recovery step for it, and a strand with no recovery pending
+        # is the bug.
+        self._retries_armed = 0
         self._stopped = False
         # --- concurrent actuation plane (doc/observability.md,
         # "Scheduler concurrency model") ---
@@ -443,11 +457,19 @@ class Scheduler:
         if job is None:
             log.error("create event for unknown job %s", name)
             return []
-        job.status = JobStatus.WAITING
+        if name in self.ready_jobs or name in self.done_jobs:
+            # Duplicate create announcement (a re-delivered bus event):
+            # the job is already accepted — or already finished its
+            # whole lifecycle. Re-accepting would be an undeclared
+            # edge (Waiting/terminal -> Waiting) and a double-count.
+            return []
+        lifecycle.transition(job, JobStatus.WAITING, reason="accepted",
+                             chips=0, tracer=self.tracer,
+                             pool=self.pool_id)
         job.metrics.last_update_time = self.clock.now()
         self.store.update_job(job)
         self.ready_jobs[name] = job
-        self.job_num_chips[name] = 0
+        self.job_num_chips.commit(name, 0)
         self.m_jobs_created.inc()
         return ["job_created"]
 
@@ -459,8 +481,9 @@ class Scheduler:
         job = self.ready_jobs.pop(name, None)
         if job is None:
             return []
-        chips = self.job_num_chips.pop(name, 0)
-        job.status = JobStatus.CANCELED
+        chips = self.job_num_chips.release(name)
+        lifecycle.transition(job, JobStatus.CANCELED, reason="user_delete",
+                             tracer=self.tracer, pool=self.pool_id)
         job.finish_time = self.clock.now()
         self.store.update_job(job)
         self.done_jobs[name] = job
@@ -514,19 +537,29 @@ class Scheduler:
     def _job_terminal_locked(self, name: str,
                              status: JobStatus) -> List[str]:
         job = self.ready_jobs.get(name)
-        if job is None or job.status == status:
+        if job is None:
+            # Duplicate terminal event: the first one already moved the
+            # job to done_jobs under this same lock, so there is no
+            # silent same-status overwrite to guard against here — an
+            # actual terminal self-loop would raise in transition()
+            # (the self-loop policy is declared, not an `==` accident).
             return []
         reasons = []
         # Final accounting before the terminal state; a Tiresias flip
         # here rides the same pass as the completion.
         if self._update_time_metrics_locked():
             reasons.append("priority_change")
-        job.status = status
-        self._job_done(job)
         if status == JobStatus.COMPLETED:
+            lifecycle.transition(job, JobStatus.COMPLETED,
+                                 reason="completed", tracer=self.tracer,
+                                 pool=self.pool_id)
+            self._job_done(job)
             self.m_jobs_completed.inc()
             reasons.append("job_completed")
         else:
+            lifecycle.transition(job, JobStatus.FAILED, reason="failed",
+                                 tracer=self.tracer, pool=self.pool_id)
+            self._job_done(job)
             self.m_jobs_failed.inc()
             reasons.append("job_failed")
         return reasons
@@ -537,7 +570,7 @@ class Scheduler:
         self.store.update_job(job)
         self.done_jobs[job.name] = job
         self.ready_jobs.pop(job.name, None)
-        self.job_num_chips.pop(job.name, None)
+        self.job_num_chips.release(job.name)
 
     # ---- host churn (reference: addNode/updateNode/deleteNode :689-747) --
 
@@ -619,6 +652,29 @@ class Scheduler:
     def resched_pending(self) -> bool:
         return self._resched_pending
 
+    @property
+    def recovery_pending(self) -> bool:
+        """Whether the scheduler still owns a corrective step: a pass
+        pending/running, or a failure retry armed on a clock timer.
+        While this holds, bookkeeping and backend truth may legally
+        diverge (the failure-isolation contract re-converges them);
+        once it clears, any divergence is a real strand — the exact
+        line the model checker draws."""
+        with self._lock:
+            return (self._resched_pending or self._in_resched
+                    or self._retries_armed > 0)
+
+    def _fire_retry(self) -> None:
+        """VirtualClock retry-timer target: trigger FIRST, disarm the
+        introspection counter after (in a finally, so a raising pass
+        can't wedge the counter high) — recovery_pending never drops
+        while the corrective pass is still unrequested."""
+        try:
+            self.trigger_resched("retry")
+        finally:
+            with self._lock:
+                self._retries_armed = max(0, self._retries_armed - 1)
+
     def pump(self) -> None:
         """Real-time driver hook (service/daemon.py): run a pending resched
         once the rate-limit window opens. Under a VirtualClock the clock's
@@ -682,10 +738,20 @@ class Scheduler:
             # triggers land inside the just-opened rate-limit window and
             # coalesce into the next pass.
             for fn, args in deferred:
-                with self._lock:
-                    reasons = fn(*args)
-                self._drain_pending_stops()
-                self._fire(reasons)
+                # Each replayed event is isolated: since transition()
+                # raises on undeclared edges, one malformed deferred
+                # event (a re-delivered create for a finished job) must
+                # not drop the rest of the queue or skip the re-arm
+                # below — same posture as the EventBus dispatcher.
+                try:
+                    with self._lock:
+                        reasons = fn(*args)
+                    self._drain_pending_stops()
+                    self._fire(reasons)
+                except Exception:
+                    log.exception("deferred event %s%r failed; "
+                                  "continuing with the rest",
+                                  getattr(fn, "__name__", fn), args)
             if rearm_at is not None:
                 # Re-triggered mid-pass (a Tiresias priority flip, a
                 # wave worker's retry): run again once the window opens —
@@ -724,7 +790,7 @@ class Scheduler:
         t_start = _walltime.monotonic()
         self.update_time_metrics()
         with self._lock:
-            old = dict(self.job_num_chips)
+            old = self.job_num_chips.snapshot()
         outcome = "error"
         with self.tracer.span(
                 "resched", component="scheduler", new_trace=True,
@@ -781,7 +847,11 @@ class Scheduler:
 
             if self.scale_out_hysteresis > 1.0:
                 self._apply_hysteresis(old, new)
-            self.job_num_chips = new
+            # Decide-phase booking commit: the pass's whole allocation
+            # lands in the ledger atomically; the waves below actuate
+            # it, and every failure edge re-books through the ledger
+            # (the booking-release contract vodacheck enforces).
+            self.job_num_chips.commit_pass(new)
             halts, scale_ins, scale_outs, starts = self.compare_results(old)
             changed = bool(halts or scale_ins or scale_outs or starts)
             for job in starts:
@@ -843,7 +913,7 @@ class Scheduler:
                               "booked so the halt is retried", job)
                 with self._lock:
                     self._add_reason(job, "halt_failed")
-                    self.job_num_chips[job] = old.get(job, 0)
+                    self.job_num_chips.commit(job, old.get(job, 0))
                     halt_failures.append(job)
 
         wave1 = ([(job, (lambda j=job: _halt_task(j))) for job in halts]
@@ -865,7 +935,7 @@ class Scheduler:
             # to the retry, which recomputes from consistent state.
             with self._lock:
                 for job in scale_outs + starts:
-                    self.job_num_chips[job] = old.get(job, 0)
+                    self.job_num_chips.commit(job, old.get(job, 0))
                     self._add_reason(job, "reverted_release_failure")
                 self._placement_dirty = True
             self._schedule_retry()
@@ -1116,8 +1186,9 @@ class Scheduler:
         their failure isolation."""
         delay = self.rate_limit_seconds + 1.0
         if isinstance(self.clock, VirtualClock):
-            self.clock.call_later(delay,
-                                  lambda: self.trigger_resched("retry"))
+            with self._lock:
+                self._retries_armed += 1
+            self.clock.call_later(delay, self._fire_retry)
         else:
             # Real-time mode: keep the request pending (the service
             # daemon's pump retries once the window opens) AND arm a
@@ -1198,23 +1269,26 @@ class Scheduler:
             except Exception:  # noqa: BLE001 - storm may still be on
                 with self._lock:
                     self._add_reason(name, "scale_failed")
-                    self.job_num_chips[name] = old_chips
+                    self.job_num_chips.commit(name, old_chips)
                 self._schedule_retry()
                 return
             with self._lock:
                 self._add_reason(name, "scale_failed")
                 if name in live:
-                    self.job_num_chips[name] = live[name].num_workers
+                    self.job_num_chips.commit(name, live[name].num_workers)
                 else:
                     self._revert_to_waiting(name)
             self._schedule_retry()
 
     def _revert_to_waiting(self, name: str) -> None:
         with self._lock:
-            self.job_num_chips[name] = 0
+            self.job_num_chips.commit(name, 0)
             job = self.ready_jobs.get(name)
             if job is not None and job.status == JobStatus.RUNNING:
-                job.status = JobStatus.WAITING
+                lifecycle.transition(job, JobStatus.WAITING,
+                                     reason="backend_lost", chips=0,
+                                     tracer=self.tracer,
+                                     pool=self.pool_id)
                 job.metrics.last_waiting_seconds = 0.0
                 self.store.update_job(job)
 
@@ -1233,7 +1307,9 @@ class Scheduler:
             self.backend.start_job(job.spec, chips, placements)
         with self._lock:
             self.m_job_restarts.inc()
-            job.status = JobStatus.RUNNING
+            lifecycle.transition(job, JobStatus.RUNNING, reason="scheduled",
+                                 chips=self.job_num_chips.get(name, 0),
+                                 tracer=self.tracer, pool=self.pool_id)
             job.metrics.last_chip_seconds = 0.0
             job.metrics.last_running_seconds = 0.0
             job.metrics.seconds_since_restart = 0.0
@@ -1299,7 +1375,11 @@ class Scheduler:
             self.backend.stop_job(name)
         if job is not None:
             with self._lock:
-                job.status = JobStatus.WAITING
+                lifecycle.transition(job, JobStatus.WAITING,
+                                     reason="preempted",
+                                     chips=self.job_num_chips.get(name, 0),
+                                     tracer=self.tracer,
+                                     pool=self.pool_id)
                 job.metrics.last_waiting_seconds = 0.0
                 self.store.update_job(job)
 
@@ -1459,10 +1539,17 @@ class Scheduler:
                 continue
             handle = running.get(job.name)
             n = handle.num_workers if handle else 0
-            job.status = JobStatus.RUNNING if n > 0 else JobStatus.WAITING
+            # Re-assert status from store + backend truth. Same-status
+            # re-assertions are DECLARED self-loops and emit their audit
+            # record (the resume trail used to be silent).
+            lifecycle.transition(
+                job,
+                JobStatus.RUNNING if n > 0 else JobStatus.WAITING,
+                reason="resume", chips=n, tracer=self.tracer,
+                pool=self.pool_id)
             job.metrics.last_update_time = self.clock.now()
             self.ready_jobs[job.name] = job
-            self.job_num_chips[job.name] = n
+            self.job_num_chips.commit(job.name, n)
         if self.placement_manager is not None:
             self.placement_manager.restore(
                 {name: h.placements for name, h in running.items()
