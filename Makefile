@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-selftest lock-order bench bench-dryrun trace-dryrun native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-selftest lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -85,6 +85,33 @@ bench-dryrun:
 # tests/test_obs.py.
 trace-dryrun:
 	$(PY) -m vodascheduler_tpu.obs.dryrun
+
+# Regenerate the committed decide-path scaling baseline
+# (doc/perf_baseline.json): per-phase latency-vs-N curves for
+# N in {100, 1k, 10k} on the fake backend, pinned seed (~30s). Review
+# the diff like any artifact — this is what the perf gate compares
+# against (doc/observability.md "Performance observatory").
+perf-baseline:
+	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py \
+		--out doc/perf_baseline.json
+
+# CI perf-regression gate: re-measure a bounded N set and fail if the
+# decide phase (or any >=1ms sub-phase) regressed past
+# baseline * tolerance + slack. Prints the full comparison table and
+# always writes the fresh curves (doc/perf_gate_fresh.json, uploaded as
+# a CI artifact on failure) so a regression is diagnosable from the CI
+# log alone. The CI band (x4 + 50ms) is deliberately wider than the
+# tool's default: the committed baseline comes from whatever machine
+# last ran `make perf-baseline`, and shared CI runners are slower and
+# noisier — this invocation catches step-change regressions (an extra
+# O(n) sweep, an accidental sleep), while the tight same-machine signal
+# lives in tests/test_perf_profile.py's hermetic gate tests (baseline
+# and fresh run generated in the same process).
+perf-gate:
+	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py \
+		--check doc/perf_baseline.json --ns 100,1000 \
+		--tolerance 4.0 --slack-ms 50 \
+		--fresh-out doc/perf_gate_fresh.json
 
 # Build the C++ resched kernels from source. The binary is a build
 # artifact (never checked into git — .gitignore covers *.so); CI and

@@ -103,6 +103,46 @@ def run_replay():
     return harness.run(), audit_path
 
 
+def decide_scaling(repo_dir: str) -> object:
+    """The decide-path scaling curves (doc/perf_baseline.json, the
+    performance observatory): per-N decide/actuate wall time and the
+    dominant phase, so the BENCH trajectory carries decide-path numbers
+    alongside the replay headline. Regenerate with `make perf-baseline`."""
+    path = os.path.join(repo_dir, "doc", "perf_baseline.json")
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"error": f"doc/perf_baseline.json unreadable: {e}"}
+    rows = []
+    try:
+        for curve in baseline.get("curves", []):
+            phases = curve.get("phases", {})
+            dominant = max(phases, key=lambda p: phases[p]["wall_ms_mean"],
+                           default=None)
+            rows.append({
+                "n_jobs": curve["n_jobs"],
+                "total_chips": curve.get("total_chips"),
+                "decide_wall_ms_mean": curve["decide_wall_ms"]["mean"],
+                "actuate_wall_ms_mean": curve["actuate_wall_ms"]["mean"],
+                "cpu_ms_mean": curve.get("cpu_ms", {}).get("mean"),
+                "dominant_phase": dominant,
+                "dominant_phase_wall_ms_mean": (
+                    phases[dominant]["wall_ms_mean"] if dominant else None),
+            })
+    except (KeyError, TypeError) as e:
+        # A schema-drifted baseline must degrade this summary row, not
+        # abort the whole bench artifact (replay headline included).
+        return {"error": f"doc/perf_baseline.json schema mismatch: "
+                         f"{type(e).__name__}: {e}"}
+    return {"source": "doc/perf_baseline.json",
+            "seed": baseline.get("seed"),
+            # ROADMAP item 2's target for the 10k decide phase; recorded
+            # here so every bench round states the current gap.
+            "decide_target_ms_at_10k": 50.0,
+            "rows": rows}
+
+
 def audit_provenance(audit_path: str) -> dict:
     """Schema-validate the captured audit JSONL and summarize it for the
     bench artifact's detail section."""
@@ -441,6 +481,10 @@ def main() -> None:
         # Per-decision provenance: the replay's full audit stream
         # (schema-validated JSONL) rides alongside the benchrunner rows.
         "audit": audit_provenance(audit_path),
+        # Decide-path scaling (the performance observatory): the
+        # committed per-phase latency-vs-N curves, summarized.
+        "decide_scaling": decide_scaling(
+            os.path.dirname(os.path.abspath(__file__))),
     }
     hw = maybe_hardware()
     if hw is not None:

@@ -1,0 +1,380 @@
+#!/usr/bin/env python
+"""Decide-path scale harness + CI perf-regression gate.
+
+Synthesizes N-job pools (default N ∈ {100, 1k, 10k}) on a
+FakeClusterBackend under a VirtualClock, runs pinned-seed rescheduling
+passes through the REAL control plane (admission → allocator →
+scheduler → placement), and captures each pass's phase-level
+`perf_report` (obs/profile.py) — the per-phase latency-vs-N curves
+ROADMAP item 2's vectorization work will be judged against. Wall time is
+real compute (the profiler reads time.monotonic, never the virtual
+clock), so a curve point is "what a pass of this shape costs in Python
+today".
+
+Modes:
+  --out doc/perf_baseline.json          regenerate the committed baseline
+                                        (`make perf-baseline`; review the
+                                        diff like any other artifact)
+  --check doc/perf_baseline.json        the CI gate (`make perf-gate`):
+                                        re-measure a bounded N set and
+                                        fail if the decide phase — or any
+                                        sub-phase that costs >= 1 ms in
+                                        the baseline — regressed past
+                                        baseline * tolerance + slack.
+                                        Fresh curves always land in
+                                        --fresh-out so a CI failure is
+                                        diagnosable from the artifact +
+                                        the printed table alone.
+
+The tolerance band (default 3.0x + 25 ms slack) absorbs machine-to-
+machine variance; a genuine algorithmic slowdown (the gate's self-test
+injects a sleep into the placement phase) lands far outside it.
+
+Churn model: each measured pass is triggered by one job deletion + one
+new submission (the coalescing window collects both), so the pass
+exercises allocation over the full queue, an incremental placement, and
+a small actuation wave — the steady-state shape of a busy pool, not an
+empty-to-full stampede (the warm-up pass covers that shape once).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import random
+import statistics
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_NS = (100, 1000, 10000)
+DEFAULT_PASSES = 3
+DEFAULT_SEED = 20260803
+DEFAULT_RATE_LIMIT = 5.0
+DEFAULT_TOLERANCE = 3.0
+DEFAULT_SLACK_MS = 25.0
+CHIPS_PER_HOST = 8
+# Sub-phases cheaper than this in the baseline are not gated — at small
+# N they sit in scheduling-noise territory and would flake the gate.
+GATE_PHASE_FLOOR_MS = 1.0
+# A pure-Python Hungarian bind on a big fleet is O(hosts^3); without the
+# native kernel the one-shot defragment probe is skipped (tagged, never
+# silent) above this host count.
+DEFRAG_PYTHON_HOST_LIMIT = 300
+
+SCHEMA = 1
+
+
+def build_world(n_jobs: int, seed: int,
+                rate_limit_seconds: float = DEFAULT_RATE_LIMIT):
+    """One pool sized to its queue: ~1 host per 8 jobs, so demand
+    saturates capacity (every pass allocates under contention)."""
+    from vodascheduler_tpu.allocator import ResourceAllocator
+    from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+    from vodascheduler_tpu.common.clock import VirtualClock
+    from vodascheduler_tpu.common.events import EventBus
+    from vodascheduler_tpu.common.store import JobStore
+    from vodascheduler_tpu.obs import tracer as obs_tracer
+    from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.scheduler import Scheduler
+    from vodascheduler_tpu.service import AdmissionService
+
+    clock = VirtualClock(start=1753760000.0)
+    tracer = obs_tracer.Tracer(clock=clock)
+    store = JobStore()
+    bus = EventBus()
+    backend = FakeClusterBackend(clock)
+    hosts = max(2, n_jobs // CHIPS_PER_HOST)
+    for i in range(hosts):
+        backend.add_host(f"host-{i}", CHIPS_PER_HOST, announce=False)
+    pm = PlacementManager("perf-pool")
+    sched = Scheduler("perf-pool", backend, store, ResourceAllocator(store),
+                      clock, bus=bus, placement_manager=pm,
+                      algorithm="ElasticTiresias",
+                      rate_limit_seconds=rate_limit_seconds, tracer=tracer)
+    admission = AdmissionService(store, bus, clock)
+    return clock, store, backend, sched, admission, random.Random(seed)
+
+
+def _make_spec(i: int, rng: random.Random):
+    from vodascheduler_tpu.common.job import JobConfig, JobSpec
+    # Small elastic jobs (the long-tail shape a 10k-job pool actually
+    # carries); epochs huge so nothing completes mid-measurement.
+    max_chips = rng.choice((1, 2, 2, 4, 4, 8))
+    return JobSpec(name=f"perf-{i:05d}", pool="perf-pool",
+                   config=JobConfig(min_num_chips=1, max_num_chips=max_chips,
+                                    epochs=100000))
+
+
+def _agg(values: List[float]) -> Dict[str, float]:
+    return {"mean": round(statistics.mean(values), 3) if values else 0.0,
+            "max": round(max(values), 3) if values else 0.0}
+
+
+def _probe_defragment(sched, hosts: int) -> Dict[str, object]:
+    """One-shot full-repack probe: the incremental steady state never
+    pays the Hungarian bind, but item 2 needs its cost curve too. Times
+    defragment() (and its nested hungarian phase) directly."""
+    from vodascheduler_tpu import native
+    from vodascheduler_tpu.obs import profile as obs_profile
+
+    if hosts > DEFRAG_PYTHON_HOST_LIMIT and native.get_lib() is None:
+        return {"skipped": f"pure-python Hungarian at {hosts} hosts "
+                           f"(O(n^3)); build native/_voda_native.so"}
+    requests = {j: n for j, n in sched.job_num_chips.items() if n > 0}
+    timer = obs_profile.PhaseTimer()
+    t0 = time.monotonic()
+    with obs_profile.use_timer(timer):
+        with timer.phase("placement"):
+            sched.placement_manager.defragment(requests)
+    wall_ms = (time.monotonic() - t0) * 1000.0
+    report = timer.report()
+    return {"wall_ms": round(wall_ms, 3),
+            "hungarian_wall_ms": report.get("hungarian",
+                                            {}).get("wall_ms", 0.0),
+            "jobs_placed": len(requests)}
+
+
+def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
+              seed: int = DEFAULT_SEED,
+              inject: Optional[Tuple[str, float]] = None) -> Dict[str, object]:
+    """Measure one N: warm-up fill pass, then `passes` churn-triggered
+    passes, aggregated from their perf_report records.
+
+    `inject` = (phase, sleep_ms) seeds a deliberate slowdown into the
+    named stage ("placement" or "allocate") — the gate's self-test
+    (tests/test_perf_profile.py) proves a seeded regression is caught.
+    """
+    clock, store, backend, sched, admission, rng = build_world(n_jobs, seed)
+
+    if inject is not None:
+        phase_name, sleep_ms = inject
+        if phase_name == "placement":
+            pm = sched.placement_manager
+            orig_place = pm.place
+
+            def slow_place(requests):
+                time.sleep(sleep_ms / 1000.0)
+                return orig_place(requests)
+
+            pm.place = slow_place
+        elif phase_name == "allocate":
+            orig_alloc = sched.allocator.allocate
+
+            def slow_alloc(request):
+                time.sleep(sleep_ms / 1000.0)
+                return orig_alloc(request)
+
+            sched.allocator.allocate = slow_alloc
+        else:
+            raise ValueError(f"injectable phases: placement, allocate "
+                             f"(got {phase_name!r})")
+
+    alive: List[str] = []
+    for i in range(n_jobs):
+        alive.append(admission.create_training_job(_make_spec(i, rng)))
+    # Fire the coalesced fill pass (every job after the first landed in
+    # one window) and let retriggers settle.
+    clock.advance(2 * DEFAULT_RATE_LIMIT + 2.0)
+    warmup_seq = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
+
+    next_id = n_jobs
+    for _ in range(passes):
+        # One deletion + one submission per window: both triggers
+        # coalesce into a single churn pass.
+        victim = alive.pop(rng.randrange(len(alive)))
+        admission.delete_training_job(victim)
+        alive.append(admission.create_training_job(
+            _make_spec(next_id, rng)))
+        next_id += 1
+        clock.advance(DEFAULT_RATE_LIMIT + 2.0)
+
+    samples = [r for r in sched.profile_records(0)
+               if r["seq"] > warmup_seq]
+    if not samples:  # pragma: no cover - harness bug guard
+        raise RuntimeError(f"no measured passes at N={n_jobs}")
+
+    phase_stats: Dict[str, Dict[str, List[float]]] = {}
+    for rec in samples:
+        for name, stats in rec["phases"].items():
+            agg = phase_stats.setdefault(name, {"wall": [], "cpu": [],
+                                                "count": []})
+            agg["wall"].append(stats["wall_ms"])
+            agg["cpu"].append(stats["cpu_ms"])
+            agg["count"].append(stats["count"])
+
+    hosts = max(2, n_jobs // CHIPS_PER_HOST)
+    curve = {
+        "n_jobs": n_jobs,
+        "hosts": hosts,
+        "chips_per_host": CHIPS_PER_HOST,
+        "total_chips": hosts * CHIPS_PER_HOST,
+        "passes_measured": len(samples),
+        "decide_wall_ms": _agg([r["decide_ms"] for r in samples]),
+        "actuate_wall_ms": _agg([r["actuate_ms"] for r in samples]),
+        "duration_ms": _agg([r["duration_ms"] for r in samples]),
+        "cpu_ms": _agg([r["cpu_ms"] for r in samples]),
+        "phases": {
+            name: {
+                "wall_ms_mean": round(statistics.mean(agg["wall"]), 3),
+                "wall_ms_max": round(max(agg["wall"]), 3),
+                "cpu_ms_mean": round(statistics.mean(agg["cpu"]), 3),
+                "count_mean": round(statistics.mean(agg["count"]), 2),
+            }
+            for name, agg in sorted(phase_stats.items())
+        },
+        "defragment_probe": _probe_defragment(sched, hosts),
+    }
+    sched.stop()
+    return curve
+
+
+def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
+              seed: int = DEFAULT_SEED, verbose: bool = True) -> dict:
+    curves = []
+    for n in ns:
+        t0 = time.monotonic()
+        curve = run_point(n, passes=passes, seed=seed)
+        if verbose:
+            print(f"perf_scale: N={n}: decide "
+                  f"{curve['decide_wall_ms']['mean']}ms mean "
+                  f"({time.monotonic() - t0:.1f}s to measure)",
+                  file=sys.stderr)
+        curves.append(curve)
+    return {
+        "schema": SCHEMA,
+        "tool": "scripts/perf_scale.py",
+        "note": ("Per-phase decide/actuate latency-vs-N curves on the "
+                 "fake backend (pinned seed). Regenerate with `make "
+                 "perf-baseline` and review the diff; `make perf-gate` "
+                 "compares a fresh bounded-N run against this file. "
+                 "doc/observability.md 'Performance observatory'."),
+        "seed": seed,
+        "passes": passes,
+        "rate_limit_seconds": DEFAULT_RATE_LIMIT,
+        "python": platform.python_version(),
+        "curves": curves,
+    }
+
+
+# ---- the gate ---------------------------------------------------------------
+
+
+def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
+            slack_ms: float = DEFAULT_SLACK_MS) -> List[str]:
+    """Regressions of the fresh run vs the baseline; empty = gate
+    passes. A fresh mean above `base * tolerance + slack_ms` fails —
+    for the decide half always, and for any sub-phase whose baseline
+    mean is >= GATE_PHASE_FLOOR_MS (cheaper phases are noise-bound)."""
+    problems: List[str] = []
+    base_by_n = {c["n_jobs"]: c for c in baseline.get("curves", [])}
+    for curve in fresh["curves"]:
+        n = curve["n_jobs"]
+        base = base_by_n.get(n)
+        if base is None:
+            problems.append(f"N={n}: no baseline curve (regenerate with "
+                            f"make perf-baseline)")
+            continue
+
+        def check(label: str, fresh_ms: float, base_ms: float) -> None:
+            bound = base_ms * tolerance + slack_ms
+            verdict = "ok" if fresh_ms <= bound else "REGRESSED"
+            print(f"  N={n:>6} {label:<18} base={base_ms:>10.3f}ms "
+                  f"fresh={fresh_ms:>10.3f}ms bound={bound:>10.3f}ms "
+                  f"{verdict}")
+            if fresh_ms > bound:
+                problems.append(
+                    f"N={n}: {label} regressed: {fresh_ms:.3f}ms vs "
+                    f"baseline {base_ms:.3f}ms (bound {bound:.3f}ms)")
+
+        check("decide", curve["decide_wall_ms"]["mean"],
+              base["decide_wall_ms"]["mean"])
+        for name, stats in base.get("phases", {}).items():
+            if stats["wall_ms_mean"] < GATE_PHASE_FLOOR_MS:
+                continue
+            fresh_phase = curve.get("phases", {}).get(name)
+            if fresh_phase is None:
+                problems.append(f"N={n}: phase {name!r} in baseline but "
+                                f"absent from the fresh run")
+                continue
+            check(name, fresh_phase["wall_ms_mean"], stats["wall_ms_mean"])
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perf_scale",
+        description="decide-path scale curves + CI perf-regression gate "
+                    "(doc/observability.md 'Performance observatory')")
+    parser.add_argument("--ns", default=None,
+                        help="comma-separated job counts "
+                             f"(default {','.join(map(str, DEFAULT_NS))})")
+    parser.add_argument("--passes", type=int, default=DEFAULT_PASSES)
+    parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    parser.add_argument("--out", default=None,
+                        help="write the measured curves to this baseline "
+                             "file and exit")
+    parser.add_argument("--check", default=None, metavar="BASELINE",
+                        help="gate mode: compare a fresh run against the "
+                             "committed baseline")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="allowed fresh/baseline ratio (default 3.0)")
+    parser.add_argument("--slack-ms", type=float, default=DEFAULT_SLACK_MS,
+                        help="absolute slack added to every bound")
+    parser.add_argument("--fresh-out", default=None,
+                        help="where --check writes the fresh curves "
+                             "(default doc/perf_gate_fresh.json; uploaded "
+                             "as a CI artifact on failure)")
+    parser.add_argument("--inject-phase", default=None,
+                        choices=("placement", "allocate"),
+                        help="seed a sleep into this stage (gate "
+                             "self-test)")
+    parser.add_argument("--inject-ms", type=float, default=0.0)
+    args = parser.parse_args(argv)
+
+    ns = (tuple(int(x) for x in args.ns.split(",")) if args.ns
+          else DEFAULT_NS)
+
+    if args.check:
+        with open(args.check) as f:
+            baseline = json.load(f)
+        if args.inject_phase:
+            # Self-test path: measure with the seeded slowdown.
+            curves = [run_point(n, passes=args.passes, seed=args.seed,
+                                inject=(args.inject_phase, args.inject_ms))
+                      for n in ns]
+            fresh = {"schema": SCHEMA, "curves": curves}
+        else:
+            fresh = run_suite(ns, passes=args.passes, seed=args.seed)
+        fresh_out = args.fresh_out or os.path.join(
+            os.path.dirname(args.check), "perf_gate_fresh.json")
+        with open(fresh_out, "w") as f:
+            json.dump(fresh, f, indent=1, sort_keys=True)
+        print(f"perf-gate: comparing against {args.check} "
+              f"(tolerance x{args.tolerance} + {args.slack_ms}ms slack); "
+              f"fresh curves -> {fresh_out}")
+        problems = compare(baseline, fresh, tolerance=args.tolerance,
+                           slack_ms=args.slack_ms)
+        for p in problems:
+            print(f"perf-gate: FAIL: {p}")
+        print(f"perf-gate: {'FAILED' if problems else 'ok'} "
+              f"({len(problems)} regression(s))")
+        return 1 if problems else 0
+
+    result = run_suite(ns, passes=args.passes, seed=args.seed)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out} ({len(result['curves'])} curve(s))")
+    else:
+        print(json.dumps(result, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -241,3 +241,150 @@ class TestMetricsConcurrency:
         assert summary.mean(k="a") == 1.0
         assert hist.count(k="a") == N * WRITERS
         assert hist.bucket_counts(k="a") == {0.5: 0, 1.5: N * WRITERS}
+
+    @staticmethod
+    def _parse_histogram(text, family):
+        """{label_json: {"buckets": [(le, v), ...], "count": v, "sum": v}}
+        from one exposition scrape."""
+        import re as _re
+
+        series = {}
+        for line in text.splitlines():
+            if not line.startswith(family) or line.startswith("# "):
+                continue
+            m = _re.match(rf"{family}(_bucket|_sum|_count)?({{[^}}]*}})? (.+)",
+                          line)
+            if not m:
+                continue
+            suffix, labels, value = m.group(1) or "", m.group(2) or "", \
+                m.group(3)
+            le = None
+            if suffix == "_bucket":
+                lem = _re.search(r'le="([^"]+)"', labels)
+                le = lem.group(1)
+                labels = _re.sub(r',?le="[^"]+"', "", labels)
+            entry = series.setdefault(labels, {"buckets": [], "count": None,
+                                               "sum": None})
+            if suffix == "_bucket":
+                entry["buckets"].append((le, float(value)))
+            elif suffix == "_count":
+                entry["count"] = float(value)
+            elif suffix == "_sum":
+                entry["sum"] = float(value)
+        return series
+
+    def test_histogram_exposition_consistent_under_concurrent_observe(self):
+        """The performance-observatory satellite pin: collect()
+        snapshots buckets/sum/count under ONE lock hold
+        (common/metrics.py), so a scrape racing observe() may be stale
+        but never torn — within one exposition text every series'
+        bucket{+Inf} equals its _count, cumulative buckets are
+        monotone, and finite le bounds are ascending with +Inf last.
+        (Without the snapshot, a mid-scrape observe lands in _count but
+        not the already-rendered buckets.)"""
+        import threading
+
+        from vodascheduler_tpu.common.metrics import Registry
+
+        r = Registry()
+        hist = r.histogram("voda_torn_scrape_seconds", "h", ("op",),
+                           buckets=(0.01, 0.1, 1.0, 10.0))
+        stop = threading.Event()
+        problems = []
+
+        def write_loop():
+            values = (0.005, 0.05, 0.5, 5.0, 50.0)
+            i = 0
+            while not stop.is_set():
+                hist.observe(values[i % len(values)], op="a")
+                hist.observe(values[(i + 2) % len(values)], op="b")
+                i += 1
+
+        writers = [threading.Thread(target=write_loop) for _ in range(4)]
+        for t in writers:
+            t.start()
+        try:
+            for _ in range(300):
+                text = r.exposition()
+                for labels, entry in self._parse_histogram(
+                        text, "voda_torn_scrape_seconds").items():
+                    les = [le for le, _ in entry["buckets"]]
+                    if les != ["0.01", "0.1", "1", "10", "+Inf"]:
+                        problems.append(f"{labels}: le order {les}")
+                    counts = [v for _, v in entry["buckets"]]
+                    if counts != sorted(counts):
+                        problems.append(f"{labels}: non-monotone {counts}")
+                    if entry["count"] is not None \
+                            and counts and counts[-1] != entry["count"]:
+                        problems.append(
+                            f"{labels}: bucket(+Inf)={counts[-1]} != "
+                            f"count={entry['count']} — torn scrape")
+                if problems:
+                    break
+        finally:
+            stop.set()
+            for t in writers:
+                t.join()
+        assert not problems, problems[:5]
+
+    def test_summary_exposition_consistent_under_concurrent_observe(self):
+        """Same snapshot pin for Summary: every observation is exactly
+        2.0, so in any single scrape _sum must equal 2 * _count — a sum
+        and count taken from different lock holds would drift apart."""
+        import re as _re
+        import threading
+
+        from vodascheduler_tpu.common.metrics import Registry
+
+        r = Registry()
+        summary = r.summary("voda_torn_summary_seconds", "s", ("op",))
+        stop = threading.Event()
+        problems = []
+
+        def write_loop():
+            while not stop.is_set():
+                summary.observe(2.0, op="a")
+
+        writers = [threading.Thread(target=write_loop) for _ in range(4)]
+        for t in writers:
+            t.start()
+        try:
+            for _ in range(300):
+                text = r.exposition()
+                pairs = {}
+                for line in text.splitlines():
+                    m = _re.match(
+                        r"voda_torn_summary_seconds_(sum|count)"
+                        r"({[^}]*}) (.+)", line)
+                    if m:
+                        pairs.setdefault(m.group(2), {})[m.group(1)] = \
+                            float(m.group(3))
+                for labels, pair in pairs.items():
+                    if len(pair) == 2 and pair["sum"] != 2.0 * pair["count"]:
+                        problems.append(f"{labels}: sum={pair['sum']} "
+                                        f"count={pair['count']}")
+                if problems:
+                    break
+        finally:
+            stop.set()
+            for t in writers:
+                t.join()
+        assert not problems, problems[:5]
+
+    def test_histogram_bucket_order_normalized(self):
+        """Unsorted construction bounds render ascending with +Inf last
+        (Prometheus le contract), and every bound line appears even
+        when only one bucket ever observed."""
+        from vodascheduler_tpu.common.metrics import Registry
+
+        r = Registry()
+        hist = r.histogram("voda_unsorted_seconds", "h",
+                           buckets=(10.0, 0.1, 1.0))
+        assert hist.buckets == (0.1, 1.0, 10.0)
+        hist.observe(0.5)
+        lines = [ln for ln in r.exposition().splitlines()
+                 if ln.startswith("voda_unsorted_seconds_bucket")]
+        les = [ln.split('le="')[1].split('"')[0] for ln in lines]
+        assert les == ["0.1", "1", "10", "+Inf"]
+        values = [float(ln.rsplit(" ", 1)[1]) for ln in lines]
+        assert values == [0.0, 1.0, 1.0, 1.0]

@@ -51,12 +51,24 @@ class TestMetricsCatalog:
         for series in ("voda_scheduler_resched_latency_seconds",
                        "voda_scheduler_actuation_seconds",
                        "voda_scheduler_resize_duration_seconds",
+                       "voda_scheduler_phase_seconds",
                        "voda_allocator_algorithm_runtime_seconds",
                        "voda_job_step_time_seconds"):
             rows = [ln for ln in doc.splitlines() if series in ln]
             assert rows, f"{series} missing from the catalog"
             assert any("histogram" in row for row in rows), \
                 f"{series} row does not declare type histogram"
+
+    def test_resched_latency_phase_split_documented(self):
+        """The decide/actuate latency split (performance observatory):
+        the catalog row must name both label values — a reader querying
+        the old unlabeled series would silently match nothing."""
+        with open(os.path.join(REPO, "doc",
+                               "prometheus-metrics-exposed.md")) as f:
+            doc = f.read()
+        row = next(ln for ln in doc.splitlines()
+                   if "voda_scheduler_resched_latency_seconds" in ln)
+        assert 'phase="decide"' in row and 'phase="actuate"' in row
 
 
 class TestApisDoc:
@@ -78,9 +90,10 @@ class TestApisDoc:
         with open(os.path.join(REPO, "vodascheduler_tpu", "service",
                                "rest.py")) as f:
             rest = f.read()
-        for route in ("/debug/resched", "/debug/trace"):
+        for route in ("/debug/resched", "/debug/trace", "/debug/profile"):
             assert route in doc and route in rest
         assert "explain" in doc  # the CLI verb riding these routes
+        assert "voda top" in doc  # the profile surface's CLI verb
 
     def test_observability_doc_covers_contract(self):
         """doc/observability.md documents the record schema, the reason
@@ -99,8 +112,29 @@ class TestApisDoc:
                      "VODA_TRACE_MAX_MB"):
             assert knob in doc, f"retention knob {knob} undocumented"
         for kind in ("resched_audit", "span", "http_access",
-                     "status_transition", "modelcheck_counterexample"):
+                     "status_transition", "modelcheck_counterexample",
+                     "perf_report"):
             assert kind in doc, f"record kind {kind} undocumented"
+
+    def test_performance_observatory_documented(self):
+        """The performance observatory contract is pinned both ways:
+        every PHASE_NAMES entry is documented in the phase table, no
+        documented phase is undeclared, and the baseline/gate workflow
+        terms are present."""
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        from vodascheduler_tpu.obs import PHASE_NAMES
+        assert "Performance observatory" in doc
+        for name in sorted(PHASE_NAMES):
+            assert f"`{name}`" in doc, f"phase {name!r} undocumented"
+        # Reverse: the phase table's rows name only declared phases.
+        table = re.findall(r"\| `([a-z_]+)` \| (?:decide|actuate) \|", doc)
+        assert set(table) == set(PHASE_NAMES), \
+            f"phase table out of sync: {sorted(set(table) ^ set(PHASE_NAMES))}"
+        for term in ("perf_baseline.json", "make perf-baseline",
+                     "make perf-gate", "/debug/profile", "voda top",
+                     "PhaseTimer", "decide_scaling"):
+            assert term in doc, f"observatory term {term!r} missing"
 
     def test_observability_doc_covers_concurrency_model(self):
         """The concurrent actuation plane's contract is documented: the

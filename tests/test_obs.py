@@ -238,7 +238,8 @@ class TestStitchedTraceRoundTrip:
         admission.create_training_job(_spec("two"))
         clock.tick(6.0)
         sched.pump()
-        assert sched.h_resched_latency.count() >= 2
+        assert sched.h_resched_latency.count(phase="decide") >= 2
+        assert sched.h_resched_latency.count(phase="actuate") >= 2
         assert sched.h_resize_duration.count(path="fast") == 1
         assert sched.allocator.h_algo_runtime.count(
             algorithm="ElasticFIFO") >= 2
@@ -357,6 +358,42 @@ class TestDebugEndpoints:
             out = capsys.readouterr().out
             assert "decision history" in out
             assert "resize_inplace" in out or "scale_in" in out
+            # The performance-observatory satellite: explain shows
+            # where the last pass's time went, with the job's share.
+            assert "last pass phase costs" in out
+            assert "decide" in out and "actuate" in out
+            assert "ms/job share" in out
+            assert "allocate" in out
+        finally:
+            server.stop()
+
+    def test_debug_profile_route_and_top_cli(self, capsys):
+        """GET /debug/profile serves schema-valid perf_report records
+        (same ring shape as /debug/resched), and `voda top` renders the
+        per-phase p50/p95 table + slowest passes from them."""
+        from vodascheduler_tpu import cli
+        server, sched, a, b = self._serve()
+        try:
+            records = self._get(server.port, "/debug/profile?n=5")
+            assert records and records[-1]["kind"] == "perf_report"
+            for rec in records:
+                assert not obs_audit.validate_record(rec)
+                assert rec["decide_ms"] >= 0 and rec["phases"]
+            # perf_report seq/trace_id pair with the pass's audit record.
+            audits = {r["seq"]: r for r in
+                      self._get(server.port, "/debug/resched?n=5")}
+            for rec in records:
+                assert rec["trace_id"] == audits[rec["seq"]]["trace_id"]
+            rc = cli.main(["--scheduler-server",
+                           f"http://127.0.0.1:{server.port}", "top"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "P50_MS" in out and "P95_MS" in out
+            for phase in ("allocate", "placement", "commit"):
+                assert phase in out
+            assert "slowest" in out and "dominant:" in out
+            # the pass's triggering jobs are named
+            assert a.split("-")[0] in out
         finally:
             server.stop()
 

@@ -1,0 +1,259 @@
+"""The performance observatory (obs/profile.py + scripts/perf_scale.py):
+PhaseTimer mechanics, perf_report schema, the committed scaling
+baseline, the tier-1 microbench, and the perf-regression gate's teeth.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import profile as obs_profile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import perf_scale  # noqa: E402
+
+
+class TestPhaseTimer:
+    def test_phases_accumulate_wall_and_cpu(self):
+        t = obs_profile.PhaseTimer()
+        with t.phase("allocate"):
+            sum(range(20000))
+        with t.phase("allocate"):
+            pass
+        rep = t.report()
+        assert rep["allocate"]["count"] == 2
+        assert rep["allocate"]["wall_ms"] >= 0.0
+        assert set(rep) == {"allocate"}
+
+    def test_unknown_phase_rejected(self):
+        t = obs_profile.PhaseTimer()
+        with pytest.raises(ValueError, match="PHASE_NAMES"):
+            with t.phase("vibes"):
+                pass
+
+    def test_nesting_is_additive(self):
+        """hungarian-inside-placement accrues into both (the parent
+        answers end-to-end cost, the child the solve's share)."""
+        t = obs_profile.PhaseTimer()
+        with t.phase("placement"):
+            with t.phase("hungarian"):
+                sum(range(10000))
+        rep = t.report()
+        assert rep["placement"]["wall_ms"] >= rep["hungarian"]["wall_ms"]
+
+    def test_decide_end_first_mark_wins(self):
+        t = obs_profile.PhaseTimer()
+        assert t.decide_seconds is None
+        t.mark_decide_end()
+        first = t.decide_seconds
+        t.mark_decide_end()
+        assert t.decide_seconds == first
+
+    def test_cpu_sampling_opt_out(self):
+        """cpu=False (the model checker's wall-only mode) skips the
+        process_time syscall entirely; wall numbers still accrue."""
+        t = obs_profile.PhaseTimer(cpu=False)
+        with t.phase("allocate"):
+            sum(range(20000))
+        rep = t.report()
+        assert rep["allocate"]["cpu_ms"] == 0.0
+        assert rep["allocate"]["wall_ms"] >= 0.0
+        assert t.cpu_seconds() == 0.0
+
+    def test_ambient_timer_no_ops_without_install(self):
+        # Downstream components call obs_profile.phase unconditionally;
+        # with no pass being profiled it must cost nothing and record
+        # nowhere.
+        assert obs_profile.current_timer() is None
+        with obs_profile.phase("hungarian"):
+            pass
+        t = obs_profile.PhaseTimer()
+        with obs_profile.use_timer(t):
+            assert obs_profile.current_timer() is t
+            with obs_profile.phase("hungarian"):
+                pass
+        assert obs_profile.current_timer() is None
+        assert t.report()["hungarian"]["count"] == 1
+
+
+class TestPerfReportSchema:
+    def _record(self, **over):
+        rec = {"kind": "perf_report", "schema": 1, "ts": 0.0, "pool": "p",
+               "seq": 1, "trace_id": "t", "outcome": "applied",
+               "triggers": ["manual"], "num_jobs": 3, "jobs": ["a"],
+               "duration_ms": 1.0, "cpu_ms": 1.0, "decide_ms": 0.8,
+               "actuate_ms": 0.2,
+               "phases": {"allocate": {"wall_ms": 0.5, "cpu_ms": 0.5,
+                                       "count": 1}}}
+        rec.update(over)
+        return rec
+
+    def test_valid_record_passes(self):
+        assert not obs_audit.validate_record(self._record())
+
+    def test_unknown_phase_rejected(self):
+        rec = self._record(phases={"vibes": {"wall_ms": 1, "cpu_ms": 1,
+                                             "count": 1}})
+        assert any("vibes" in p for p in obs_audit.validate_record(rec))
+
+    def test_missing_stats_rejected(self):
+        rec = self._record(phases={"allocate": {"wall_ms": 1}})
+        problems = obs_audit.validate_record(rec)
+        assert any("cpu_ms" in p for p in problems)
+
+    def test_missing_fields_rejected(self):
+        rec = self._record()
+        del rec["decide_ms"]
+        assert obs_audit.validate_record(rec)
+
+
+class TestCommittedBaseline:
+    """doc/perf_baseline.json is a first-class artifact: schema-valid,
+    covering N in {100, 1k, 10k}, with the 10k decide-phase total
+    recorded (the number itself is ROADMAP item 2's target, not this
+    PR's gate)."""
+
+    def _baseline(self):
+        with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
+            return json.load(f)
+
+    def test_schema_and_coverage(self):
+        base = self._baseline()
+        assert base["schema"] == 1
+        assert base["tool"] == "scripts/perf_scale.py"
+        assert base["seed"] and base["passes"] >= 1
+        by_n = {c["n_jobs"]: c for c in base["curves"]}
+        assert set(by_n) == {100, 1000, 10000}
+        for curve in base["curves"]:
+            assert curve["passes_measured"] >= 1
+            assert curve["decide_wall_ms"]["mean"] > 0
+            assert curve["actuate_wall_ms"]["mean"] >= 0
+            for name, stats in curve["phases"].items():
+                assert name in obs_audit.PHASE_NAMES, name
+                assert {"wall_ms_mean", "wall_ms_max", "cpu_ms_mean",
+                        "count_mean"} <= set(stats)
+            # The decide sub-stages that always run are present.
+            for required in ("allocate", "commit", "diff", "snapshot"):
+                assert required in curve["phases"], (curve["n_jobs"],
+                                                    required)
+
+    def test_10k_decide_total_recorded(self):
+        base = self._baseline()
+        curve = next(c for c in base["curves"] if c["n_jobs"] == 10000)
+        assert curve["decide_wall_ms"]["mean"] > 0
+        # The full-repack probe prices the Hungarian path too (or says
+        # why it couldn't — never a silent gap).
+        probe = curve["defragment_probe"]
+        assert "wall_ms" in probe or "skipped" in probe
+
+    def test_bench_summarizes_curves(self):
+        sys.path.insert(0, REPO)
+        import bench
+        out = bench.decide_scaling(REPO)
+        assert out["source"] == "doc/perf_baseline.json"
+        rows = {r["n_jobs"]: r for r in out["rows"]}
+        assert set(rows) == {100, 1000, 10000}
+        assert rows[10000]["decide_wall_ms_mean"] > 0
+        assert rows[10000]["dominant_phase"] in obs_audit.PHASE_NAMES
+        assert out["decide_target_ms_at_10k"] == 50.0
+
+
+class TestScaleHarness:
+    """The tier-1 microbench: a small-N point through the REAL control
+    plane yields a full per-phase curve."""
+
+    def test_run_point_small_n(self):
+        curve = perf_scale.run_point(60, passes=2, seed=7)
+        assert curve["n_jobs"] == 60
+        assert curve["passes_measured"] >= 2
+        assert curve["decide_wall_ms"]["mean"] > 0
+        for required in ("snapshot", "allocate", "algorithm", "commit",
+                         "diff", "placement"):
+            assert required in curve["phases"], required
+        for name in curve["phases"]:
+            assert name in obs_audit.PHASE_NAMES
+        # The one-shot full-repack probe timed the Hungarian solve.
+        assert curve["defragment_probe"].get("wall_ms", 0) > 0
+        assert "hungarian_wall_ms" in curve["defragment_probe"]
+
+
+class TestPerfGate:
+    """`make perf-gate` semantics, hermetically (same machine generates
+    baseline and fresh run, so tight tolerances are deterministic): the
+    clean tree passes; a seeded 2x-style slowdown in the placement
+    phase fails."""
+
+    def _mini_baseline(self, tmp_path):
+        base = perf_scale.run_suite(ns=(60,), passes=2, seed=7,
+                                    verbose=False)
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps(base))
+        return path, base
+
+    def test_clean_tree_passes(self, tmp_path, capsys):
+        path, base = self._mini_baseline(tmp_path)
+        fresh_out = tmp_path / "fresh.json"
+        rc = perf_scale.main(["--check", str(path), "--ns", "60",
+                              "--passes", "2", "--seed", "7",
+                              "--fresh-out", str(fresh_out)])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "perf-gate: ok" in out
+        # The fresh curves are always written (the CI diagnosis artifact).
+        fresh = json.loads(fresh_out.read_text())
+        assert fresh["curves"][0]["n_jobs"] == 60
+
+    def test_injected_placement_slowdown_fails(self, tmp_path, capsys):
+        path, base = self._mini_baseline(tmp_path)
+        base_decide = base["curves"][0]["decide_wall_ms"]["mean"]
+        # Seed a slowdown comfortably past the bound: tolerance 1.5 +
+        # 5ms slack, injection >> base decide cost.
+        inject_ms = max(50.0, 3.0 * base_decide)
+        fresh_out = tmp_path / "fresh.json"
+        rc = perf_scale.main(["--check", str(path), "--ns", "60",
+                              "--passes", "2", "--seed", "7",
+                              "--tolerance", "1.5", "--slack-ms", "5",
+                              "--inject-phase", "placement",
+                              "--inject-ms", str(inject_ms),
+                              "--fresh-out", str(fresh_out)])
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "REGRESSED" in out
+        assert "decide regressed" in out
+
+    def test_missing_baseline_curve_fails(self, tmp_path, capsys):
+        path, _ = self._mini_baseline(tmp_path)
+        rc = perf_scale.main(["--check", str(path), "--ns", "40",
+                              "--passes", "1", "--seed", "7",
+                              "--fresh-out", str(tmp_path / "f.json")])
+        assert rc == 1
+        assert "no baseline curve" in capsys.readouterr().out
+
+
+class TestBehaviorNeutrality:
+    """Profiling is measurement, not policy: with the profiler riding
+    every pass, a deterministic scenario's decisions and audit stream
+    are unchanged (the replay-headline pin in tests/test_replay.py
+    covers the full 64-job trace; this is the fast split-brain check —
+    the perf_report stream exists AND the audit stream validates)."""
+
+    def test_dryrun_scenario_emits_valid_perf_reports(self, tmp_path):
+        from vodascheduler_tpu.obs.dryrun import run_scenario
+        result = run_scenario(str(tmp_path))
+        assert not result["problems"], result["problems"]
+        with open(result["path"]) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        perfs = [r for r in records if r["kind"] == "perf_report"]
+        audits = [r for r in records if r["kind"] == "resched_audit"]
+        assert perfs and len(perfs) == len(audits)
+        for rec in perfs:
+            assert not obs_audit.validate_record(rec)
+        # Pairing: each perf_report shares seq+trace_id with its audit.
+        audit_by_seq = {r["seq"]: r for r in audits}
+        for rec in perfs:
+            assert rec["trace_id"] == audit_by_seq[rec["seq"]]["trace_id"]

@@ -213,6 +213,22 @@ class TestVocab:
                     pass
             """, "cluster/x.py") == []
 
+    def test_unknown_phase_name_flagged(self):
+        fs = findings("""
+            def g(prof):
+                with prof.phase("vibes_stage"):
+                    pass
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["vocab"]
+        assert "PHASE_NAMES" in fs[0].message
+
+    def test_known_phase_name_clean(self):
+        assert findings("""
+            def g(prof):
+                with prof.phase("hungarian"):
+                    pass
+            """, "placement/x.py") == []
+
     def test_dead_vocabulary_entry_flagged(self, tmp_path):
         # A one-sided vocab edit: entry exists in obs/audit.py but no
         # code ever emits it. lint_package's reverse sweep catches it.

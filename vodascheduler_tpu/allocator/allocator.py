@@ -23,6 +23,7 @@ from vodascheduler_tpu.common.job import TrainingJob, base_job_info
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import ScheduleResult
+from vodascheduler_tpu.obs import profile as obs_profile
 from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement.topology import (
     PoolTopology,
@@ -140,13 +141,19 @@ class ResourceAllocator:
                 self.m_info_seconds.observe(time.monotonic() - t0,
                                             algorithm=algo.name)
             t0 = time.monotonic()
-            result = algo.schedule(request.ready_jobs, request.num_chips)
-            if request.topology is not None:
-                result = enforce_feasibility(result, request.ready_jobs,
-                                             request.num_chips,
-                                             request.topology)
-                validate_result(request.num_chips, result, request.ready_jobs,
-                                topology=request.topology)
+            # The pure decision stage, profiled separately from the
+            # job-info fetch above (obs/profile.py; the ambient pass
+            # timer no-ops on a bare RemoteAllocator HTTP call): this is
+            # the number ROADMAP item 2's algorithm vectorization moves.
+            with obs_profile.phase("algorithm"):
+                result = algo.schedule(request.ready_jobs, request.num_chips)
+                if request.topology is not None:
+                    result = enforce_feasibility(result, request.ready_jobs,
+                                                 request.num_chips,
+                                                 request.topology)
+                    validate_result(request.num_chips, result,
+                                    request.ready_jobs,
+                                    topology=request.topology)
             took = time.monotonic() - t0
             self.m_algo_seconds.observe(took, algorithm=algo.name)
             self.h_algo_runtime.observe(took, algorithm=algo.name)

@@ -250,6 +250,10 @@ class _World:
             self.clock, bus=self.bus, placement_manager=self.pm,
             algorithm=config.algorithm,
             rate_limit_seconds=config.rate_limit_seconds,
+            # Wall-only profiling: the BFS drives millions of
+            # micro-passes through prefix replay, and per-phase CPU
+            # sampling is a syscall per phase boundary (obs/profile.py).
+            profile_cpu=False,
             tracer=self.tracer)
         self._specs = {
             shape.name: JobSpec(

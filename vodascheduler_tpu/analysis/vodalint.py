@@ -54,11 +54,12 @@ RULES: Dict[str, str] = {
     "vocab": (
         "Audit vocabulary is closed: every literal reason code "
         "(_add_reason), trigger (trigger_resched), span name "
-        "(tracer.span/start_span) and status-transition reason "
-        "(lifecycle.transition(..., reason=...)) must be in "
-        "obs/audit.py's REASON_CODES/TRIGGERS/SPAN_NAMES/STATUS_REASONS "
-        "— and every vocabulary entry must be used somewhere in the "
-        "package (one-sided edits fail)."),
+        "(tracer.span/start_span), status-transition reason "
+        "(lifecycle.transition(..., reason=...)) and profiler phase "
+        "name (phase(...)/PhaseTimer.phase(...)) must be in "
+        "obs/audit.py's REASON_CODES/TRIGGERS/SPAN_NAMES/STATUS_REASONS/"
+        "PHASE_NAMES — and every vocabulary entry must be used "
+        "somewhere in the package (one-sided edits fail)."),
     "status-store": (
         "No direct `<job>.status = ...` store outside common/"
         "lifecycle.py — every status change goes through "
@@ -426,6 +427,7 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
     triggers = vocab["TRIGGERS"]
     span_names = vocab["SPAN_NAMES"]
     status_reasons = vocab["STATUS_REASONS"]
+    phase_names = vocab.get("PHASE_NAMES", frozenset())
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -452,6 +454,15 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
                         rel, line, "vocab",
                         f"span name {code!r} not in "
                         f"obs.audit.SPAN_NAMES"))
+        elif name == "phase" and phase_names and node.args:
+            # obs_profile.phase("...") / PhaseTimer.phase("...") — the
+            # profiler's stage vocabulary is closed like span names.
+            for line, code in _literal_strings(node.args[0]) or []:
+                if code not in phase_names:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"phase name {code!r} not in "
+                        f"obs.audit.PHASE_NAMES"))
         elif name == "transition":
             # lifecycle.transition(job, to, reason=...): the status-
             # change reason is keyword-only and must come from the
@@ -698,7 +709,8 @@ def _load_vocab() -> Dict[str, frozenset]:
     return {"REASON_CODES": audit.REASON_CODES,
             "TRIGGERS": audit.TRIGGERS,
             "SPAN_NAMES": audit.SPAN_NAMES,
-            "STATUS_REASONS": audit.STATUS_REASONS}
+            "STATUS_REASONS": audit.STATUS_REASONS,
+            "PHASE_NAMES": audit.PHASE_NAMES}
 
 
 def lint_source(src: str, rel: str,
@@ -806,6 +818,7 @@ def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
             ("REASON_CODES", vocab["REASON_CODES"], used_literals),
             ("TRIGGERS", vocab["TRIGGERS"], used_literals),
             ("SPAN_NAMES", vocab["SPAN_NAMES"], used_literals),
+            ("PHASE_NAMES", vocab["PHASE_NAMES"], used_literals),
             ("STATUS_REASONS", vocab["STATUS_REASONS"],
              used_outside_lifecycle)):
         for entry in sorted(entries):

@@ -12,6 +12,7 @@ Usage:
   python -m vodascheduler_tpu.cli get status      # scheduler's table
   python -m vodascheduler_tpu.cli algorithm <name>
   python -m vodascheduler_tpu.cli explain <job>   # decision-audit history
+  python -m vodascheduler_tpu.cli top             # live per-phase profile
 """
 
 from __future__ import annotations
@@ -98,6 +99,16 @@ def main(argv=None) -> int:
     p_explain.add_argument("-n", type=int, default=20,
                            help="max decisions to show (newest last)")
 
+    p_top = sub.add_parser(
+        "top",
+        help="where the scheduler's milliseconds go: per-phase p50/p95 "
+             "over recent passes and the slowest passes with their "
+             "dominant phase (GET /debug/profile)")
+    p_top.add_argument("-n", type=int, default=50,
+                       help="recent passes to aggregate")
+    p_top.add_argument("-k", type=int, default=5,
+                       help="slowest passes to list")
+
     args = parser.parse_args(argv)
     from urllib.parse import quote as _q
     pool_q = f"?pool={_q(args.pool, safe='')}" if args.pool else ""
@@ -134,7 +145,70 @@ def main(argv=None) -> int:
         out = _request(f"{args.scheduler_server}/debug/trace/"
                        f"{quote(args.name, safe='')}{pool_q}")
         _print_explain(args.name, out, limit=args.n)
+    elif args.command == "top":
+        q = f"?n={args.n}"
+        if args.pool:
+            q += f"&pool={_q(args.pool, safe='')}"
+        records = _request(f"{args.scheduler_server}/debug/profile{q}")
+        _print_top(records, k=args.k)
     return 0
+
+
+def _pctl(values, fraction: float) -> float:
+    """Nearest-rank percentile over a small sample (no interpolation —
+    `voda top` reads tens of passes, not millions): ordered[ceil(p*n)-1],
+    so p95 over 20 passes is the 19th value, not the maximum."""
+    import math
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(1, math.ceil(fraction * len(ordered)))
+    return ordered[min(len(ordered), rank) - 1]
+
+
+def _dominant_phase(rec: dict):
+    """(name, wall_ms) of the record's costliest phase, or None."""
+    phases = rec.get("phases") or {}
+    if not phases:
+        return None
+    name = max(phases, key=lambda p: phases[p].get("wall_ms", 0.0))
+    return name, phases[name].get("wall_ms", 0.0)
+
+
+def _print_top(records: list, k: int = 5) -> None:
+    """Human rendering of /debug/profile: per-phase p50/p95 over the
+    window, then the slowest passes with their dominant phase and the
+    jobs whose deltas triggered them."""
+    if not records:
+        print("no profiled passes yet (ring empty; run or trigger a "
+              "resched first)")
+        return
+    print(f"scheduler profile over last {len(records)} pass(es):")
+    per_phase = {}
+    for rec in records:
+        for name, stats in (rec.get("phases") or {}).items():
+            per_phase.setdefault(name, []).append(stats.get("wall_ms", 0.0))
+    header = f"  {'PHASE':<18}{'P50_MS':>10}{'P95_MS':>10}{'PASSES':>8}"
+    print(header)
+    rows = [("decide", [r.get("decide_ms", 0.0) for r in records]),
+            ("actuate", [r.get("actuate_ms", 0.0) for r in records])]
+    rows += sorted(per_phase.items(), key=lambda kv: -_pctl(kv[1], 0.5))
+    for name, vals in rows:
+        print(f"  {name:<18}{_pctl(vals, 0.5):>10.3f}"
+              f"{_pctl(vals, 0.95):>10.3f}{len(vals):>8}")
+    slowest = sorted(records, key=lambda r: -r.get("duration_ms", 0.0))[:k]
+    print(f"slowest {len(slowest)} pass(es):")
+    for rec in slowest:
+        dom = _dominant_phase(rec)
+        dom_s = f"{dom[0]} {dom[1]:.3f}ms" if dom else "n/a"
+        jobs = rec.get("jobs", [])
+        jobs_s = ",".join(jobs[:4]) + (f" (+{len(jobs) - 4})"
+                                       if len(jobs) > 4 else "")
+        print(f"  resched#{rec.get('seq')} {rec.get('duration_ms', 0):.3f}ms "
+              f"(decide {rec.get('decide_ms', 0):.3f} / actuate "
+              f"{rec.get('actuate_ms', 0):.3f}) dominant: {dom_s} "
+              f"triggers={'+'.join(rec.get('triggers', ()))} "
+              f"jobs=[{jobs_s}]")
 
 
 def _print_explain(job: str, payload: dict, limit: int = 20) -> None:
@@ -161,6 +235,23 @@ def _print_explain(job: str, payload: dict, limit: int = 20) -> None:
               f"{rec.get('algorithm')}): "
               f"{delta.get('before')} -> {delta.get('after')} chips "
               f"[{reasons}]{extra}")
+    perf = payload.get("perf")
+    if perf:
+        # Where the time went the last time a pass acted on this job,
+        # with the job's even share of the pass cost (K jobs shared the
+        # pass; per-phase attribution would need per-job stage timing
+        # the hot path deliberately doesn't pay for).
+        touched = max(1, len(perf.get("jobs", ())) or 1)
+        share = perf.get("duration_ms", 0.0) / touched
+        print(f"last pass phase costs (resched#{perf.get('seq')}, "
+              f"{touched} job(s) touched, ~{share:.3f}ms/job share): "
+              f"decide {perf.get('decide_ms', 0):.3f}ms / "
+              f"actuate {perf.get('actuate_ms', 0):.3f}ms")
+        phases = perf.get("phases") or {}
+        for name in sorted(phases, key=lambda p: -phases[p]["wall_ms"]):
+            stats = phases[name]
+            print(f"  {name:<18}{stats['wall_ms']:>10.3f}ms wall"
+                  f"{stats['cpu_ms']:>10.3f}ms cpu  x{stats['count']}")
     spans = payload.get("spans", [])
     if spans:
         print(f"recent spans ({len(spans)}):")

@@ -7,6 +7,9 @@
 - `audit`: the per-resched decision record schema — closed trigger and
   reason-code vocabularies with a validator (`make trace-dryrun` gates
   on it).
+- `profile`: the phase-level decide/actuate profiler (`PhaseTimer`) —
+  per-pass `perf_report` records over the closed `PHASE_NAMES`
+  vocabulary (the performance observatory, doc/observability.md).
 - `dryrun`: fake-backend scenario that exercises the whole plane and
   validates every emitted record.
 
@@ -14,12 +17,18 @@ See doc/observability.md.
 """
 
 from vodascheduler_tpu.obs.audit import (  # noqa: F401
+    PHASE_NAMES,
     REASON_CODES,
     SPAN_NAMES,
     STATUS_REASONS,
     TRIGGERS,
     validate_jsonl,
     validate_record,
+)
+from vodascheduler_tpu.obs.profile import (  # noqa: F401
+    PhaseTimer,
+    current_timer,
+    use_timer,
 )
 from vodascheduler_tpu.obs.tracer import (  # noqa: F401
     PARENT_SPAN_HEADER,

@@ -79,6 +79,28 @@ STATUS_REASONS = frozenset({
     "user_delete",   # -> Canceled
 })
 
+# The decide/actuate sub-stages the performance observatory times
+# (obs/profile.py; doc/observability.md "Performance observatory").
+# Closed both ways like the other vocabularies: every literal
+# `phase("...")` / `PhaseTimer.phase("...")` name must be declared here
+# (vodalint's vocab rule), every entry must be timed somewhere, and a
+# perf_report record naming an unknown phase fails validation — so the
+# phase breakdown ROADMAP item 2's vectorization work is judged against
+# can never silently grow untyped stages.
+PHASE_NAMES = frozenset({
+    "snapshot",          # decide: ready-queue + reservation snapshot under the lock
+    "allocate",          # decide: the allocator.allocate call (incl. job-info fetch)
+    "algorithm",         # decide: the pure scheduling algorithm + feasibility rounding (nested in allocate)
+    "hysteresis",        # decide: scale-out suppression gate
+    "placement",         # decide: placement.place/defragment
+    "hungarian",         # decide: the Hungarian assignment solve (nested in placement)
+    "diff",              # decide: old-vs-new allocation diff + reason tagging
+    "commit",            # decide: BookingLedger.commit_pass
+    "actuate_release",   # actuate: wave 1 — halts + scale-ins
+    "actuate_claim",     # actuate: wave 2 — starts + scale-outs
+    "actuate_migrate",   # actuate: trailing wave — re-bindings
+})
+
 # Every span name the package may emit (the trace file's third closed
 # vocabulary, alongside TRIGGERS and REASON_CODES). Enforced statically
 # by vodalint's `vocab` rule — NOT by validate_record, because tests
@@ -105,6 +127,9 @@ _REQUIRED_STATUS_FIELDS = ("kind", "schema", "ts", "pool", "job", "from",
                            "to", "reason")
 _REQUIRED_COUNTEREXAMPLE_FIELDS = ("kind", "schema", "ts", "violation",
                                    "step", "path", "config")
+_REQUIRED_PERF_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
+                         "outcome", "duration_ms", "cpu_ms", "decide_ms",
+                         "actuate_ms", "num_jobs", "phases")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -124,7 +149,27 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _validate_status_transition(rec)
     if kind == "modelcheck_counterexample":
         return _check_fields(rec, _REQUIRED_COUNTEREXAMPLE_FIELDS)
+    if kind == "perf_report":
+        return _validate_perf(rec)
     return [f"unknown record kind {kind!r}"]
+
+
+def _validate_perf(rec: Dict[str, Any]) -> List[str]:
+    problems = _check_fields(rec, _REQUIRED_PERF_FIELDS)
+    phases = rec.get("phases")
+    if not isinstance(phases, dict):
+        problems.append("phases is not an object")
+        return problems
+    for name, stats in phases.items():
+        if name not in PHASE_NAMES:
+            problems.append(f"unknown phase {name!r}")
+        if not isinstance(stats, dict):
+            problems.append(f"phase {name!r} stats is not an object")
+            continue
+        for f in ("wall_ms", "cpu_ms", "count"):
+            if f not in stats:
+                problems.append(f"phase {name!r}: missing {f!r}")
+    return problems
 
 
 def _validate_status_transition(rec: Dict[str, Any]) -> List[str]:

@@ -17,12 +17,18 @@ import math
 from typing import List, Sequence, Tuple
 
 from vodascheduler_tpu import native
+from vodascheduler_tpu.obs import profile as obs_profile
 
 
 def solve_max(score: Sequence[Sequence[float]]) -> List[Tuple[int, int]]:
     """Maximum-score perfect assignment on a square matrix.
 
     Returns [(row, col), ...] with each row and column used exactly once.
+
+    Profiled as its own `hungarian` phase (obs/profile.py, nested inside
+    the pass's `placement` phase): the O(n³) solve is the stage ROADMAP
+    item 2's native/warm-start work targets, so its cost must be visible
+    separately from the packing around it.
     """
     n = len(score)
     if n == 0:
@@ -30,12 +36,13 @@ def solve_max(score: Sequence[Sequence[float]]) -> List[Tuple[int, int]]:
     for row in score:
         if len(row) != n:
             raise ValueError("score matrix must be square")
-    result = native.hungarian_max(score)
-    if result is not None:
-        return result
-    cost = [[-float(v) for v in row] for row in score]
-    cols = _solve_min(cost)
-    return [(r, c) for r, c in enumerate(cols)]
+    with obs_profile.phase("hungarian"):
+        result = native.hungarian_max(score)
+        if result is not None:
+            return result
+        cost = [[-float(v) for v in row] for row in score]
+        cols = _solve_min(cost)
+        return [(r, c) for r, c in enumerate(cols)]
 
 
 def _solve_min(cost: List[List[float]]) -> List[int]:

@@ -51,6 +51,7 @@ from vodascheduler_tpu.common.types import (
     ScheduleResult,
 )
 from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import profile as obs_profile
 from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import PlacementManager
 
@@ -139,6 +140,7 @@ class Scheduler:
         actuation_workers: Optional[int] = None,
         actuation_parallel: Optional[bool] = None,
         price_actuation: bool = False,
+        profile_cpu: bool = True,
     ):
         self.pool_id = pool_id
         self.backend = backend
@@ -258,6 +260,14 @@ class Scheduler:
         import collections
         self.audit_ring = collections.deque(maxlen=AUDIT_RING_SIZE)
         self._audit_seq = 0
+        # Performance observatory (doc/observability.md): every pass
+        # also emits a phase-level perf_report (obs/profile.py),
+        # retained here for GET /debug/profile and `voda top`.
+        # profile_cpu=False drops per-phase CPU sampling (wall stays):
+        # process_time is a real syscall, and drivers running millions
+        # of micro-passes (the model checker) opt out.
+        self.profile_cpu = bool(profile_cpu)
+        self.profile_ring = collections.deque(maxlen=AUDIT_RING_SIZE)
         # Triggers coalesce like the rescheds they request: every reason
         # arriving inside one rate-limit window lands in the same pass's
         # record.
@@ -335,11 +345,30 @@ class Scheduler:
             const_labels=pool_l)
         # Histograms (the summaries above keep their reference-parity
         # names; the bucketed views answer tail questions the sums can't).
+        # Split by pass half (the decide/actuate lock split, PR 4): the
+        # decide series is the under-lock decision latency ROADMAP item
+        # 2 targets (~50 ms at 10k jobs); the actuate series is the wave
+        # execution the lock split already took off the critical path.
+        # One blob histogram could not distinguish a slow allocator from
+        # a slow backend.
         self.h_resched_latency = registry.histogram(
             "voda_scheduler_resched_latency_seconds",
-            "Rescheduling pass latency (bucketed)",
+            "Rescheduling pass latency by half (phase=decide: the "
+            "under-lock decision; phase=actuate: the wave execution)",
+            labels=("phase",),
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
                      60.0),
+            const_labels=pool_l)
+        # Per sub-stage wall time, one observation per pass per phase
+        # that ran (obs/profile.py PHASE_NAMES) — the live counterpart of
+        # doc/perf_baseline.json's latency-vs-N curves.
+        self.h_phase_seconds = registry.histogram(
+            "voda_scheduler_phase_seconds",
+            "Wall time of one decide/actuate sub-stage per resched pass "
+            "(phase from obs.audit.PHASE_NAMES)",
+            labels=("phase",),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                     1.0, 5.0, 15.0, 60.0),
             const_labels=pool_l)
         # Fast-vs-cold resize duration: the measured wall time of each
         # backend scale_job call, labeled by the ResizePath it took —
@@ -787,7 +816,11 @@ class Scheduler:
             self._pass_resize_seconds = {}
             self._last_pass_priced_seconds = 0.0
             self._pass_wave_stats = []
-        t_start = _walltime.monotonic()
+        # Phase-level profiler (obs/profile.py): t_start is the timer's
+        # own wall origin, so the pass duration, the decide/actuate
+        # split, and the per-phase numbers all share one zero.
+        prof = obs_profile.PhaseTimer(cpu=self.profile_cpu)
+        t_start = prof.wall_start
         self.update_time_metrics()
         with self._lock:
             old = self.job_num_chips.snapshot()
@@ -797,9 +830,16 @@ class Scheduler:
                 attrs={"pool": self.pool_id, "algorithm": self.algorithm,
                        "triggers": triggers}) as sp:
             try:
-                outcome = self._resched_pass(t_start, old)
+                # Ambient install: downstream stages on this thread
+                # (placement's Hungarian bind, the allocator's algorithm
+                # stage) time themselves into the same pass profile.
+                with obs_profile.use_timer(prof):
+                    outcome = self._resched_pass(t_start, old, prof)
             finally:
                 duration = _walltime.monotonic() - t_start
+                decide_s = (prof.decide_seconds
+                            if prof.decide_seconds is not None else duration)
+                actuate_s = max(0.0, duration - decide_s)
                 sp.set_attr("outcome", outcome)
                 sp.set_attr("actuation_mode",
                             "parallel" if self.actuation_parallel
@@ -807,66 +847,82 @@ class Scheduler:
                 sp.set_attr("actuation_workers", self.actuation_workers)
                 sp.set_attr("actuation_critical_path_s",
                             round(self._last_pass_priced_seconds, 4))
-                self.h_resched_latency.observe(duration)
+                sp.set_attr("decide_ms", round(decide_s * 1000.0, 3))
+                sp.set_attr("actuate_ms", round(actuate_s * 1000.0, 3))
+                self.h_resched_latency.observe(decide_s, phase="decide")
+                self.h_resched_latency.observe(actuate_s, phase="actuate")
                 self._emit_audit(sp, triggers, old, duration, outcome)
+                self._emit_perf(sp, triggers, prof, duration, decide_s,
+                                actuate_s, outcome)
 
-    def _resched_pass(self, t_start: float, old: ScheduleResult) -> str:
+    def _resched_pass(self, t_start: float, old: ScheduleResult,
+                      prof: obs_profile.PhaseTimer) -> str:
         """The pass body; returns the audit outcome tag ('applied',
-        'allocation_failed', or 'reverted_release_failure')."""
+        'allocation_failed', or 'reverted_release_failure'). `prof` is
+        the pass's phase profiler; every decide sub-stage and actuation
+        wave below accrues into it (doc/observability.md "Performance
+        observatory")."""
         import time as _walltime
 
         # ---- decide (under the lock) ---------------------------------
         with self._lock:
-            jobs = list(self.ready_jobs.values())
-            # Chips of deleted jobs whose checkpoint drain is still
-            # blocking in _drain_pending_stops: physically occupied, so
-            # off this pass's budget (and their host slots stay held
-            # below). The drain's own trigger re-runs allocation once
-            # the backend has truly released them.
-            reserved = dict(self._stops_in_flight)
+            with prof.phase("snapshot"):
+                jobs = list(self.ready_jobs.values())
+                # Chips of deleted jobs whose checkpoint drain is still
+                # blocking in _drain_pending_stops: physically occupied,
+                # so off this pass's budget (and their host slots stay
+                # held below). The drain's own trigger re-runs
+                # allocation once the backend has truly released them.
+                reserved = dict(self._stops_in_flight)
             t_alloc = _walltime.monotonic()
             try:
-                new = self.allocator.allocate(AllocationRequest(
-                    scheduler_id=self.pool_id,
-                    num_chips=max(0, self.total_chips
-                                  - sum(reserved.values())),
-                    algorithm=self.algorithm,
-                    ready_jobs=jobs,
-                    # Slice-shape feasibility: with a modeled torus,
-                    # grants are rounded to counts that admit a
-                    # contiguous sub-slice (SURVEY.md §7).
-                    topology=(self.placement_manager.topology
-                              if self.placement_manager is not None
-                              else None),
-                ))
+                with prof.phase("allocate"):
+                    new = self.allocator.allocate(AllocationRequest(
+                        scheduler_id=self.pool_id,
+                        num_chips=max(0, self.total_chips
+                                      - sum(reserved.values())),
+                        algorithm=self.algorithm,
+                        ready_jobs=jobs,
+                        # Slice-shape feasibility: with a modeled torus,
+                        # grants are rounded to counts that admit a
+                        # contiguous sub-slice (SURVEY.md §7).
+                        topology=(self.placement_manager.topology
+                                  if self.placement_manager is not None
+                                  else None),
+                    ))
             except Exception:
                 log.exception("allocation failed; retrying after rate limit")
+                prof.mark_decide_end()
                 self._schedule_retry()
                 return "allocation_failed"
             self.m_alloc_seconds.observe(_walltime.monotonic() - t_alloc)
 
             if self.scale_out_hysteresis > 1.0:
-                self._apply_hysteresis(old, new)
+                with prof.phase("hysteresis"):
+                    self._apply_hysteresis(old, new)
             # Decide-phase booking commit: the pass's whole allocation
             # lands in the ledger atomically; the waves below actuate
             # it, and every failure edge re-books through the ledger
             # (the booking-release contract vodacheck enforces).
-            self.job_num_chips.commit_pass(new)
-            halts, scale_ins, scale_outs, starts = self.compare_results(old)
-            changed = bool(halts or scale_ins or scale_outs or starts)
-            for job in starts:
-                self._add_reason(job, "started")
-            for job in halts:
-                self._add_reason(job, "halted")
-            for job in scale_ins:
-                self._add_reason(job, "scale_in")
-            for job in scale_outs:
-                self._add_reason(job, "scale_out")
-            # Per-job shrink targets, snapshotted now: the wave-1 barrier
-            # compares bookkeeping against these to detect shrinks the
-            # backend didn't realize.
-            scale_in_targets = {j: self.job_num_chips.get(j, 0)
-                                for j in scale_ins}
+            with prof.phase("commit"):
+                self.job_num_chips.commit_pass(new)
+            with prof.phase("diff"):
+                halts, scale_ins, scale_outs, starts = \
+                    self.compare_results(old)
+                changed = bool(halts or scale_ins or scale_outs or starts)
+                for job in starts:
+                    self._add_reason(job, "started")
+                for job in halts:
+                    self._add_reason(job, "halted")
+                for job in scale_ins:
+                    self._add_reason(job, "scale_in")
+                for job in scale_outs:
+                    self._add_reason(job, "scale_out")
+                # Per-job shrink targets, snapshotted now: the wave-1
+                # barrier compares bookkeeping against these to detect
+                # shrinks the backend didn't realize.
+                scale_in_targets = {j: self.job_num_chips.get(j, 0)
+                                    for j in scale_ins}
 
             # Unlike the reference (which places *after* the MPI-Operator
             # creates pods, steering them via tolerations and deleting
@@ -876,22 +932,25 @@ class Scheduler:
             placed = False
             if ((changed or self._placement_dirty)
                     and self.placement_manager is not None):
-                requests = {j: n for j, n in self.job_num_chips.items()
-                            if n > 0}
-                # Draining deletions keep their host slots until the
-                # backend released them (phantom same-size requests:
-                # _release_slots leaves an unchanged request alone).
-                requests.update(reserved)
-                if (self.defrag_cross_host_threshold > 0
-                        and self._last_cross_host
-                        >= self.defrag_cross_host_threshold):
-                    decision = self.placement_manager.defragment(requests)
-                else:
-                    decision = self.placement_manager.place(requests)
-                self._last_cross_host = decision.num_jobs_cross_host
-                placements = decision.placements
-                placed = True
-                self._placement_dirty = False
+                with prof.phase("placement"):
+                    requests = {j: n for j, n in self.job_num_chips.items()
+                                if n > 0}
+                    # Draining deletions keep their host slots until the
+                    # backend released them (phantom same-size requests:
+                    # _release_slots leaves an unchanged request alone).
+                    requests.update(reserved)
+                    if (self.defrag_cross_host_threshold > 0
+                            and self._last_cross_host
+                            >= self.defrag_cross_host_threshold):
+                        decision = self.placement_manager.defragment(
+                            requests)
+                    else:
+                        decision = self.placement_manager.place(requests)
+                    self._last_cross_host = decision.num_jobs_cross_host
+                    placements = decision.placements
+                    placed = True
+                    self._placement_dirty = False
+            prof.mark_decide_end()
 
         # ---- actuate (lock released; re-acquired per bookkeeping) ----
         # Wave 1 — release: halts and scale-ins free chips concurrently.
@@ -920,7 +979,8 @@ class Scheduler:
                  + [(job, (lambda j=job: self._apply_scale(
                      j, placements.get(j), old.get(j, 0))))
                     for job in scale_ins])
-        self._run_wave("release", wave1)
+        with prof.phase("actuate_release"):
+            self._run_wave("release", wave1)
 
         with self._lock:
             release_failed = bool(halt_failures) or any(
@@ -957,14 +1017,16 @@ class Scheduler:
             + [(job, (lambda j=job: self._apply_scale(
                 j, placements.get(j), old.get(j, 0))))
                for job in scale_outs])
-        self._run_wave("claim", wave2)
+        with prof.phase("actuate_claim"):
+            self._run_wave("claim", wave2)
         if placed:
             # Reserved (draining) jobs are never migration candidates —
             # they are mid-teardown, not mis-placed.
             touched = (set(halts) | set(starts) | set(scale_ins)
                        | set(scale_outs) | set(reserved))
-            self._run_wave("migrate",
-                           self._migration_tasks(placements, touched))
+            with prof.phase("actuate_migrate"):
+                self._run_wave("migrate",
+                               self._migration_tasks(placements, touched))
 
         self.store.flush()  # batch boundary for autoflush=False stores
         self.m_resched_total.inc()
@@ -1461,6 +1523,59 @@ class Scheduler:
         with self._lock:
             records = list(self.audit_ring)
         return records[-max(0, int(n)):] if n else records
+
+    def _emit_perf(self, span, triggers: List[str],
+                   prof: obs_profile.PhaseTimer, duration_s: float,
+                   decide_s: float, actuate_s: float, outcome: str) -> None:
+        """Emit the pass's phase-level perf_report (the performance
+        observatory, doc/observability.md): the same seq/trace_id as the
+        pass's resched_audit, plus where the milliseconds went. Feeds
+        the profile ring (GET /debug/profile, `voda top`) and the
+        per-phase histogram."""
+        phases = prof.report()
+        with self._lock:
+            rec = {
+                "kind": "perf_report",
+                "schema": obs_audit.SCHEMA_VERSION,
+                "ts": self.clock.now(),
+                "pool": self.pool_id,
+                "seq": self._audit_seq,
+                "trace_id": span.trace_id,
+                "triggers": list(triggers),
+                "outcome": outcome,
+                "algorithm": self.algorithm,
+                "num_jobs": len(self.ready_jobs),
+                # The jobs this pass acted on (reason-tagged deltas):
+                # what `voda top` shows as the pass's triggering jobs.
+                "jobs": sorted(self._pass_reasons),
+                "duration_ms": round(duration_s * 1000.0, 3),
+                "cpu_ms": round(prof.cpu_seconds() * 1000.0, 3),
+                "decide_ms": round(decide_s * 1000.0, 3),
+                "actuate_ms": round(actuate_s * 1000.0, 3),
+                "phases": phases,
+            }
+            self.profile_ring.append(rec)
+        for name, stats in phases.items():
+            self.h_phase_seconds.observe(stats["wall_ms"] / 1000.0,
+                                         phase=name)
+        self.tracer.emit(dict(rec))
+
+    def profile_records(self, n: int = 20) -> List[dict]:
+        """The last n perf_report records (GET /debug/profile)."""
+        with self._lock:
+            records = list(self.profile_ring)
+        return records[-max(0, int(n)):] if n else records
+
+    def explain_profile(self, job: str) -> Optional[dict]:
+        """The newest perf_report whose pass acted on `job` — where the
+        time went the last time the scheduler touched it (`voda explain`
+        renders the job's per-pass share)."""
+        with self._lock:
+            records = list(self.profile_ring)
+        for rec in reversed(records):
+            if job in rec.get("jobs", ()):
+                return rec
+        return None
 
     def explain_job(self, job: str, n: int = 50) -> List[dict]:
         """Audit records whose deltas touch `job`, oldest first

@@ -284,7 +284,9 @@ def make_scheduler_server(scheduler, registry: Registry,
 
     def debug_trace(body, query):
         """Decision history + spans for one job: /debug/trace/<job> or
-        ?job=<name>. Backs `voda explain <job>`."""
+        ?job=<name>. Backs `voda explain <job>`. `perf` is the newest
+        phase-level perf_report whose pass acted on the job (where the
+        time went; null when no profiled pass touched it)."""
         job = (query.get("__path__", [None])[0]
                or query.get("job", [None])[0])
         if not job:
@@ -294,7 +296,15 @@ def make_scheduler_server(scheduler, registry: Registry,
             "job": job,
             "records": sched.explain_job(job),
             "spans": sched.tracer.spans_for_job(job, limit=200),
+            "perf": sched.explain_profile(job),
         }
+
+    def debug_profile(body, query):
+        """Last K phase-level perf_report records (?n=K, default 20) —
+        the performance observatory's per-pass breakdowns, same shape as
+        /debug/resched (doc/observability.md). Backs `voda top`."""
+        n = int(query.get("n", ["20"])[0])
+        return 200, pick(body, query).profile_records(n)
 
     return RestServer({
         ("GET", "/training"): get_training,
@@ -304,6 +314,7 @@ def make_scheduler_server(scheduler, registry: Registry,
         ("GET", "/debug/resched"): debug_resched,
         ("GET", "/debug/trace"): debug_trace,
         ("GET", "/debug/trace/*"): debug_trace,
+        ("GET", "/debug/profile"): debug_profile,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
 
