@@ -121,8 +121,20 @@ $(NATIVE_SO): $(NATIVE_SRC)
 	$(CXX) -O2 -shared -fPIC -std=c++17 -o $@.tmp $<
 	mv $@.tmp $@
 
+# Build + smoke-test: the library loads, the warm Hungarian kernel
+# answers (and matches a VODA_NO_NATIVE pure-Python solve — the ctypes
+# fallback contract exercised in the same breath).
 native: $(NATIVE_SO)
-	$(PY) -c "from vodascheduler_tpu import native; assert native.get_lib() is not None; print('native kernels OK')"
+	$(PY) -c "from vodascheduler_tpu import native; assert native.get_lib() is not None; \
+	assert hasattr(native.get_lib(), 'voda_hungarian_warm'), 'stale .so: rebuild'; \
+	from vodascheduler_tpu.placement import hungarian; \
+	score = [[2.0, 0.0], [0.0, 2.0]]; \
+	out, state = hungarian.solve_max_warm(score, None); \
+	assert out == [(0, 0), (1, 1)], out; \
+	import os; os.environ['VODA_NO_NATIVE'] = '1'; \
+	assert native.hungarian_warm(score, [-1, -1], [0.0, 0.0], [0.0, 0.0], [0, 1]) is None; \
+	assert hungarian.solve_max(score) == out; \
+	print('native kernels OK (voda_hungarian_warm + ctypes fallback)')"
 
 docker:
 	docker build -f deploy/docker/Dockerfile.controlplane -t voda-controlplane:latest .

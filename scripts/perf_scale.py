@@ -52,7 +52,9 @@ from typing import Dict, List, Optional, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_NS = (100, 1000, 10000)
-DEFAULT_PASSES = 3
+# 5 measured passes per point: enough samples for the p50/p95 columns
+# the gate bounds tails with (3 made p95 degenerate-equal to max).
+DEFAULT_PASSES = 5
 DEFAULT_SEED = 20260803
 DEFAULT_RATE_LIMIT = 5.0
 DEFAULT_TOLERANCE = 3.0
@@ -66,7 +68,7 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 1
+SCHEMA = 2  # v2: mean/max grew p50/p95 (phases: wall_ms_p50/p95)
 
 
 def build_world(n_jobs: int, seed: int,
@@ -110,9 +112,23 @@ def _make_spec(i: int, rng: random.Random):
                                     epochs=100000))
 
 
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation): the
+    smallest sample at or above rank ceil(q * n)."""
+    ordered = sorted(values)
+    # Integer arithmetic (q as a percent) so 0.95 * 20 == rank 19, not
+    # the float-fuzzed 20.
+    rank = max(1, (int(q * 100) * len(ordered) + 99) // 100)
+    return ordered[rank - 1]
+
+
 def _agg(values: List[float]) -> Dict[str, float]:
-    return {"mean": round(statistics.mean(values), 3) if values else 0.0,
-            "max": round(max(values), 3) if values else 0.0}
+    if not values:
+        return {"mean": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0}
+    return {"mean": round(statistics.mean(values), 3),
+            "max": round(max(values), 3),
+            "p50": round(_percentile(values, 0.50), 3),
+            "p95": round(_percentile(values, 0.95), 3)}
 
 
 def _probe_defragment(sched, hosts: int) -> Dict[str, object]:
@@ -222,6 +238,8 @@ def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
             name: {
                 "wall_ms_mean": round(statistics.mean(agg["wall"]), 3),
                 "wall_ms_max": round(max(agg["wall"]), 3),
+                "wall_ms_p50": round(_percentile(agg["wall"], 0.50), 3),
+                "wall_ms_p95": round(_percentile(agg["wall"], 0.95), 3),
                 "cpu_ms_mean": round(statistics.mean(agg["cpu"]), 3),
                 "count_mean": round(statistics.mean(agg["count"]), 2),
             }
@@ -249,10 +267,12 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
         "schema": SCHEMA,
         "tool": "scripts/perf_scale.py",
         "note": ("Per-phase decide/actuate latency-vs-N curves on the "
-                 "fake backend (pinned seed). Regenerate with `make "
-                 "perf-baseline` and review the diff; `make perf-gate` "
-                 "compares a fresh bounded-N run against this file. "
-                 "doc/observability.md 'Performance observatory'."),
+                 "fake backend (pinned seed), mean/max/p50/p95 per "
+                 "phase. Regenerate with `make perf-baseline` and "
+                 "review the diff; `make perf-gate` compares a fresh "
+                 "bounded-N run (decide mean + p95, >=1ms sub-phase "
+                 "means) against this file. doc/observability.md "
+                 "'Performance observatory'."),
         "seed": seed,
         "passes": passes,
         "rate_limit_seconds": DEFAULT_RATE_LIMIT,
@@ -267,9 +287,11 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
 def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
             slack_ms: float = DEFAULT_SLACK_MS) -> List[str]:
     """Regressions of the fresh run vs the baseline; empty = gate
-    passes. A fresh mean above `base * tolerance + slack_ms` fails —
-    for the decide half always, and for any sub-phase whose baseline
-    mean is >= GATE_PHASE_FLOOR_MS (cheaper phases are noise-bound)."""
+    passes. A fresh value above `base * tolerance + slack_ms` fails —
+    the decide MEAN and decide P95 always (the tail is the
+    control-plane stall the mean can hide), and the mean of any
+    sub-phase whose baseline mean is >= GATE_PHASE_FLOOR_MS (cheaper
+    phases are noise-bound)."""
     problems: List[str] = []
     base_by_n = {c["n_jobs"]: c for c in baseline.get("curves", [])}
     for curve in fresh["curves"]:
@@ -293,6 +315,11 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
 
         check("decide", curve["decide_wall_ms"]["mean"],
               base["decide_wall_ms"]["mean"])
+        # Tail bound: pre-p95 baselines (schema 1) simply skip it.
+        base_p95 = base["decide_wall_ms"].get("p95")
+        fresh_p95 = curve["decide_wall_ms"].get("p95")
+        if base_p95 is not None and fresh_p95 is not None:
+            check("decide_p95", fresh_p95, base_p95)
         for name, stats in base.get("phases", {}).items():
             if stats["wall_ms_mean"] < GATE_PHASE_FLOOR_MS:
                 continue

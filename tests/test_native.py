@@ -79,6 +79,55 @@ def test_ffdl_dp_parity_with_python():
     assert sum(native_result.values()) <= 16
 
 
+def test_hungarian_warm_cold_solve_matches_python_augment():
+    """voda_hungarian_warm with every row dirty IS a cold JV solve with
+    exported duals; the assignment must match the pure-Python augment
+    oracle exactly (same algorithm, same row order), and the duals must
+    be dual-feasible with tight matched edges."""
+    rng = random.Random(13)
+    for n in (1, 2, 5, 12, 30):
+        score = [[float(rng.randint(0, 20)) for _ in range(n)]
+                 for _ in range(n)]
+        nat = native.hungarian_warm(score, [-1] * n, [0.0] * n, [0.0] * n,
+                                    list(range(n)))
+        assert nat is not None
+        rtc_nat, u, v = nat
+        rtc_py, _, _ = hungarian._augment_rows_py(
+            score, [-1] * n, [0.0] * n, [0.0] * n, list(range(n)))
+        assert rtc_nat == rtc_py
+        for i in range(n):
+            for j in range(n):
+                assert u[i] + v[j] <= -score[i][j] + 1e-9
+            assert u[i] + v[rtc_nat[i]] == pytest.approx(-score[i][rtc_nat[i]])
+
+
+def test_hungarian_warm_reaugments_dirty_rows_only():
+    """A warm call with one dirty row keeps clean rows' matches valid
+    and lands on the same canonical assignment as a cold solve (the
+    solve_max_warm contract, exercised here at the ctypes layer)."""
+    score = [[5.0, 0.0, 0.0], [0.0, 5.0, 0.0], [0.0, 0.0, 5.0]]
+    rtc, u, v = native.hungarian_warm(score, [-1] * 3, [0.0] * 3,
+                                      [0.0] * 3, [0, 1, 2])
+    assert rtc == [0, 1, 2]
+    # Row 0 now prefers column 2: unassign it and re-augment just it.
+    score[0] = [0.0, 0.0, 9.0]
+    rtc[0] = -1
+    u[0] = 0.0
+    rtc2, _, _ = native.hungarian_warm(score, rtc, u, v, [0])
+    assert sorted(rtc2) == [0, 1, 2]
+    assert rtc2[0] == 2  # the dirty row moved; a clean row took col 0
+
+
+def test_lexmin_pm_picks_lex_smallest():
+    # Two optimal matchings exist in this tight graph; the kernel must
+    # return the lexicographically smallest.
+    tight = [[1, 1], [1, 1]]
+    assert native.lexmin_pm(tight, [1, 0]) == [0, 1]
+    # And respect infeasibility: identity is forced here.
+    tight = [[1, 0], [1, 1]]
+    assert native.lexmin_pm(tight, [0, 1]) == [0, 1]
+
+
 def test_native_speedup_on_large_pool():
     """The point of the kernel: n=128 hosts assignment well under the
     reference's 30 s resched rate limit, and faster than Python."""

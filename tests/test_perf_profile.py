@@ -114,9 +114,9 @@ class TestPerfReportSchema:
 
 class TestCommittedBaseline:
     """doc/perf_baseline.json is a first-class artifact: schema-valid,
-    covering N in {100, 1k, 10k}, with the 10k decide-phase total
-    recorded (the number itself is ROADMAP item 2's target, not this
-    PR's gate)."""
+    covering N in {100, 1k, 10k}, with tail percentiles per curve and
+    the 10k decide mean inside ROADMAP item 2's 50 ms target (the
+    decide-path kernels' acceptance number — this IS the gate now)."""
 
     def _baseline(self):
         with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
@@ -124,28 +124,36 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 1
+        assert base["schema"] == 2
         assert base["tool"] == "scripts/perf_scale.py"
-        assert base["seed"] and base["passes"] >= 1
+        assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}
         assert set(by_n) == {100, 1000, 10000}
         for curve in base["curves"]:
             assert curve["passes_measured"] >= 1
             assert curve["decide_wall_ms"]["mean"] > 0
             assert curve["actuate_wall_ms"]["mean"] >= 0
+            # v2: tail columns, so the gate can bound p95 not just mean.
+            for agg in (curve["decide_wall_ms"], curve["actuate_wall_ms"]):
+                assert {"mean", "max", "p50", "p95"} <= set(agg)
+                assert agg["p50"] <= agg["p95"] <= agg["max"]
             for name, stats in curve["phases"].items():
                 assert name in obs_audit.PHASE_NAMES, name
-                assert {"wall_ms_mean", "wall_ms_max", "cpu_ms_mean",
+                assert {"wall_ms_mean", "wall_ms_max", "wall_ms_p50",
+                        "wall_ms_p95", "cpu_ms_mean",
                         "count_mean"} <= set(stats)
             # The decide sub-stages that always run are present.
             for required in ("allocate", "commit", "diff", "snapshot"):
                 assert required in curve["phases"], (curve["n_jobs"],
                                                     required)
 
-    def test_10k_decide_total_recorded(self):
+    def test_10k_decide_under_target(self):
+        """The committed artifact itself pins the tentpole result: a
+        10k-job decide phase under 50 ms mean (the live re-measurement
+        lives in the slow tier, TestDecideTarget)."""
         base = self._baseline()
         curve = next(c for c in base["curves"] if c["n_jobs"] == 10000)
-        assert curve["decide_wall_ms"]["mean"] > 0
+        assert 0 < curve["decide_wall_ms"]["mean"] < 50.0
         # The full-repack probe prices the Hungarian path too (or says
         # why it couldn't — never a silent gap).
         probe = curve["defragment_probe"]
@@ -172,6 +180,7 @@ class TestScaleHarness:
         assert curve["n_jobs"] == 60
         assert curve["passes_measured"] >= 2
         assert curve["decide_wall_ms"]["mean"] > 0
+        assert curve["decide_wall_ms"]["p95"] >= curve["decide_wall_ms"]["p50"]
         for required in ("snapshot", "allocate", "algorithm", "commit",
                          "diff", "placement"):
             assert required in curve["phases"], required
@@ -180,6 +189,28 @@ class TestScaleHarness:
         # The one-shot full-repack probe timed the Hungarian solve.
         assert curve["defragment_probe"].get("wall_ms", 0) > 0
         assert "hungarian_wall_ms" in curve["defragment_probe"]
+
+    def test_percentiles_nearest_rank(self):
+        assert perf_scale._percentile([5.0], 0.95) == 5.0
+        assert perf_scale._percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.0
+        vals = [float(i) for i in range(1, 21)]
+        assert perf_scale._percentile(vals, 0.95) == 19.0
+        assert perf_scale._percentile(vals, 0.50) == 10.0
+
+
+@pytest.mark.slow
+class TestDecideTarget:
+    """The tentpole acceptance, measured live: a 10k-job decide phase
+    under 50 ms mean on the fake backend (pinned seed). Slow tier — a
+    10k world takes ~10 s to build; the committed-artifact pin above
+    keeps the fast tier honest between runs."""
+
+    def test_10k_decide_under_50ms(self):
+        curve = perf_scale.run_point(10000, passes=5)
+        assert curve["decide_wall_ms"]["mean"] < 50.0, curve["decide_wall_ms"]
+        # The sub-phase the kernels rebuilt is the proof detail: the
+        # allocator's pure-algorithm stage clears its old 33 ms mean.
+        assert curve["phases"]["algorithm"]["wall_ms_mean"] < 33.0
 
 
 class TestPerfGate:

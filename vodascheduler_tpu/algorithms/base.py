@@ -89,6 +89,15 @@ class SchedulerAlgorithm(abc.ABC):
         """Return {job name: chips}. Must include every job in `jobs` (0 for
         unscheduled) and satisfy validate_result."""
 
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
+        """The pure per-job reference implementation — the differential
+        oracle the vectorized kernels (algorithms/fastpath.py) are
+        proven bit-identical against. Algorithms with a fastpath kernel
+        override this with their original body and dispatch from
+        `schedule`; for the rest, `schedule` IS the reference."""
+        return self.schedule(jobs, total_chips)
+
     @property
     def needs_job_info(self) -> bool:
         """Whether the allocator must attach JobInfo (speedup curves /

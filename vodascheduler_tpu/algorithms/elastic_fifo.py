@@ -24,6 +24,15 @@ class ElasticFIFO(SchedulerAlgorithm):
     elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.elastic_fifo(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}
         ordered = sorted(jobs, key=lambda j: j.submit_time)
         free = allocate_minimums(ordered, result, total_chips)

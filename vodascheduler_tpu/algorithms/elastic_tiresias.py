@@ -80,6 +80,15 @@ class ElasticTiresias(SchedulerAlgorithm):
     elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.elastic_tiresias(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {j.name: 0 for j in jobs}
         gain: Dict[str, float] = {}
         free = total_chips

@@ -39,6 +39,15 @@ class FfDLOptimizer(SchedulerAlgorithm):
     elastic = True
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.ffdl(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {j.name: 0 for j in jobs}
         if not jobs or total_chips <= 0:
             validate_result(total_chips, result, jobs)

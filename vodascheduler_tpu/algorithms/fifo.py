@@ -21,6 +21,15 @@ class FIFO(SchedulerAlgorithm):
     name = "FIFO"
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.fifo(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}
         ordered = sorted(jobs, key=lambda j: j.submit_time)
         allocate_minimums(ordered, result, total_chips)

@@ -25,6 +25,15 @@ class SRJF(SchedulerAlgorithm):
     name = "SRJF"
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.srjf(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}
         ordered = sorted(jobs, key=remaining_seconds)
         allocate_minimums(ordered, result, total_chips)

@@ -51,6 +51,15 @@ class Tiresias(SchedulerAlgorithm):
     name = "Tiresias"
 
     def schedule(self, jobs: List[TrainingJob], total_chips: int) -> ScheduleResult:
+        from vodascheduler_tpu.algorithms import fastpath
+
+        fast = fastpath.tiresias(jobs, total_chips)
+        if fast is not None:
+            return fast
+        return self.schedule_reference(jobs, total_chips)
+
+    def schedule_reference(self, jobs: List[TrainingJob],
+                           total_chips: int) -> ScheduleResult:
         result: ScheduleResult = {}
         free = total_chips
         queues = queues_by_priority(jobs)

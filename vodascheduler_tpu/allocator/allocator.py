@@ -19,7 +19,7 @@ from typing import List, Optional
 
 from vodascheduler_tpu.algorithms import new_algorithm
 from vodascheduler_tpu.algorithms.base import validate_result
-from vodascheduler_tpu.common.job import TrainingJob, base_job_info
+from vodascheduler_tpu.common.job import JobInfo, TrainingJob, base_job_info
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import ScheduleResult
@@ -99,9 +99,72 @@ def enforce_feasibility(result: ScheduleResult, jobs: List[TrainingJob],
     return out
 
 
+def enforce_feasibility_reference(result: ScheduleResult,
+                                  jobs: List[TrainingJob], total_chips: int,
+                                  topology: PoolTopology) -> ScheduleResult:
+    """Differential oracle for enforce_feasibility: the identical
+    rounding policy on the pre-table scan primitives (topology.py
+    `_*_scan`), so tests can prove the FeasibleTable-backed path makes
+    the same per-grant decisions the O(scan) implementation made."""
+    from vodascheduler_tpu.placement.topology import (
+        _is_feasible_scan,
+        _next_feasible_above_scan,
+        _round_to_feasible_scan,
+    )
+
+    bounds = {j.name: (j.config.min_num_chips, j.config.max_num_chips)
+              for j in jobs}
+    out: ScheduleResult = {}
+    for job, n in result.items():
+        lo, _hi = bounds.get(job, (0, n))
+        f = _round_to_feasible_scan(n, topology)
+        out[job] = f if f >= max(lo, 1) else 0
+    free = max(0, total_chips) - sum(out.values())
+    by_loss = sorted(result.items(),
+                     key=lambda kv: kv[1] - out.get(kv[0], 0), reverse=True)
+    for job, n in by_loss:
+        if n <= 0 or out[job] == n:
+            continue
+        lo, hi = bounds.get(job, (0, n))
+        ceiling = n if _is_feasible_scan(n, topology) else \
+            _next_feasible_above_scan(n, topology)
+        if ceiling is None or ceiling > hi:
+            continue
+        cost = ceiling - out[job]
+        if 0 < cost <= free:
+            out[job] = ceiling
+            free -= cost
+    return out
+
+
+# The linear-speedup prior's curves are identical for every fresh job
+# (speedup[n] = n, efficiency[n] = 1). One shared, effectively-immutable
+# pair of dicts instead of ~500 fresh entries per job keeps a 10k-job
+# fill from minting millions of heap objects whose eventual gen-2 GC
+# pause lands inside a later pass's decide window. Nothing in the tree
+# mutates an ATTACHED info's curves in place (the collector builds its
+# own docs and upserts them), and serialization deep-copies.
+_BASE_CURVES = base_job_info("", "", "")
+
+
+def _base_prior(name: str, category: str, pool: str) -> JobInfo:
+    return JobInfo(name=name, category=category, pool=pool,
+                   estimated_remaining_seconds=0.0,
+                   speedup=_BASE_CURVES.speedup,
+                   efficiency=_BASE_CURVES.efficiency)
+
+
 class ResourceAllocator:
     def __init__(self, store: JobStore, registry: Optional[Registry] = None):
         self.store = store
+        # Per-job linear-speedup priors, reused across passes: a fresh
+        # job with no learned doc gets the same base prior every pass,
+        # and building one is ~500 dict entries — at 10k fresh jobs that
+        # was most of the job-info fetch cost. Entries are evicted
+        # implicitly: once a doc exists in the store the prior is never
+        # consulted for that job again (and the cache is bounded by the
+        # ready queue via the per-pass sweep in _attach_job_info).
+        self._base_infos: dict = {}
         registry = registry or Registry()
         # Reference metric names: pkg/allocator/allocator/metrics.go.
         self.m_requests = registry.counter(
@@ -137,9 +200,10 @@ class ResourceAllocator:
                                 "num_jobs": len(request.ready_jobs)}) as sp:
             if algo.needs_job_info:
                 t0 = time.monotonic()
-                self._attach_job_info(request.ready_jobs)
+                attached = self._attach_job_info(request.ready_jobs)
                 self.m_info_seconds.observe(time.monotonic() - t0,
                                             algorithm=algo.name)
+                sp.set_attr("jobinfo", attached)
             t0 = time.monotonic()
             # The pure decision stage, profiled separately from the
             # job-info fetch above (obs/profile.py; the ambient pass
@@ -160,11 +224,37 @@ class ResourceAllocator:
             sp.set_attr("granted_chips", sum(result.values()))
         return result
 
-    def _attach_job_info(self, jobs: List[TrainingJob]) -> None:
+    def _attach_job_info(self, jobs: List[TrainingJob]) -> int:
+        """Attach each job's info doc for this pass and return how many
+        were served from LEARNED docs (exact or category fallback) —
+        the allocate span's `jobinfo` attr; the remainder to `num_jobs`
+        ran on the linear-speedup prior, so the pair reads as curve
+        coverage of the queue.
+
+        Batched: ONE store scan per pass (store.job_infos_for — single
+        lock acquisition, O(1) name-index probes, category-fallback doc
+        memoized per distinct category) instead of N point lookups +
+        N category scans while the scheduler holds its lock. Jobs with
+        neither a doc nor a category fallback get the linear-speedup
+        base prior, cached per job name — semantics per job are
+        unchanged (exact doc, else newest category doc, else prior)."""
+        infos = self.store.job_infos_for(jobs)
+        base_cache = self._base_infos
+        learned = 0
         for job in jobs:
-            info = self.store.get_job_info(job.name)
+            info = infos.get(job.name)
             if info is None:
-                info = self.store.find_category_info(job.category)
-            if info is None:
-                info = base_job_info(job.name, job.category, job.pool)
+                info = base_cache.get(job.name)
+                if info is None:
+                    info = base_cache[job.name] = _base_prior(
+                        job.name, job.category, job.pool)
+            else:
+                learned += 1
             job.info = info
+        # Bound the prior cache by the live queue: names no longer in
+        # the ready set (completed/deleted jobs) drop out.
+        if len(base_cache) > 2 * len(jobs) + 64:
+            keep = {job.name for job in jobs}
+            self._base_infos = {k: v for k, v in base_cache.items()
+                                if k in keep}
+        return learned

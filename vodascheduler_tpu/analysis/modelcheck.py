@@ -732,6 +732,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{' +replayed' if reproduced else ''} "
                   f"({result.states} states)")
             ok = ok and caught and reproduced
+        # Decide-path kernel equivalence (PR 8): the model checker's
+        # state graph is only stable if the vectorized allocation
+        # kernels make bit-identical decisions to their pure-Python
+        # oracles — so the differential sweep is part of the same
+        # teeth-check. 200+ seeded pools across every fastpath
+        # algorithm (tests/test_fastpath_oracle.py runs the wider
+        # matrix; this is the CI tripwire).
+        from vodascheduler_tpu.algorithms import fastpath
+        mismatches = fastpath.self_check(n_pools=200)
+        print(f"selftest fastpath-oracle: "
+              f"{'EQUIVALENT' if not mismatches else 'DIVERGED'} "
+              f"(200 pools x {len(fastpath.FASTPATH_ALGORITHMS)} "
+              f"algorithms)")
+        for m in mismatches[:10]:
+            print(f"  {m}")
+        ok = ok and not mismatches
         return 0 if ok else 1
 
     t0 = time.monotonic()

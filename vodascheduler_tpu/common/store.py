@@ -32,6 +32,13 @@ class JobStore:
         self._lock = threading.RLock()
         self._jobs: Dict[str, TrainingJob] = {}       # by job name
         self._infos: Dict[str, Dict[str, JobInfo]] = {}  # category -> job name -> info
+        # Flat name -> info index for the allocator's batched per-pass
+        # lookup. Only docs whose stored category matches
+        # category_of(name) are indexed, so a hit here is exactly what
+        # get_job_info(name) would have returned (a doc filed under a
+        # foreign category is invisible to get_job_info's bucket walk
+        # and must stay invisible to the batch path too).
+        self._info_by_name: Dict[str, JobInfo] = {}
 
     # -- job metadata (reference: job_metadata collection) -------------------
 
@@ -64,6 +71,8 @@ class JobStore:
     def upsert_job_info(self, info: JobInfo) -> None:
         with self._lock:
             self._infos.setdefault(info.category, {})[info.name] = info
+            if category_of(info.name) == info.category:
+                self._info_by_name[info.name] = info
             self._dirty()
 
     def get_job_info(self, name: str) -> Optional[JobInfo]:
@@ -74,11 +83,38 @@ class JobStore:
         """Any historical info doc in the category — used to seed a new job's
         curves from past runs of the same workload (handlers.go:180-206)."""
         with self._lock:
-            docs = self._infos.get(category)
-            if not docs:
-                return None
-            # newest job name sorts last (timestamp suffix)
-            return docs[sorted(docs.keys())[-1]]
+            return self._find_category_info_locked(category)
+
+    def _find_category_info_locked(self, category: str) -> Optional[JobInfo]:
+        docs = self._infos.get(category)
+        if not docs:
+            return None
+        # newest job name sorts last (timestamp suffix)
+        return docs[sorted(docs.keys())[-1]]
+
+    def job_infos_for(self, jobs: List[TrainingJob]) -> Dict[str, Optional[JobInfo]]:
+        """Batched per-pass info lookup for the allocator: one lock
+        acquisition and one O(1) name-index probe per job instead of N
+        point lookups (each paying the category_of regex + a lock
+        round-trip), with the category-fallback doc memoized per
+        distinct category instead of re-sorted per job. Returns
+        {job name: info-or-None}; semantics per job are exactly
+        `get_job_info(name) or find_category_info(job.category)`."""
+        out: Dict[str, Optional[JobInfo]] = {}
+        with self._lock:
+            by_name = self._info_by_name
+            fallback: Dict[str, Optional[JobInfo]] = {}
+            for job in jobs:
+                info = by_name.get(job.name)
+                if info is None:
+                    cat = job.category
+                    if cat in fallback:
+                        info = fallback[cat]
+                    else:
+                        info = fallback[cat] = \
+                            self._find_category_info_locked(cat)
+                out[job.name] = info
+        return out
 
     def _dirty(self) -> None:  # persistence hook
         pass
@@ -169,6 +205,8 @@ class FileJobStore(JobStore):
             for idoc in raw.get("infos", []):
                 info = _info_from_dict(idoc)
                 self._infos.setdefault(info.category, {})[info.name] = info
+                if category_of(info.name) == info.category:
+                    self._info_by_name[info.name] = info
         finally:
             self._loading = False
 

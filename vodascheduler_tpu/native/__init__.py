@@ -67,6 +67,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32)]
             lib.voda_ffdl_dp.restype = None
+            # PR 8 kernels (decide-path fast kernels): bound leniently so
+            # a stale prebuilt .so without them still serves the original
+            # ABI-stable entry points (callers fall back to Python).
+            try:
+                lib.voda_hungarian_warm.argtypes = [
+                    ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+                    ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_double),
+                    ctypes.POINTER(ctypes.c_double)]
+                lib.voda_hungarian_warm.restype = None
+                lib.voda_lexmin_pm.argtypes = [
+                    ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_int32)]
+                lib.voda_lexmin_pm.restype = None
+            except AttributeError:  # pragma: no cover - stale binary
+                log.debug("stale native binary lacks the warm kernels; "
+                          "rebuild with `make native`")
             _lib = lib
         except OSError as e:
             log.debug("native load failed: %s", e)
@@ -87,6 +105,66 @@ def hungarian_max(score: Sequence[Sequence[float]]) -> Optional[List[Tuple[int, 
     out = (ctypes.c_int32 * n)()
     lib.voda_hungarian_max(n, flat, out)
     return [(i, int(out[i])) for i in range(n)]
+
+
+def hungarian_warm(score: Sequence[Sequence[float]], row_to_col: List[int],
+                   u: List[float], v: List[float], dirty: Sequence[int]):
+    """Native warm/cold JV augmentation of `dirty` rows against the
+    given duals + partial assignment; returns (row_to_col, u, v) or
+    None when the kernel is unavailable (pure-Python fallback in
+    placement/hungarian.py)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        warm_fn = lib.voda_hungarian_warm
+    except AttributeError:  # pragma: no cover - stale prebuilt binary
+        return None
+    n = len(score)
+    if n == 0 or not dirty:
+        return list(row_to_col), list(u), list(v)
+    try:  # numpy marshalling: a Python n^2 fill would dwarf the solve
+        import numpy as np
+        flat = (ctypes.c_double * (n * n)).from_buffer_copy(
+            np.ascontiguousarray(score, dtype=np.float64).tobytes())
+    except ImportError:  # pragma: no cover - numpy ships with jax
+        flat = (ctypes.c_double * (n * n))()
+        for i, row in enumerate(score):
+            for j, x in enumerate(row):
+                flat[i * n + j] = float(x)
+    c_dirty = (ctypes.c_int32 * len(dirty))(*dirty)
+    c_rtc = (ctypes.c_int32 * n)(*row_to_col)
+    c_u = (ctypes.c_double * n)(*u)
+    c_v = (ctypes.c_double * n)(*v)
+    warm_fn(n, flat, len(dirty), c_dirty, c_rtc, c_u, c_v)
+    return ([int(c_rtc[i]) for i in range(n)],
+            [float(c_u[i]) for i in range(n)],
+            [float(c_v[j]) for j in range(n)])
+
+
+def lexmin_pm(tight, row_to_col: List[int]):
+    """Native lexicographically-smallest perfect matching of the tight
+    graph (`tight`: n x n numpy bool / 0-1 array, row-major);
+    `row_to_col` must be a perfect matching within it. Returns the
+    canonical row_to_col, or None when the kernel is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        lexmin_fn = lib.voda_lexmin_pm
+    except AttributeError:  # pragma: no cover - stale prebuilt binary
+        return None
+    n = len(row_to_col)
+    if n == 0:
+        return []
+    try:
+        buf = tight.astype("uint8").tobytes()  # numpy path
+    except AttributeError:
+        buf = bytes(1 if x else 0 for row in tight for x in row)
+    c_tight = (ctypes.c_uint8 * (n * n)).from_buffer_copy(buf)
+    c_rtc = (ctypes.c_int32 * n)(*row_to_col)
+    lexmin_fn(n, c_tight, c_rtc)
+    return [int(c_rtc[i]) for i in range(n)]
 
 
 def ffdl_dp(K: int, lo: Sequence[int], hi: Sequence[int],

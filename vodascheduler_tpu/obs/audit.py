@@ -93,7 +93,8 @@ PHASE_NAMES = frozenset({
     "algorithm",         # decide: the pure scheduling algorithm + feasibility rounding (nested in allocate)
     "hysteresis",        # decide: scale-out suppression gate
     "placement",         # decide: placement.place/defragment
-    "hungarian",         # decide: the Hungarian assignment solve (nested in placement)
+    "hungarian",         # decide: the cold Hungarian assignment solve (nested in placement)
+    "hungarian_warm",    # decide: warm-started incremental Hungarian re-solve (nested in placement)
     "diff",              # decide: old-vs-new allocation diff + reason tagging
     "commit",            # decide: BookingLedger.commit_pass
     "actuate_release",   # actuate: wave 1 — halts + scale-ins

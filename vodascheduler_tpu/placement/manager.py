@@ -26,7 +26,8 @@ TPU-first deltas:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+import os
+from typing import Dict, List, Optional, Set, Tuple
 
 from vodascheduler_tpu.common.metrics import Registry, timed
 from vodascheduler_tpu.obs import tracer as obs_tracer
@@ -57,11 +58,37 @@ class PlacementManager:
 
     def __init__(self, pool_id: str = "default",
                  topology: Optional[PoolTopology] = None,
-                 registry=None):
+                 registry=None, fast_diff: Optional[bool] = None):
         self.pool_id = pool_id
         self.topology = topology
         self.host_states: Dict[str, HostState] = {}
         self.job_placements: Dict[str, JobPlacement] = {}
+        # --- decide-path fast kernels (ROADMAP item 2) ---
+        # The incremental pass used to snapshot + re-diff + re-score
+        # every job every pass (O(jobs) dict/list churn while the
+        # scheduler lock is held — ~27 ms of the 10k-job decide phase
+        # in doc/perf_baseline.json). The fast path tracks the jobs a
+        # pass actually MUTATES (copy-on-write snapshots at first
+        # touch) and diffs/rescores only those; untouched jobs keep
+        # their expansion, their per-job stats entry, and their row in
+        # the persistent placements view by construction. The full-scan
+        # implementation remains as `_place_reference` — the
+        # differential oracle (VODA_PURE_PLACEMENT=1 forces it, and
+        # tests/test_fastpath_oracle.py proves decision equality over
+        # seeded churn sequences).
+        self.fast_diff = (not os.environ.get("VODA_PURE_PLACEMENT")
+                          if fast_diff is None else bool(fast_diff))
+        self._caches_valid = False
+        self._placements_view: Dict[str, List[Tuple[str, int]]] = {}
+        self._job_stats: Dict[str, Tuple[int, int]] = {}  # (crossed, contig)
+        self._cross_total = 0
+        self._contig_total = 0
+        self._prune_pending: Set[str] = set()  # zeroed by remove_host
+        self._pass_old: Optional[Dict[str, List[Tuple[str, int]]]] = None
+        # Warm-start state for defragment's Hungarian bind: duals +
+        # assignment carried between full repacks (placement/hungarian
+        # solve_max_warm; canonical extraction keeps warm == cold).
+        self._bind_warm: Optional[hungarian.WarmState] = None
         # Reference series: pkg/placement/metrics.go:11-50 (algo duration
         # summary + migrated/deleted/cross-node gauges of the last pass).
         if registry is None:
@@ -115,6 +142,9 @@ class PlacementManager:
                 if hs.host == name:
                     placement.num_workers -= hs.num_slots
                     hs.num_slots = 0
+            # The zeroed entries must be pruned (and the job's stats +
+            # placements-view row refreshed) by the next fast pass.
+            self._prune_pending.add(job_name)
 
     def add_hosts_from_topology(self, topology: PoolTopology) -> None:
         self.topology = topology
@@ -141,22 +171,209 @@ class PlacementManager:
         the job's existing hosts for ICI contiguity). Migrations then only
         arise from host loss — or from an explicit defragment() pass, which
         is where the reference's full repack + Hungarian machinery lives
-        on."""
+        on.
+
+        Two implementations, decision-identical (the differential
+        suite's contract): the touched-set fast path (ctor comment)
+        and the full-scan reference."""
         with timed(self.m_algo_duration, mode="incremental"), \
                 obs_tracer.active_tracer().span(
                     "placement.place", component="placement",
                     attrs={"pool": self.pool_id, "mode": "incremental",
                            "num_jobs": len(job_requests)}) as sp:
-            old_worker_hosts = {job: self._expand_workers(p)
-                                for job, p in self.job_placements.items()}
-
-            self._release_slots(job_requests)
-            cross, contiguity = self._place_incremental(job_requests)
-            decision = self._decision(old_worker_hosts, cross, contiguity)
+            if self.fast_diff:
+                decision = self._place_fast(job_requests)
+            else:
+                decision = self._place_reference(job_requests)
             sp.set_attr("workers_migrated", decision.workers_migrated)
             sp.set_attr("jobs_cross_host", decision.num_jobs_cross_host)
         self._observe(decision)
         return decision
+
+    def _place_reference(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """The full-scan pass: snapshot every job, release, pack, re-score
+        and re-diff the whole fleet — the differential-test oracle."""
+        self._caches_valid = False  # a later fast pass must rebuild
+        self._pass_old = None
+        old_worker_hosts = {job: self._expand_workers(p)
+                            for job, p in self.job_placements.items()}
+        self._release_slots(job_requests)
+        cross, contiguity = self._place_incremental(job_requests)
+        return self._decision(old_worker_hosts, cross, contiguity)
+
+    def _place_fast(self, job_requests: ScheduleResult) -> PlacementDecision:
+        """The touched-set pass: copy-on-write snapshots at first
+        mutation, growth-only packing without per-job re-pruning, and a
+        diff/stats/view refresh restricted to the touched jobs."""
+        if not self._caches_valid:
+            self._rebuild_caches()
+        self._pass_old = {}
+        if self._prune_pending:
+            # Entries zeroed by remove_host since the last pass: prune
+            # them now (the reference pruned every job every pass; zeros
+            # only ever come from host removal, so this is the whole
+            # set). Touch first — the snapshot ignores zero entries, so
+            # pruning itself never reads as a migration.
+            hosts = self.host_states
+            for job in self._prune_pending:
+                placement = self.job_placements.get(job)
+                if placement is None:
+                    continue
+                self._touch(job, placement)
+                placement.host_slots = [
+                    hs for hs in placement.host_slots
+                    if hs.num_slots > 0 and hs.host in hosts]
+            self._prune_pending.clear()
+        self._release_slots(job_requests)
+        self._pack_growth(job_requests)
+        decision = self._decision_fast()
+        self._pass_old = None
+        return decision
+
+    def _touch(self, job: str, placement: Optional[JobPlacement]) -> None:
+        """Record `job`'s pre-mutation placement once per pass (the
+        copy-on-write snapshot the end-of-pass diff runs against)."""
+        old = self._pass_old
+        if old is None or job in old:
+            return
+        if placement is None:
+            old[job] = []
+        else:
+            old[job] = [(hs.host, hs.num_slots)
+                        for hs in placement.host_slots if hs.num_slots > 0]
+
+    def _rebuild_caches(self) -> None:
+        """Full recompute of the persistent placements view and per-job
+        cross/contiguity stats (after reference-mode passes, restore, or
+        defragment rewrote the world)."""
+        view: Dict[str, List[Tuple[str, int]]] = {}
+        stats: Dict[str, Tuple[int, int]] = {}
+        cross_total = 0
+        contig_total = 0
+        for job, placement in self.job_placements.items():
+            view[job] = [(hs.host, hs.num_slots)
+                         for hs in placement.host_slots]
+            crossed, contig = self._job_stats_of(placement)
+            stats[job] = (crossed, contig)
+            cross_total += crossed
+            contig_total += contig
+        self._placements_view = view
+        self._job_stats = stats
+        self._cross_total = cross_total
+        self._contig_total = contig_total
+        self._caches_valid = True
+
+    def _job_stats_of(self, placement: JobPlacement) -> Tuple[int, int]:
+        """(crossed 0/1, contiguity cost) for one job — the per-job term
+        of the fleet stats the reference recomputed wholesale."""
+        used = {hs.host for hs in placement.host_slots if hs.num_slots > 0}
+        if len(used) <= 1:
+            return 0, 0
+        contig = 0
+        if self.topology is not None:
+            host_states = self.host_states
+            coords = [host_states[h].coord for h in used
+                      if h in host_states
+                      and host_states[h].coord is not None]
+            contig = self.topology.contiguity_cost(coords)
+        return 1, contig
+
+    def _pack_growth(self, job_requests: ScheduleResult) -> None:
+        """The reference `_place_incremental` loop restricted to jobs
+        that actually grow (requested > placed). Restricting BEFORE the
+        demand sort is order-preserving: a stable filter commutes with
+        the stable sort, and no-growth jobs were side-effect-free in the
+        reference loop (their per-job prune is a no-op outside host
+        churn, which _place_fast handles via _prune_pending)."""
+        jp = self.job_placements
+        growth: List[Tuple[str, int]] = []
+        for job, requested in job_requests.items():
+            placement = jp.get(job)
+            if placement is None or requested > placement.num_workers:
+                growth.append((job, requested))
+        if not growth:
+            return
+        growth.sort(key=lambda kv: kv[1], reverse=True)
+        hosts = self._hosts_sorted()
+        host_states = self.host_states
+        for job, requested in growth:
+            placement = jp.get(job)
+            if placement is None:
+                placement = jp[job] = JobPlacement(name=job)
+            self._touch(job, placement)
+            delta = requested - placement.num_workers
+            if delta <= 0:
+                continue
+            my_hosts = [host_states[hs.host] for hs in placement.host_slots
+                        if hs.host in host_states and hs.num_slots > 0]
+            while delta > 0:
+                best = self._pick_host(hosts, delta, my_hosts,
+                                       prefer_own=True)
+                if best is None:
+                    break  # tolerated inconsistency: place what fits
+                take = min(best.free_slots, delta)
+                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
+                best.free_slots -= take
+                delta -= take
+                placement.num_workers += take
+                if placement.host_slots and placement.host_slots[-1].host == best.name:
+                    placement.host_slots[-1].num_slots += take
+                else:
+                    placement.host_slots.append(HostSlots(best.name, take))
+                if best not in my_hosts:
+                    my_hosts.append(best)
+            if placement.num_workers == 0:
+                del jp[job]
+
+    def _decision_fast(self) -> PlacementDecision:
+        """Diff + stats + view refresh over the touched jobs only; the
+        untouched fleet contributes its cached terms unchanged."""
+        migrations: Dict[str, List[int]] = {}
+        full_restarts: List[str] = []
+        migrated = 0
+        view = self._placements_view
+        stats = self._job_stats
+        jp = self.job_placements
+        for job, old_pairs in (self._pass_old or {}).items():
+            placement = jp.get(job)
+            if placement is None:  # released outright this pass
+                view.pop(job, None)
+                crossed, contig = stats.pop(job, (0, 0))
+                self._cross_total -= crossed
+                self._contig_total -= contig
+                continue
+            pairs = [(hs.host, hs.num_slots) for hs in placement.host_slots]
+            view[job] = pairs
+            crossed, contig = self._job_stats_of(placement)
+            old_crossed, old_contig = stats.get(job, (0, 0))
+            stats[job] = (crossed, contig)
+            self._cross_total += crossed - old_crossed
+            self._contig_total += contig - old_contig
+
+            new_hosts = self._expand_pairs(pairs)
+            old_hosts = self._expand_pairs(old_pairs)
+            moved = [i for i in range(min(len(old_hosts), len(new_hosts)))
+                     if old_hosts[i] != new_hosts[i]]
+            if moved:
+                migrations[job] = moved
+                migrated += len(moved)
+                if len(moved) == len(new_hosts):
+                    full_restarts.append(job)
+        return PlacementDecision(
+            placements=dict(view),
+            migrations=migrations,
+            full_restarts=full_restarts,
+            num_jobs_cross_host=self._cross_total,
+            total_contiguity_cost=self._contig_total,
+            workers_migrated=migrated,
+        )
+
+    @staticmethod
+    def _expand_pairs(pairs: List[Tuple[str, int]]) -> List[str]:
+        hosts: List[str] = []
+        for host, num in pairs:
+            hosts.extend([host] * num)
+        return hosts
 
     def defragment(self, job_requests: ScheduleResult) -> PlacementDecision:
         """Full repack + Hungarian stay-put relabeling (the reference's
@@ -179,6 +396,10 @@ class PlacementManager:
             self._bind_hosts(logical)
             self._update_job_placements()
             decision = self._decision(old_worker_hosts, cross, contiguity)
+            # The repack rewrote the world: the fast path's incremental
+            # view/stats rebuild on its next pass.
+            self._caches_valid = False
+            self._prune_pending.clear()
         self._observe(decision)
         return decision
 
@@ -271,6 +492,7 @@ class PlacementManager:
             requested = job_requests.get(placement.name)
             if requested is None:
                 # Terminated: release everything.
+                self._touch(placement.name, placement)
                 for hs in placement.host_slots:
                     host = self.host_states.get(hs.host)
                     if host is not None:
@@ -282,6 +504,7 @@ class PlacementManager:
             elif requested < placement.num_workers:
                 # Scaled down: trim from the tail — worker ranks die from
                 # the highest index first (release-order contract).
+                self._touch(placement.name, placement)
                 to_release = placement.num_workers - requested
                 while to_release > 0 and placement.host_slots:
                     tail = placement.host_slots[-1]
@@ -387,7 +610,13 @@ class PlacementManager:
         if n == 0:
             return
         score = [[self._overlap(lg, ph) for ph in physical] for lg in logical]
-        for row, col in hungarian.solve_max(score):
+        # Warm-started canonical assignment: duals + matching carried
+        # from the previous defragment; only rows whose overlap vector
+        # changed re-solve (canonical extraction guarantees the result
+        # equals a cold solve_max — hungarian.py module docstring).
+        assignment, self._bind_warm = hungarian.solve_max_warm(
+            score, self._bind_warm)
+        for row, col in assignment:
             logical[row].name = physical[col].name
             logical[row].coord = physical[col].coord
         self.host_states = {h.name: h for h in logical}
@@ -429,6 +658,7 @@ class PlacementManager:
         """Reconstruct state from externally persisted placements (the
         backend's view of running workers — the TPU analog of reading pod
         tolerations)."""
+        self._caches_valid = False
         for job, host_slots in placements.items():
             placement = JobPlacement(name=job)
             for host_name, workers in host_slots:

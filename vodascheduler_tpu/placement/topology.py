@@ -82,6 +82,66 @@ def feasible_shapes(num_chips: int, torus_dims: Sequence[int]) -> List[SliceShap
     return shapes
 
 
+class FeasibleTable:
+    """Precomputed feasibility of every chip count 0..total_chips for
+    one (torus_dims, host_block) pool shape — the decide-path kernel
+    behind round_to_feasible / next_feasible_above / is_feasible_count.
+
+    The scan-based primitives below (`_is_feasible_scan` et al.) pay a
+    factorization enumeration per probe and, for the rounding helpers,
+    a probe per candidate count; under `enforce_feasibility` that ran
+    on every grant of every pass while the scheduler lock was held.
+    A pool shape's feasibility is static, so one upfront sweep turns
+    all three into array lookups. Tables are cached per shape
+    (`FeasibleTable.for_topology`); the scan primitives remain the
+    differential-test oracles (tests/test_fastpath_oracle.py).
+    """
+
+    __slots__ = ("total", "feasible", "round_down", "next_at")
+
+    def __init__(self, torus_dims: Tuple[int, ...],
+                 host_block: Tuple[int, ...]) -> None:
+        total = math.prod(torus_dims)
+        cph = math.prod(host_block)
+        host_grid = tuple(t // h for t, h in zip(torus_dims, host_block))
+        feasible = [False] * (total + 1)
+        feasible[0] = True
+        for n in range(1, total + 1):
+            if n < cph:
+                feasible[n] = bool(_divisor_shapes(n, host_block))
+            else:
+                feasible[n] = (n % cph == 0
+                               and bool(_divisor_shapes(n // cph, host_grid)))
+        round_down = [0] * (total + 1)
+        best = 0
+        for n in range(1, total + 1):
+            if feasible[n]:
+                best = n
+            round_down[n] = best
+        # next_at[k] = smallest feasible count >= k (k in 0..total);
+        # None past the pool's largest feasible count.
+        next_at: List[Optional[int]] = [None] * (total + 1)
+        nxt: Optional[int] = None
+        for n in range(total, -1, -1):
+            if feasible[n]:
+                nxt = n
+            next_at[n] = nxt
+        self.total = total
+        self.feasible = feasible
+        self.round_down = round_down
+        self.next_at = next_at
+
+    _cache: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], "FeasibleTable"] = {}
+
+    @classmethod
+    def for_topology(cls, topology: "PoolTopology") -> "FeasibleTable":
+        key = (topology.torus_dims, topology.host_block)
+        table = cls._cache.get(key)
+        if table is None:
+            table = cls._cache[key] = cls(*key)
+        return table
+
+
 def round_to_feasible(n: int, topology: "PoolTopology") -> int:
     """Largest feasible chip count <= n on this pool.
 
@@ -93,31 +153,45 @@ def round_to_feasible(n: int, topology: "PoolTopology") -> int:
     becoming `map[job]sliceShape` (reference invariant enforcement:
     pkg/algorithm/utils.go:18-42 has no such notion — GPUs are fungible).
     """
-    for k in range(min(n, topology.total_chips), 0, -1):
-        if is_feasible_count(k, topology):
-            return k
-    return 0
+    table = FeasibleTable.for_topology(topology)
+    if n <= 0:
+        return 0
+    return table.round_down[n if n <= table.total else table.total]
 
 
 def next_feasible_above(n: int, topology: "PoolTopology") -> Optional[int]:
     """Smallest feasible chip count > n, or None if the pool tops out."""
-    for k in range(n + 1, topology.total_chips + 1):
-        if is_feasible_count(k, topology):
-            return k
-    return None
+    table = FeasibleTable.for_topology(topology)
+    k = n + 1
+    if k > table.total:
+        return None
+    return table.next_at[k if k > 0 else 0]
 
 
 def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
-    """O(1)-ish direct check (one factorization enumeration, no scan) —
-    this sits on the allocation hot path via enforce_feasibility and
-    validate_result.
+    """O(1) table lookup — this sits on the allocation hot path via
+    enforce_feasibility and validate_result. A count above the pool's
+    total can never tile it (factors are bounded by the host grid), so
+    out-of-range counts are infeasible without a probe."""
+    if n == 0:
+        return True
+    table = FeasibleTable.for_topology(topology)
+    if n < 0 or n > table.total:
+        return False
+    return table.feasible[n]
 
-    Multi-host slices must be a contiguous block of *whole hosts*, i.e. a
-    sub-grid of the host grid scaled by the host block — so the check
-    factorizes n / chips_per_host over the host grid, not n over the raw
-    torus (e.g. 36 chips on a (4,4,4)/(2,2,1) pool factor as 3x3x4 chips,
-    but no union of whole 2x2x1 hosts forms that box: infeasible).
-    """
+
+# ---- scan-based reference primitives (differential-test oracles) -----------
+
+
+def _is_feasible_scan(n: int, topology: "PoolTopology") -> bool:
+    """Pre-table is_feasible_count: one factorization enumeration per
+    probe. Multi-host slices must be a contiguous block of *whole
+    hosts*, i.e. a sub-grid of the host grid scaled by the host block —
+    so the check factorizes n / chips_per_host over the host grid, not
+    n over the raw torus (e.g. 36 chips on a (4,4,4)/(2,2,1) pool
+    factor as 3x3x4 chips, but no union of whole 2x2x1 hosts forms
+    that box: infeasible)."""
     if n == 0:
         return True
     if n < 0:
@@ -126,6 +200,21 @@ def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
     if n < cph:
         return bool(_divisor_shapes(n, topology.host_block))
     return n % cph == 0 and bool(_divisor_shapes(n // cph, topology.host_grid))
+
+
+def _round_to_feasible_scan(n: int, topology: "PoolTopology") -> int:
+    for k in range(min(n, topology.total_chips), 0, -1):
+        if _is_feasible_scan(k, topology):
+            return k
+    return 0
+
+
+def _next_feasible_above_scan(n: int,
+                              topology: "PoolTopology") -> Optional[int]:
+    for k in range(n + 1, topology.total_chips + 1):
+        if _is_feasible_scan(k, topology):
+            return k
+    return None
 
 
 @dataclasses.dataclass

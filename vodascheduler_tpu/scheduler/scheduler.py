@@ -1271,8 +1271,14 @@ class Scheduler:
         scale_ins: List[str] = []
         scale_outs: List[str] = []
         starts: List[str] = []
+        # One ledger snapshot for the whole diff: the per-job .get()
+        # takes the ledger lock each call, which at 10k jobs is pure
+        # overhead inside the decide window (behavior identical — the
+        # pass thread is the only booking writer here).
+        booked = self.job_num_chips.snapshot()
+        booked_get = booked.get
         for job, n_old in old.items():
-            n_new = self.job_num_chips.get(job, 0)
+            n_new = booked_get(job, 0)
             if n_old > n_new:
                 if n_new == 0:
                     status = self._job_status(job)
@@ -1287,7 +1293,7 @@ class Scheduler:
                 else:
                     scale_outs.append(job)
         # jobs that appear only in the new result
-        for job, n_new in self.job_num_chips.items():
+        for job, n_new in booked.items():
             if job not in old and n_new > 0:
                 starts.append(job)
         return halts, scale_ins, scale_outs, starts
@@ -1598,29 +1604,40 @@ class Scheduler:
 
     def _update_time_metrics_locked(self) -> bool:
         """Returns whether a Tiresias priority flipped (the caller fires
-        the resched trigger once it has released the lock)."""
+        the resched trigger once it has released the lock).
+
+        This runs inside every pass's decide window (resched() ticks it
+        before deciding), so the loop body is hoisted for the 10k-job
+        queue: one booking snapshot instead of a locked get per job, and
+        the per-job enum/algorithm tests reduced to locals (ROADMAP
+        item 2; behavior identical to the unhoisted form)."""
         now = self.clock.now()
         priority_changed = False
+        is_tiresias = self.algorithm in ("Tiresias", "ElasticTiresias")
+        booked = self.job_num_chips.snapshot()
+        booked_get = booked.get
+        RUNNING, WAITING = JobStatus.RUNNING, JobStatus.WAITING
         for job in self.ready_jobs.values():
-            elapsed = now - job.metrics.last_update_time
+            m = job.metrics
+            elapsed = now - m.last_update_time
             if elapsed < 0:
                 elapsed = 0.0
-            n = self.job_num_chips.get(job.name, 0)
-            m = job.metrics
-            if job.status == JobStatus.RUNNING:
+            status = job.status
+            if status is RUNNING:
+                chip_elapsed = elapsed * booked_get(job.name, 0)
                 m.running_seconds += elapsed
-                m.chip_seconds += elapsed * n
+                m.chip_seconds += chip_elapsed
                 m.total_seconds += elapsed
                 m.last_running_seconds += elapsed
-                m.last_chip_seconds += elapsed * n
+                m.last_chip_seconds += chip_elapsed
                 m.seconds_since_restart += elapsed
-            elif job.status == JobStatus.WAITING:
+            elif status is WAITING:
                 m.waiting_seconds += elapsed
                 m.total_seconds += elapsed
                 m.last_waiting_seconds += elapsed
             m.last_update_time = now
 
-            if (self.algorithm in ("Tiresias", "ElasticTiresias")
+            if (is_tiresias
                     and job.status in (JobStatus.RUNNING, JobStatus.WAITING)):
                 # Deliberate fix over the reference (scheduler.go:787-802),
                 # which never resets the last_* windows on a transition: a
