@@ -86,18 +86,23 @@ bench-dryrun:
 trace-dryrun:
 	$(PY) -m vodascheduler_tpu.obs.dryrun
 
-# Regenerate the committed decide-path scaling baseline
-# (doc/perf_baseline.json): per-phase latency-vs-N curves for
-# N in {100, 1k, 10k} on the fake backend, pinned seed (~30s). Review
-# the diff like any artifact — this is what the perf gate compares
-# against (doc/observability.md "Performance observatory").
+# Regenerate the committed decide-path + ingestion scaling baseline
+# (doc/perf_baseline.json): per-phase latency-vs-N curves plus the
+# ingestion section (bulk/single admission p99, storm-to-quiescent,
+# snapshot-cache reads) for N in {100, 1k, 10k} on the fake backend,
+# pinned seed (~60s). Review the diff like any artifact — this is what
+# the perf gate compares against (doc/observability.md "Performance
+# observatory" + "Ingestion plane").
 perf-baseline:
 	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py \
 		--out doc/perf_baseline.json
 
 # CI perf-regression gate: re-measure a bounded N set and fail if the
 # decide phase (or any >=1ms sub-phase) regressed past
-# baseline * tolerance + slack. Prints the full comparison table and
+# baseline * tolerance + slack — or if an ingestion column did: bulk /
+# single admission p99 (slack/5: sub-ms costs need a sub-ms band) or
+# storm passes-to-quiescent (a count: only a coalescing regression
+# moves it). Prints the full comparison table and
 # always writes the fresh curves (doc/perf_gate_fresh.json, uploaded as
 # a CI artifact on failure) so a regression is diagnosable from the CI
 # log alone. The CI band (x4 + 50ms) is deliberately wider than the

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Decide-path scale harness + CI perf-regression gate.
+"""Decide-path + ingestion-plane scale harness + CI perf-regression gate.
 
 Synthesizes N-job pools (default N ∈ {100, 1k, 10k}) on a
 FakeClusterBackend under a VirtualClock, runs pinned-seed rescheduling
@@ -35,6 +35,19 @@ new submission (the coalescing window collects both), so the pass
 exercises allocation over the full queue, an incremental placement, and
 a small actuation wave — the steady-state shape of a busy pool, not an
 empty-to-full stampede (the warm-up pass covers that shape once).
+
+Schema 3 adds the ingestion section (doc/observability.md "Ingestion
+plane"): per-N bulk-admission burst curves (per-item p50/p99 through the
+REAL AdmissionService batch path: validate -> one store commit -> one
+publish_many -> batched scheduler drain), single-request admission
+p50/p99, the event-storm-to-quiescent shape (how many coalesced resched
+passes a fleet-sized CREATE storm costs, and how long until the pool is
+quiet), and read latency from the snapshot cache — sampled by a
+concurrent scrape thread WHILE the storm's passes are in flight. The
+gate bounds the admission p99 columns with a tighter slack than the
+decide phases (sub-ms admission costs would vanish inside the decide
+slack), and pins passes-to-quiescent so a coalescing regression (N
+events -> N passes) cannot land silently.
 """
 
 from __future__ import annotations
@@ -68,7 +81,19 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 2  # v2: mean/max grew p50/p95 (phases: wall_ms_p50/p95)
+SCHEMA = 3  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
+# suite grew the top-level "ingestion" section (bulk/single admission,
+# storm-to-quiescent, snapshot-cache reads).
+
+# Ingestion measurement shape: the admission slack is deliberately
+# tighter than the decide slack — a per-item bulk admission costs
+# ~0.05-0.5 ms, so the decide gate's 25-50 ms slack would make its
+# bound vacuous. The divisor keeps the two gates one knob.
+INGEST_SLACK_DIVISOR = 5.0
+# Passes-to-quiescent is a COUNT, not a latency: machine speed cannot
+# move it, only a coalescing regression can. The bound still leaves
+# room for one extra retrigger window.
+INGEST_PASS_BOUND = (2.0, 2)  # fresh <= base * 2 + 2
 
 
 def build_world(n_jobs: int, seed: int,
@@ -113,22 +138,21 @@ def _make_spec(i: int, rng: random.Random):
 
 
 def _percentile(values: List[float], q: float) -> float:
-    """Nearest-rank percentile (deterministic, no interpolation): the
-    smallest sample at or above rank ceil(q * n)."""
-    ordered = sorted(values)
-    # Integer arithmetic (q as a percent) so 0.95 * 20 == rank 19, not
-    # the float-fuzzed 20.
-    rank = max(1, (int(q * 100) * len(ordered) + 99) // 100)
-    return ordered[rank - 1]
+    """Nearest-rank percentile — the one shared implementation
+    (common/metrics.py), re-exported under the harness's local name."""
+    from vodascheduler_tpu.common.metrics import nearest_rank_percentile
+    return nearest_rank_percentile(values, q)
 
 
 def _agg(values: List[float]) -> Dict[str, float]:
     if not values:
-        return {"mean": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0}
+        return {"mean": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0,
+                "p99": 0.0}
     return {"mean": round(statistics.mean(values), 3),
             "max": round(max(values), 3),
             "p50": round(_percentile(values, 0.50), 3),
-            "p95": round(_percentile(values, 0.95), 3)}
+            "p95": round(_percentile(values, 0.95), 3),
+            "p99": round(_percentile(values, 0.99), 3)}
 
 
 def _probe_defragment(sched, hosts: int) -> Dict[str, object]:
@@ -251,6 +275,138 @@ def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
     return curve
 
 
+def run_ingestion_point(n_jobs: int, seed: int = DEFAULT_SEED,
+                        inject_admission_ms: float = 0.0
+                        ) -> Dict[str, object]:
+    """Measure the ingestion plane at one fleet size (doc/observability.md
+    "Ingestion plane"): admit `n_jobs` through the REAL bulk path in
+    B-sized bursts (each burst: validate -> one store commit -> one
+    publish_many -> one batched scheduler drain), plus a tail of timed
+    single-request admissions, then let the storm's coalesced passes run
+    to quiescence while a concurrent scrape thread samples the snapshot
+    cache.
+
+    `inject_admission_ms` seeds a per-job slowdown into the store commit
+    — the gate's ingestion self-test (a seeded admission regression must
+    trip the p99 bound the way a placement sleep trips the decide one).
+    """
+    import threading
+
+    clock, store, backend, sched, admission, rng = build_world(n_jobs, seed)
+
+    if inject_admission_ms > 0:
+        orig_insert = store.insert_jobs
+
+        def slow_insert(jobs, infos=()):
+            time.sleep(inject_admission_ms * max(1, len(jobs)) / 1000.0)
+            orig_insert(jobs, infos)
+
+        store.insert_jobs = slow_insert
+
+    # Warm-up: one admitted job runs the inline fill pass and closes the
+    # rate-limit window, so every measured admission below lands inside
+    # the window — its cost is validate/commit/publish/drain, never a
+    # piggy-backed decide pass (those are measured by run_point).
+    admission.create_training_job(_make_spec(0, rng))
+
+    # Single-request admissions: the per-request latency a lone client
+    # sees on POST /training.
+    singles = min(100, max(10, n_jobs // 10))
+    single_ms: List[float] = []
+    for i in range(singles):
+        t0 = time.monotonic()
+        admission.create_training_job(_make_spec(1 + i, rng))
+        single_ms.append((time.monotonic() - t0) * 1000.0)
+
+    # Bulk bursts: n_jobs more specs through POST /training/batch's
+    # engine, B at a time.
+    burst_size = max(10, min(1000, n_jobs // 5))
+    burst_ms: List[float] = []
+    item_ms: List[float] = []
+    next_id = 1 + singles
+    remaining = n_jobs
+    while remaining > 0:
+        take = min(burst_size, remaining)
+        specs = [_make_spec(next_id + k, rng) for k in range(take)]
+        next_id += take
+        remaining -= take
+        t0 = time.monotonic()
+        results = admission.create_training_jobs(specs)
+        dt = (time.monotonic() - t0) * 1000.0
+        assert all("error" not in r for r in results)
+        burst_ms.append(dt)
+        # Amortized per-item cost of the burst — items inside a burst
+        # are NOT individually timed, so the aggregate's "p99" is over
+        # per-burst means (one sample per burst), not per-item tails.
+        item_ms.append(dt / take)
+
+    # Storm -> quiescent: every admission above landed in one rate-limit
+    # window; advancing the clock fires the coalesced pass(es). A scrape
+    # thread hammers the status snapshot THROUGHOUT — while passes hold
+    # the scheduler lock — so the read aggregate is "what a concurrent
+    # poller pays mid-pass", served from the version-stamped cache.
+    seq_before = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
+    reads_during: List[float] = []
+    stop_reading = threading.Event()
+
+    def scraper():
+        while not stop_reading.is_set():
+            t0 = time.monotonic()
+            sched.status_table_json()
+            reads_during.append((time.monotonic() - t0) * 1000.0)
+            time.sleep(0.0005)
+
+    # Warm the snapshot cache first: the very first read after boot
+    # builds it under the lock, and with the fill pass in flight that
+    # cold sample would wait out the whole pass — a boot artifact, not
+    # the cached-read-during-pass cost this column claims to measure.
+    sched.status_table_json()
+    reader = threading.Thread(target=scraper, daemon=True)
+    t_storm = time.monotonic()
+    reader.start()
+    settle_windows = 0
+    while settle_windows < 20:
+        clock.advance(DEFAULT_RATE_LIMIT + 2.0)
+        settle_windows += 1
+        with sched._lock:
+            pending = sched._resched_pending
+        if not pending and admission.bus.pending(sched.pool_id) == 0:
+            break
+    quiescent_ms = (time.monotonic() - t_storm) * 1000.0
+    stop_reading.set()
+    reader.join(timeout=5.0)
+    passes = len([r for r in sched.profile_records(0)
+                  if r["seq"] > seq_before])
+
+    # Steady-state cached reads: the pool is quiet, the snapshot is
+    # warm — this is the ~zero a scrape costs between state changes.
+    cached_ms: List[float] = []
+    for _ in range(200):
+        t0 = time.monotonic()
+        sched.status_table_json()
+        cached_ms.append((time.monotonic() - t0) * 1000.0)
+
+    point = {
+        "n_jobs": n_jobs,
+        "burst_size": burst_size,
+        "bursts": len(burst_ms),
+        "singles": singles,
+        "bulk_admit_burst_ms": _agg(burst_ms),
+        "bulk_admit_per_item_ms": _agg(item_ms),
+        "single_admit_ms": _agg(single_ms),
+        "storm": {
+            "events": n_jobs + singles + 1,
+            "passes_to_quiescent": passes,
+            "to_quiescent_ms": round(quiescent_ms, 3),
+        },
+        "read_during_pass_ms": dict(_agg(reads_during),
+                                    count=len(reads_during)),
+        "read_cached_ms": _agg(cached_ms),
+    }
+    sched.stop()
+    return point
+
+
 def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
               seed: int = DEFAULT_SEED, verbose: bool = True) -> dict:
     curves = []
@@ -263,21 +419,37 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
                   f"({time.monotonic() - t0:.1f}s to measure)",
                   file=sys.stderr)
         curves.append(curve)
+    ingestion = []
+    for n in ns:
+        t0 = time.monotonic()
+        point = run_ingestion_point(n, seed=seed)
+        if verbose:
+            print(f"perf_scale: N={n}: ingest bulk "
+                  f"{point['bulk_admit_per_item_ms']['p99']}ms/job p99, "
+                  f"storm -> quiescent in "
+                  f"{point['storm']['passes_to_quiescent']} pass(es) "
+                  f"({time.monotonic() - t0:.1f}s to measure)",
+                  file=sys.stderr)
+        ingestion.append(point)
     return {
         "schema": SCHEMA,
         "tool": "scripts/perf_scale.py",
-        "note": ("Per-phase decide/actuate latency-vs-N curves on the "
-                 "fake backend (pinned seed), mean/max/p50/p95 per "
-                 "phase. Regenerate with `make perf-baseline` and "
-                 "review the diff; `make perf-gate` compares a fresh "
-                 "bounded-N run (decide mean + p95, >=1ms sub-phase "
-                 "means) against this file. doc/observability.md "
-                 "'Performance observatory'."),
+        "note": ("Per-phase decide/actuate latency-vs-N curves plus the "
+                 "ingestion section (bulk/single admission, storm-to-"
+                 "quiescent, snapshot-cache reads) on the fake backend "
+                 "(pinned seed), mean/max/p50/p95/p99 per aggregate. "
+                 "Regenerate with `make perf-baseline` and review the "
+                 "diff; `make perf-gate` compares a fresh bounded-N run "
+                 "(decide mean + p95, >=1ms sub-phase means, admission "
+                 "p99 columns, passes-to-quiescent) against this file. "
+                 "doc/observability.md 'Performance observatory' + "
+                 "'Ingestion plane'."),
         "seed": seed,
         "passes": passes,
         "rate_limit_seconds": DEFAULT_RATE_LIMIT,
         "python": platform.python_version(),
         "curves": curves,
+        "ingestion": ingestion,
     }
 
 
@@ -329,6 +501,57 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
                                 f"absent from the fresh run")
                 continue
             check(name, fresh_phase["wall_ms_mean"], stats["wall_ms_mean"])
+
+    # Ingestion columns (schema 3): admission p99 bounds use a tighter
+    # slack (sub-ms costs would vanish inside the decide slack);
+    # passes-to-quiescent is a count bound — only a coalescing
+    # regression can move it.
+    base_ing = {c["n_jobs"]: c for c in baseline.get("ingestion", [])}
+    fresh_ing = {c["n_jobs"]: c for c in fresh.get("ingestion", [])}
+    if base_ing and not fresh_ing:
+        # The decide-phase inject self-test measures no ingestion; say
+        # so rather than silently narrowing the gate.
+        print("  (ingestion section absent from the fresh run — "
+              "admission columns not compared)")
+    ing_slack = slack_ms / INGEST_SLACK_DIVISOR
+    for n in sorted(fresh_ing):
+        fc, bc = fresh_ing[n], base_ing.get(n)
+        if bc is None:
+            problems.append(f"N={n}: no baseline ingestion point "
+                            f"(regenerate with make perf-baseline)")
+            continue
+
+        def icheck(label: str, fresh_ms: float, base_ms: float) -> None:
+            bound = base_ms * tolerance + ing_slack
+            verdict = "ok" if fresh_ms <= bound else "REGRESSED"
+            print(f"  N={n:>6} {label:<18} base={base_ms:>10.3f}ms "
+                  f"fresh={fresh_ms:>10.3f}ms bound={bound:>10.3f}ms "
+                  f"{verdict}")
+            if fresh_ms > bound:
+                problems.append(
+                    f"N={n}: {label} regressed: {fresh_ms:.3f}ms vs "
+                    f"baseline {base_ms:.3f}ms (bound {bound:.3f}ms)")
+
+        icheck("ingest_bulk_p99", fc["bulk_admit_per_item_ms"]["p99"],
+               bc["bulk_admit_per_item_ms"]["p99"])
+        icheck("ingest_single_p99", fc["single_admit_ms"]["p99"],
+               bc["single_admit_ms"]["p99"])
+        if fc["read_during_pass_ms"].get("count", 0):
+            icheck("ingest_read_p99", fc["read_during_pass_ms"]["p99"],
+                   bc["read_during_pass_ms"]["p99"])
+        ratio, extra = INGEST_PASS_BOUND
+        base_passes = bc["storm"]["passes_to_quiescent"]
+        fresh_passes = fc["storm"]["passes_to_quiescent"]
+        bound_passes = base_passes * ratio + extra
+        verdict = "ok" if fresh_passes <= bound_passes else "REGRESSED"
+        print(f"  N={n:>6} {'storm_passes':<18} base={base_passes:>10} "
+              f"fresh={fresh_passes:>10} bound={bound_passes:>10.0f}   "
+              f"{verdict}")
+        if fresh_passes > bound_passes:
+            problems.append(
+                f"N={n}: storm coalescing regressed: {fresh_passes} "
+                f"passes to quiescent vs baseline {base_passes} "
+                f"(bound {bound_passes:.0f})")
     return problems
 
 
@@ -361,6 +584,9 @@ def main(argv=None) -> int:
                         help="seed a sleep into this stage (gate "
                              "self-test)")
     parser.add_argument("--inject-ms", type=float, default=0.0)
+    parser.add_argument("--inject-admission-ms", type=float, default=0.0,
+                        help="seed a per-job sleep into the bulk store "
+                             "commit (ingestion-gate self-test)")
     args = parser.parse_args(argv)
 
     ns = (tuple(int(x) for x in args.ns.split(",")) if args.ns
@@ -375,6 +601,14 @@ def main(argv=None) -> int:
                                 inject=(args.inject_phase, args.inject_ms))
                       for n in ns]
             fresh = {"schema": SCHEMA, "curves": curves}
+        elif args.inject_admission_ms:
+            # Ingestion self-test path: only the admission columns are
+            # re-measured, with the seeded per-job commit slowdown.
+            fresh = {"schema": SCHEMA, "curves": [],
+                     "ingestion": [run_ingestion_point(
+                         n, seed=args.seed,
+                         inject_admission_ms=args.inject_admission_ms)
+                         for n in ns]}
         else:
             fresh = run_suite(ns, passes=args.passes, seed=args.seed)
         fresh_out = args.fresh_out or os.path.join(

@@ -136,6 +136,36 @@ class TestApisDoc:
                      "PhaseTimer", "decide_scaling"):
             assert term in doc, f"observatory term {term!r} missing"
 
+    def test_ingestion_plane_documented(self):
+        """The fleet-scale ingestion plane's contract is pinned both
+        ways: apis.md documents the batch route and the 429 semantics,
+        observability.md documents the mechanisms, knobs, and metric
+        names, and every documented knob exists in config.py."""
+        with open(os.path.join(REPO, "doc", "apis.md")) as f:
+            apis = f.read()
+        for term in ("/training/batch", "429", "Retry-After",
+                     "/debug/ingest", "zero residue"):
+            assert term in apis, f"apis.md: ingestion term {term!r} missing"
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        assert "Ingestion plane" in doc
+        for term in ("publish_many", "insert_jobs", "batch mode",
+                     "snapshot cache", "shed watermark",
+                     "passes_to_quiescent", "voda_admission_shed_total",
+                     "voda_events_dropped_total", "voda_event_queue_depth",
+                     "/debug/ingest"):
+            assert term in doc, f"ingestion-plane term {term!r} missing"
+        import vodascheduler_tpu.config as cfg
+        for knob, attr in (("VODA_EVENT_QUEUE_MAX", "EVENT_QUEUE_MAX"),
+                           ("VODA_EVENT_SHED_WATERMARK",
+                            "EVENT_SHED_WATERMARK"),
+                           ("VODA_ADMISSION_RETRY_AFTER_SECONDS",
+                            "ADMISSION_RETRY_AFTER_SECONDS"),
+                           ("VODA_METRICS_CACHE_SECONDS",
+                            "METRICS_CACHE_SECONDS")):
+            assert knob in doc, f"ingestion knob {knob} undocumented"
+            assert hasattr(cfg, attr), f"documented knob {knob} gone"
+
     def test_observability_doc_covers_concurrency_model(self):
         """The concurrent actuation plane's contract is documented: the
         decide/actuate split, the wave vocabulary (matching the

@@ -1,0 +1,1156 @@
+"""The fleet-scale ingestion plane (doc/observability.md "Ingestion
+plane"): bounded batched event bus, bulk admission with all-or-nothing
+rollback, 429 backpressure, the read-path snapshot cache, and the
+pinned ingestion latency columns.
+
+What is pinned here:
+
+1. **Bus semantics** — bounded per-topic queues that drop-and-count at
+   the bound, batch-mode subscribers that receive a drained burst as
+   ONE call, and a drain that delivers OUTSIDE the bus lock (a raising
+   subscriber can never wedge concurrent publishers).
+2. **Bulk admission atomicity** — a batch with one invalid spec admits
+   NOTHING (zero residue in store or bus, per-item error bodies); a
+   publish/hook failure compensating-deletes the whole batch
+   (handlers.go:119-134, scaled up).
+3. **Backpressure** — a pool past its shed watermark answers
+   `429 + Retry-After` and counts `voda_admission_shed_total`.
+4. **Storm coalescing** — a 1k-event CREATE storm costs a bounded
+   number of resched passes, not 1k lock round-trips.
+5. **Snapshot cache** — `status_table()`/`GET /training` serve the last
+   committed snapshot, lock-free, while a pass holds the scheduler
+   busy; the slow tier measures a 1k-job burst's per-request p99 under
+   20 ms with a pass in flight (the ISSUE 9 acceptance number).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus, JobEvent
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import EventVerb
+from vodascheduler_tpu.placement import PlacementManager
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+from vodascheduler_tpu.service.admission import (
+    BATCH_SIBLING_REJECTED,
+    AdmissionShed,
+)
+from vodascheduler_tpu.service.rest import Raw, _metrics_route, make_service_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import perf_scale  # noqa: E402
+
+
+def _spec(name, pool="pool", max_chips=4, epochs=1000):
+    return JobSpec(name=name, pool=pool,
+                   config=JobConfig(min_num_chips=1, max_num_chips=max_chips,
+                                    epochs=epochs))
+
+
+def _world(num_hosts=4, chips_per_host=4, rate_limit=5.0, registry=None,
+           queue_max=None, shed_watermark=None):
+    clock = VirtualClock(start=1753760000.0)
+    store = JobStore()
+    bus = EventBus(registry=registry, queue_max=queue_max,
+                   shed_watermark=shed_watermark)
+    backend = FakeClusterBackend(clock)
+    for i in range(num_hosts):
+        backend.add_host(f"host-{i}", chips_per_host, announce=False)
+    sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                      clock, bus=bus,
+                      placement_manager=PlacementManager("pool"),
+                      algorithm="ElasticFIFO",
+                      rate_limit_seconds=rate_limit)
+    admission = AdmissionService(store, bus, clock, registry=registry,
+                                 valid_pools={"pool"})
+    return clock, store, bus, backend, sched, admission
+
+
+# ---- 1. EventBus: bounded, batched, lock-safe -------------------------------
+
+
+class TestBoundedBus:
+    def test_queue_bound_drops_and_counts(self):
+        bus = EventBus(queue_max=3)
+        for i in range(5):
+            bus.publish("t", JobEvent(EventVerb.CREATE, f"j{i}"))
+        assert bus.pending("t") == 3
+        assert bus.dropped("t") == 2
+        assert bus.dropped() == 2
+        # FIFO survivors are the oldest three.
+        got = [bus.get("t", timeout=0).job_name for _ in range(3)]
+        assert got == ["j0", "j1", "j2"]
+
+    def test_drop_counter_lands_on_registry(self):
+        registry = Registry()
+        bus = EventBus(registry=registry, queue_max=1)
+        bus.publish_many("pool", [JobEvent(EventVerb.CREATE, f"j{i}")
+                                  for i in range(4)])
+        text = registry.exposition()
+        assert "voda_events_dropped_total" in text
+        assert 'voda_event_queue_depth{topic="pool"} 1' in text
+
+    def test_batch_subscriber_gets_backlog_as_one_call(self):
+        bus = EventBus()
+        for i in range(5):
+            bus.publish("t", JobEvent(EventVerb.CREATE, f"j{i}"))
+        calls = []
+        bus.subscribe("t", lambda batch: calls.append(list(batch)),
+                      batch=True)
+        assert len(calls) == 1
+        assert [e.job_name for e in calls[0]] == [f"j{i}" for i in range(5)]
+        assert bus.pending("t") == 0
+
+    def test_publish_many_is_one_delivery(self):
+        bus = EventBus()
+        calls = []
+        bus.subscribe("t", lambda batch: calls.append(list(batch)),
+                      batch=True)
+        bus.publish_many("t", [JobEvent(EventVerb.CREATE, f"j{i}")
+                               for i in range(100)])
+        assert len(calls) == 1 and len(calls[0]) == 100
+
+    def test_single_mode_subscriber_still_per_event(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe("t", seen.append)
+        bus.publish_many("t", [JobEvent(EventVerb.CREATE, "a"),
+                               JobEvent(EventVerb.DELETE, "b")])
+        assert [(e.verb, e.job_name) for e in seen] == [
+            (EventVerb.CREATE, "a"), (EventVerb.DELETE, "b")]
+
+    def test_raising_subscriber_cannot_wedge_the_lock(self):
+        """Delivery runs outside the bus lock: after a subscriber
+        exception, another thread can still take the lock and a later
+        publish still delivers."""
+        bus = EventBus()
+        state = {"raised": 0}
+        delivered = []
+
+        def flaky(event):
+            if not delivered:
+                state["raised"] += 1
+                raise RuntimeError("boom")
+            delivered.append(event)
+
+        bus.subscribe("t", flaky)
+        bus.publish("t", JobEvent(EventVerb.CREATE, "a"))  # contained
+        assert state["raised"] == 1
+
+        got = []
+
+        def try_lock():
+            ok = bus._lock.acquire(timeout=1.0)
+            got.append(ok)
+            if ok:
+                bus._lock.release()
+
+        t = threading.Thread(target=try_lock)
+        t.start()
+        t.join(timeout=5.0)
+        assert got == [True]
+
+        delivered.append("primed")
+        bus.publish("t", JobEvent(EventVerb.CREATE, "b"))
+        assert any(isinstance(e, JobEvent) and e.job_name == "b"
+                   for e in delivered)
+
+    def test_event_published_during_drain_is_not_stranded(self):
+        """A publisher that loses the drain race just enqueues; the
+        winning drainer loops and picks its event up before
+        returning."""
+        bus = EventBus()
+        entered = threading.Event()
+        proceed = threading.Event()
+        seen = []
+
+        def slow(event):
+            seen.append(event.job_name)
+            if event.job_name == "first":
+                entered.set()
+                proceed.wait(timeout=10.0)
+
+        bus.subscribe("t", slow)
+        t = threading.Thread(
+            target=lambda: bus.publish("t", JobEvent(EventVerb.CREATE,
+                                                     "first")))
+        t.start()
+        assert entered.wait(timeout=5.0)
+        # This publish sees the drain in flight and returns immediately.
+        t0 = time.monotonic()
+        bus.publish("t", JobEvent(EventVerb.CREATE, "second"))
+        assert time.monotonic() - t0 < 1.0
+        proceed.set()
+        t.join(timeout=5.0)
+        assert seen == ["first", "second"]
+
+    def test_saturated_watermark(self):
+        bus = EventBus(queue_max=10, shed_watermark=4)
+        assert not bus.saturated("t")
+        bus.publish_many("t", [JobEvent(EventVerb.CREATE, f"j{i}")
+                               for i in range(4)])
+        assert bus.saturated("t")
+
+    def test_all_or_nothing_publish_enqueues_nothing_on_overflow(self):
+        """The admission hand-off contract: a burst that cannot fit
+        WHOLE raises EventQueueFull with zero events enqueued — the
+        caller still owns every event (rollback stays possible); the
+        default best-effort mode keeps the fitting prefix."""
+        from vodascheduler_tpu.common.events import EventQueueFull
+        bus = EventBus(queue_max=5)
+        bus.publish_many("t", [JobEvent(EventVerb.CREATE, f"pre-{i}")
+                               for i in range(3)])
+        with pytest.raises(EventQueueFull) as exc:
+            bus.publish_many("t", [JobEvent(EventVerb.CREATE, f"j{i}")
+                                   for i in range(4)],
+                             all_or_nothing=True)
+        assert exc.value.free == 2
+        assert bus.pending("t") == 3  # nothing of the burst landed
+        assert bus.dropped("t") == 0
+        # A burst that fits goes through whole.
+        bus.publish_many("t", [JobEvent(EventVerb.CREATE, "fits")],
+                         all_or_nothing=True)
+        assert bus.pending("t") == 4
+
+    def test_multi_topic_all_or_nothing_publish(self):
+        """publish_many_multi loads EVERY topic's queue under one lock
+        hold: all bursts land (one batched delivery per topic), or an
+        overflow on ANY topic enqueues nothing anywhere — a cross-pool
+        admission batch must never deliver pool A's CREATEs and then
+        fail pool B's."""
+        from vodascheduler_tpu.common.events import EventQueueFull
+        bus = EventBus(queue_max=3)
+        calls = {"a": [], "b": []}
+        bus.subscribe("a", lambda batch: calls["a"].append(list(batch)),
+                      batch=True)
+        bus.subscribe("b", lambda batch: calls["b"].append(list(batch)),
+                      batch=True)
+        bus.publish_many_multi({
+            "a": [JobEvent(EventVerb.CREATE, "a1"),
+                  JobEvent(EventVerb.CREATE, "a2")],
+            "b": [JobEvent(EventVerb.CREATE, "b1")],
+        })
+        assert [len(c) for c in calls["a"]] == [2]
+        assert [len(c) for c in calls["b"]] == [1]
+        # Overflow on the SECOND topic: the first topic's subscriber
+        # must hear nothing from this batch.
+        bus2 = EventBus(queue_max=3)
+        heard = []
+        bus2.subscribe("a", lambda batch: heard.extend(batch), batch=True)
+        bus2.publish_many("b", [JobEvent(EventVerb.CREATE, f"fill-{i}")
+                                for i in range(3)])
+        with pytest.raises(EventQueueFull) as exc:
+            bus2.publish_many_multi({
+                "a": [JobEvent(EventVerb.CREATE, "ghost")],
+                "b": [JobEvent(EventVerb.CREATE, "wontfit")],
+            })
+        assert exc.value.topic == "b"
+        assert heard == []            # nothing delivered on topic a
+        assert bus2.pending("a") == 0  # nothing queued either
+        assert bus2.pending("b") == 3  # untouched
+        # Empty input is a no-op.
+        bus2.publish_many_multi({})
+        bus2.publish_many_multi({"a": []})
+        assert heard == []
+
+    def test_depth_probes_are_read_only(self):
+        """Admission probes saturated()/pending() with not-yet-validated
+        pool names; a probe must not mint a queue (and its per-topic
+        depth gauge) for every typo'd pool."""
+        registry = Registry()
+        bus = EventBus(registry=registry)
+        assert bus.pending("typo") == 0
+        assert not bus.saturated("typo")
+        assert bus.topics() == []
+        assert "typo" not in registry.exposition()
+
+    def test_drain_winner_captivity_is_bounded(self):
+        """Under a sustained storm the drain winner (somebody's HTTP
+        request thread) hands off to a daemon drainer after
+        _DRAIN_LOOPS_MAX rounds instead of delivering every other
+        publisher's events until the storm ends — nothing strands, but
+        one publisher's latency stays bounded."""
+        bus = EventBus()
+        delivered_on = []
+        count = [0]
+
+        def chaining(event):
+            delivered_on.append(threading.current_thread().name)
+            count[0] += 1
+            if count[0] < 30:
+                # Refill mid-delivery: without the cap the first caller
+                # would personally deliver all 30 rounds.
+                bus.publish("t", JobEvent(EventVerb.CREATE, f"c{count[0]}"))
+
+        bus.subscribe("t", chaining)
+        bus.publish("t", JobEvent(EventVerb.CREATE, "c0"))
+        deadline = time.monotonic() + 10.0
+        while count[0] < 30 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert count[0] == 30                      # nothing stranded
+        me = threading.current_thread().name
+        mine = sum(1 for name in delivered_on if name == me)
+        assert mine <= EventBus._DRAIN_LOOPS_MAX   # captivity bounded
+        assert any(name.startswith("voda-event-drain-")
+                   for name in delivered_on)       # daemon took over
+
+    def test_reentrant_publish_from_subscriber(self):
+        """A subscriber may itself publish (the scheduler's deferred
+        replay does); the drain loop delivers the follow-on event."""
+        bus = EventBus()
+        seen = []
+
+        def chaining(event):
+            seen.append(event.job_name)
+            if event.job_name == "a":
+                bus.publish("t", JobEvent(EventVerb.CREATE, "chained"))
+
+        bus.subscribe("t", chaining)
+        bus.publish("t", JobEvent(EventVerb.CREATE, "a"))
+        assert seen == ["a", "chained"]
+
+
+# ---- 2. Bulk admission: atomic, one commit, compensating deletes -----------
+
+
+class TestBulkAdmission:
+    def test_happy_path_one_store_write_one_publish(self):
+        clock = VirtualClock(start=1753760000.0)
+
+        class CountingStore(JobStore):
+            dirty_calls = 0
+
+            def _dirty(self):
+                super()._dirty()
+                CountingStore.dirty_calls += 1
+
+        store = CountingStore()
+        bus = EventBus()
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        before = CountingStore.dirty_calls
+        results = admission.create_training_jobs(
+            [_spec(f"bulk-{i}") for i in range(50)])
+        assert len(results) == 50
+        assert all("error" not in r for r in results)
+        # ONE store commit for the whole batch (insert_jobs)...
+        assert CountingStore.dirty_calls == before + 1
+        # ...and the whole burst queued on the (subscriber-less) bus.
+        assert bus.pending("pool") == 50
+        assert len(store.list_jobs()) == 50
+
+    def test_in_batch_name_collisions_deduplicated(self):
+        clock = VirtualClock(start=1753760000.0)
+        admission = AdmissionService(JobStore(), EventBus(), clock,
+                                     valid_pools={"pool"})
+        results = admission.create_training_jobs(
+            [_spec("same"), _spec("same"), _spec("same")])
+        names = [r["name"] for r in results]
+        assert len(set(names)) == 3
+
+    def test_concurrent_same_name_admissions_never_collide(self):
+        # The name-pick -> insert window is serialized
+        # (_name_claim_lock): racing same-second admissions of the same
+        # spec.name must each land a distinct job, never silently
+        # overwrite one another in the store.
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        admission = AdmissionService(store, EventBus(), clock,
+                                     valid_pools={"pool"})
+        barrier = threading.Barrier(8)
+        names: list = []
+        lock = threading.Lock()
+
+        def admit():
+            barrier.wait()
+            name = admission.create_training_job(_spec("racer"))
+            with lock:
+                names.append(name)
+
+        threads = [threading.Thread(target=admit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(names) == 8 and len(set(names)) == 8
+        assert len(store.list_jobs()) == 8
+
+    def test_invalid_spec_rejects_whole_batch_zero_residue(self):
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus()
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        version_before = store.version
+        results = admission.create_training_jobs(
+            [_spec("good-a"), _spec("bad", pool="nope"), _spec("good-b")])
+        assert "unknown pool 'nope'" in results[1]["error"]
+        assert results[0]["error"] == BATCH_SIBLING_REJECTED
+        assert results[2]["error"] == BATCH_SIBLING_REJECTED
+        # Zero residue: nothing stored, nothing published, no store
+        # write at all (validation precedes the commit).
+        assert store.list_jobs() == []
+        assert bus.pending("pool") == 0
+        assert store.version == version_before
+
+    def test_publish_failure_compensating_deletes_batch(self):
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus()
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+
+        def exploding(by_topic):
+            raise RuntimeError("broker down")
+
+        bus.publish_many_multi = exploding
+        with pytest.raises(RuntimeError, match="broker down"):
+            admission.create_training_jobs(
+                [_spec(f"doomed-{i}") for i in range(5)])
+        assert store.list_jobs() == []
+        # Zero residue includes the seeded JobInfo docs: a rolled-back
+        # job never ran, so its phantom info must not linger to feed a
+        # later admission's category seeding.
+        assert store._infos == {}
+        assert store._info_by_name == {}
+
+    def test_hook_failure_compensating_deletes_batch(self):
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus()
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        calls = []
+
+        def hook(name):
+            calls.append(name)
+            if len(calls) == 3:
+                raise ValueError("profile attach failed")
+
+        with pytest.raises(ValueError):
+            admission.create_training_jobs(
+                [_spec(f"hooked-{i}") for i in range(5)], on_admitted=hook)
+        assert store.list_jobs() == []
+        assert bus.pending("pool") == 0
+        assert store._infos == {}          # no phantom JobInfo residue
+        assert store._info_by_name == {}
+
+    def test_shed_past_watermark(self):
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        registry = Registry()
+        bus = EventBus(registry=registry, queue_max=100, shed_watermark=5)
+        admission = AdmissionService(store, bus, clock, registry=registry,
+                                     valid_pools={"pool"})
+        bus.publish_many("pool", [JobEvent(EventVerb.CREATE, f"old-{i}")
+                                  for i in range(5)])
+        with pytest.raises(AdmissionShed) as exc:
+            admission.create_training_jobs([_spec("refused")])
+        assert exc.value.pool == "pool"
+        assert exc.value.retry_after > 0
+        with pytest.raises(AdmissionShed):
+            admission.create_training_job(_spec("also-refused"))
+        assert admission.m_shed.value() == 2.0
+        assert store.list_jobs() == []
+
+    def test_burst_bigger_than_free_slots_sheds_with_zero_residue(self):
+        """A burst below the watermark but too big to fit whole under
+        the queue bound sheds up front (a partially-queued burst would
+        strand committed jobs the scheduler never hears about)."""
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus(queue_max=10, shed_watermark=9)
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        bus.publish_many("pool", [JobEvent(EventVerb.CREATE, f"old-{i}")
+                                  for i in range(5)])  # below watermark
+        with pytest.raises(AdmissionShed):
+            admission.create_training_jobs(
+                [_spec(f"big-{i}") for i in range(8)])  # 8 > 5 free
+        assert store.list_jobs() == []
+        assert bus.pending("pool") == 5  # untouched
+
+    def test_publish_race_to_full_queue_rolls_back_and_sheds(self):
+        """Belt over the pre-check's braces: if the queue fills between
+        the capacity check and the publish (another publisher racing),
+        the all-or-nothing publish fails, the batch compensating-deletes,
+        and the client sees the same 429-shaped backpressure."""
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus(queue_max=50, shed_watermark=49)
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        real_free = bus.free_slots
+
+        def racing_free(topic):
+            # The pre-check sees room; the racing publisher then fills
+            # the queue before our publish lands.
+            out = real_free(topic)
+            bus.publish_many("pool", [JobEvent(EventVerb.CREATE, f"r-{i}")
+                                      for i in range(50)])
+            return out
+
+        bus.free_slots = racing_free
+        with pytest.raises(AdmissionShed):
+            admission.create_training_jobs([_spec("raced")])
+        bus.free_slots = real_free
+        assert store.list_jobs() == []  # compensating delete fired
+        assert admission.m_shed.value() == 1.0
+
+    def test_cross_pool_batch_overflow_is_atomic(self):
+        """A batch spanning pools must be all-or-nothing ACROSS pools:
+        if pool b's queue cannot take its share, pool a's scheduler must
+        never hear the batch's CREATEs — otherwise the rollback deletes
+        store jobs a's scheduler already runs (ghost jobs), and the
+        client's retry admits the a-specs twice."""
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus(queue_max=10)
+        heard_on_a = []
+        bus.subscribe("a", lambda batch: heard_on_a.extend(batch),
+                      batch=True)
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"a", "b"})
+        # Fill pool b past capacity while blinding the pre-check, so the
+        # overflow is detected at the publish itself (the racing-
+        # publisher shape).
+        for i in range(10):
+            bus.publish("b", JobEvent(EventVerb.CREATE, f"fill-{i}"))
+        bus.saturated = lambda topic: False
+        bus.free_slots = lambda topic: 10
+        with pytest.raises(AdmissionShed) as exc:
+            admission.create_training_jobs(
+                [_spec("span-a", pool="a"), _spec("span-b", pool="b")])
+        assert exc.value.pool == "b"
+        assert store.list_jobs() == []   # rollback, nothing admitted
+        assert heard_on_a == []          # pool a heard NOTHING
+        assert bus.pending("a") == 0
+        assert bus.pending("b") == 10    # untouched
+
+    def test_delete_on_full_queue_sheds_not_silent(self):
+        """A DELETE dropped at the bound would answer 200 while the
+        scheduler keeps the job running forever — it must shed
+        instead."""
+        clock = VirtualClock(start=1753760000.0)
+        store = JobStore()
+        bus = EventBus(queue_max=4, shed_watermark=4)
+        admission = AdmissionService(store, bus, clock,
+                                     valid_pools={"pool"})
+        results = admission.create_training_jobs([_spec("victim")])
+        name = results[0]["name"]
+        bus.publish_many("pool", [JobEvent(EventVerb.CREATE, f"fill-{i}")
+                                  for i in range(3)])
+        assert bus.free_slots("pool") == 0
+        with pytest.raises(AdmissionShed):
+            admission.delete_training_job(name)
+        assert store.get_job(name) is not None  # nothing half-done
+
+    def test_ingest_stats_shape(self):
+        clock, store, bus, backend, sched, admission = _world()
+        admission.create_training_job(_spec("one"))
+        admission.create_training_jobs([_spec(f"b-{i}") for i in range(8)])
+        stats = admission.ingest_stats()
+        assert stats["admitted_total"] == 9.0
+        assert stats["shed_total"] == 0.0
+        assert stats["queue_depth"] == {"pool": 0}
+        assert stats["recent_admit_ms"]["count"] == 1
+        assert stats["recent_admit_ms"]["p99"] >= 0.0
+        assert stats["last_burst"]["size"] == 8
+        assert stats["last_burst"]["admitted"] == 8
+        assert stats["last_burst"]["per_item_ms"] >= 0.0
+        sched.stop()
+
+
+# ---- 3. Store: bulk ops -----------------------------------------------------
+
+
+class TestStoreBulkOps:
+    def test_bulk_delete_one_write(self):
+        class CountingStore(JobStore):
+            def __init__(self):
+                super().__init__()
+                self.dirty_calls = 0
+
+            def _dirty(self):
+                super()._dirty()
+                self.dirty_calls += 1
+
+        store = CountingStore()
+        clock = VirtualClock(start=1753760000.0)
+        admission = AdmissionService(store, EventBus(), clock,
+                                     valid_pools={"pool"})
+        results = admission.create_training_jobs(
+            [_spec(f"d-{i}") for i in range(10)])
+        before = store.dirty_calls
+        store.delete_jobs([r["name"] for r in results])
+        assert store.dirty_calls == before + 1
+        assert store.list_jobs() == []
+
+    def test_version_stamp_moves_on_every_write(self):
+        store = JobStore()
+        v0 = store.version
+        clock = VirtualClock(start=1753760000.0)
+        admission = AdmissionService(store, EventBus(), clock,
+                                     valid_pools={"pool"})
+        admission.create_training_jobs([_spec("v-a"), _spec("v-b")])
+        v1 = store.version
+        assert v1 > v0
+        job = store.list_jobs()[0]
+        store.update_job(job)
+        assert store.version > v1
+
+    def test_file_store_batch_insert_round_trips(self, tmp_path):
+        from vodascheduler_tpu.common.store import FileJobStore
+        path = str(tmp_path / "state.json")
+        store = FileJobStore(path)
+        clock = VirtualClock(start=1753760000.0)
+        admission = AdmissionService(store, EventBus(), clock,
+                                     valid_pools={"pool"})
+        admission.create_training_jobs([_spec(f"f-{i}") for i in range(6)])
+        reloaded = FileJobStore(path)
+        assert len(reloaded.list_jobs()) == 6
+
+
+# ---- 4. Storm coalescing + the snapshot cache -------------------------------
+
+
+class TestStormCoalescing:
+    def test_1k_event_storm_bounded_passes(self):
+        """ISSUE 9 acceptance: >= 1k CREATE events coalesce into a
+        bounded number of resched passes — the batch drain applies the
+        whole burst as ONE subscriber call, and the deduplicated
+        triggers land in one rate-limit window."""
+        clock, store, bus, backend, sched, admission = _world(
+            num_hosts=16, chips_per_host=8)
+        batch_calls = []
+
+        def counting(events):
+            batch_calls.append(len(events))
+            sched._on_job_events(events)
+
+        # Replace the scheduler's bus subscription with a counting
+        # wrapper (the bus holds the bound method captured at subscribe
+        # time).
+        bus.subscribe("pool", counting, batch=True)
+
+        results = admission.create_training_jobs(
+            [_spec(f"storm-{i:04d}", max_chips=2) for i in range(1000)])
+        assert all("error" not in r for r in results)
+        # One drained burst, one batch call.
+        assert batch_calls == [1000]
+        assert bus.pending("pool") == 0
+
+        # Let the coalesced pass(es) and their retriggers settle.
+        for _ in range(6):
+            clock.advance(7.0)
+        passes = len(sched.profile_records(0))
+        assert 1 <= passes <= 4, passes
+        assert len(sched.ready_jobs) == 1000
+        sched.stop()
+
+
+class TestSnapshotCache:
+    def test_cached_bytes_reused_until_state_changes(self):
+        clock, store, bus, backend, sched, admission = _world()
+        admission.create_training_job(_spec("cache-a"))
+        clock.advance(12.0)
+        first = sched.status_table_json()
+        assert first is sched.status_table_json()  # same object: cache hit
+        assert json.loads(first.decode())
+        admission.create_training_job(_spec("cache-b"))
+        clock.advance(12.0)
+        second = sched.status_table_json()
+        assert second is not first
+        names = {r["name"] for r in json.loads(second.decode())}
+        assert any(n.startswith("cache-b") for n in names)
+        sched.stop()
+
+    def test_reads_served_from_snapshot_while_pass_in_flight(self):
+        """ISSUE 9 acceptance: a REST read arriving while a pass holds
+        the scheduler lock serves the last committed snapshot instead
+        of waiting out the decide phase."""
+        clock, store, bus, backend, sched, admission = _world(
+            rate_limit=0.0)
+        admission.create_training_job(_spec("seed"))
+        rows_before = sched.status_table()  # warm the cache
+        assert any(r["name"].startswith("seed") for r in rows_before)
+
+        entered = threading.Event()
+        release = threading.Event()
+        pm = sched.placement_manager
+        orig_place = pm.place
+
+        def blocking_place(requests):
+            entered.set()
+            release.wait(timeout=30.0)
+            return orig_place(requests)
+
+        pm.place = blocking_place
+        t = threading.Thread(
+            target=lambda: admission.create_training_job(_spec("during")),
+            daemon=True)
+        t.start()
+        try:
+            assert entered.wait(timeout=10.0)
+            # The pass (triggered by the admission above, running on its
+            # thread) holds the lock inside placement. Reads stay live
+            # AND cheap: last committed snapshot, no waiting.
+            t0 = time.monotonic()
+            rows = sched.status_table()
+            data = sched.status_table_json()
+            took = time.monotonic() - t0
+            assert took < 1.0, f"read blocked {took:.3f}s on the pass"
+            assert data is sched.status_table_json()
+            # Snapshot isolation: the mid-pass mutation ("during"'s
+            # create) is not visible yet.
+            assert not any(r["name"].startswith("during") for r in rows)
+        finally:
+            release.set()
+            t.join(timeout=30.0)
+            pm.place = orig_place
+        clock.advance(1.0)
+        rows_after = sched.status_table()
+        assert any(r["name"].startswith("during") for r in rows_after)
+        sched.stop()
+
+
+# ---- 5. REST: batch route, 429, cached reads, debug/ingest ------------------
+
+
+class _Service:
+    def __init__(self, queue_max=None, shed_watermark=None):
+        self.clock = VirtualClock(start=1753760000.0)
+        self.store = JobStore()
+        self.registry = Registry()
+        self.bus = EventBus(registry=self.registry, queue_max=queue_max,
+                            shed_watermark=shed_watermark)
+        self.admission = AdmissionService(self.store, self.bus, self.clock,
+                                          registry=self.registry,
+                                          valid_pools={"pool"})
+        self.server = make_service_server(self.admission, self.registry,
+                                          host="127.0.0.1", port=0)
+        self.server.start()
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def stop(self):
+        self.server.stop()
+
+
+def _post(url, payload, expect_error=False):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return json.loads(r.read())
+
+
+class TestRestIngestion:
+    @pytest.fixture()
+    def svc(self):
+        svc = _Service()
+        yield svc
+        svc.stop()
+
+    def test_batch_route_happy_path(self, svc):
+        specs = [{"name": f"rb-{i}", "pool": "pool",
+                  "config": {"min_num_chips": 1, "max_num_chips": 2}}
+                 for i in range(5)]
+        status, body, _ = _post(f"{svc.url}/training/batch",
+                                {"specs": specs})
+        assert status == 200
+        assert body["admitted"] == 5
+        assert all("error" not in r for r in body["results"])
+        assert len(svc.store.list_jobs()) == 5
+
+    def test_batch_route_bare_list_accepted(self, svc):
+        specs = [{"name": "rl-0", "pool": "pool"}]
+        status, body, _ = _post(f"{svc.url}/training/batch", specs)
+        assert status == 200 and body["admitted"] == 1
+
+    def test_batch_route_partial_failure_atomic(self, svc):
+        specs = [{"name": "ok-0", "pool": "pool"},
+                 {"name": "bad", "pool": "typo"},
+                 {"name": "ok-1", "pool": "pool"}]
+        status, body, _ = _post(f"{svc.url}/training/batch",
+                                {"specs": specs}, expect_error=True)
+        assert status == 400
+        assert body["admitted"] == 0
+        assert "unknown pool" in body["results"][1]["error"]
+        assert body["results"][0]["error"] == BATCH_SIBLING_REJECTED
+        assert svc.store.list_jobs() == []
+        assert svc.bus.pending("pool") == 0
+
+    def test_batch_route_malformed_spec_atomic(self, svc):
+        specs = [{"name": "ok-0", "pool": "pool"},
+                 {"name": "bad", "no_such_field": True}]
+        status, body, _ = _post(f"{svc.url}/training/batch",
+                                {"specs": specs}, expect_error=True)
+        assert status == 400 and body["admitted"] == 0
+        assert svc.store.list_jobs() == []
+
+    def test_429_with_retry_after(self):
+        svc = _Service(queue_max=100, shed_watermark=3)
+        try:
+            svc.bus.publish_many(
+                "pool", [JobEvent(EventVerb.CREATE, f"old-{i}")
+                         for i in range(3)])
+            status, body, headers = _post(
+                f"{svc.url}/training",
+                {"name": "refused", "pool": "pool"}, expect_error=True)
+            assert status == 429
+            assert "Retry-After" in headers
+            assert int(headers["Retry-After"]) >= 1
+            assert body["retry_after_seconds"] > 0
+            status, body, _ = _post(
+                f"{svc.url}/training/batch",
+                {"specs": [{"name": "refused-2", "pool": "pool"}]},
+                expect_error=True)
+            assert status == 429
+            with urllib.request.urlopen(f"{svc.url}/metrics",
+                                        timeout=10.0) as r:
+                text = r.read().decode()
+            assert "voda_admission_shed_total 2" in text
+        finally:
+            svc.stop()
+
+    def test_get_training_served_from_version_cache(self, svc):
+        _post(f"{svc.url}/training/batch",
+              {"specs": [{"name": "gv-0", "pool": "pool"}]})
+        one = _get(f"{svc.url}/training")
+        again = _get(f"{svc.url}/training")
+        assert one == again and len(one) == 1
+        _post(f"{svc.url}/training", {"name": "gv-1", "pool": "pool"})
+        fresh = _get(f"{svc.url}/training")
+        assert len(fresh) == 2  # the version bump invalidated the cache
+
+    def test_debug_ingest_route(self, svc):
+        _post(f"{svc.url}/training/batch",
+              {"specs": [{"name": "di-0", "pool": "pool"},
+                         {"name": "di-1", "pool": "pool"}]})
+        stats = _get(f"{svc.url}/debug/ingest")
+        assert stats["admitted_total"] == 2.0
+        assert stats["last_burst"]["size"] == 2
+        assert "queue_depth" in stats and "recent_admit_ms" in stats
+
+    def test_server_thread_hygiene(self, svc):
+        """Satellite: daemon handler threads + a socket read timeout, so
+        a stalled client can neither pin shutdown nor leak a thread
+        forever."""
+        assert svc.server.httpd.daemon_threads is True
+        assert svc.server.httpd.RequestHandlerClass.timeout == 30.0
+
+
+class TestMetricsCache:
+    def test_ttl_zero_always_fresh(self):
+        registry = Registry()
+        c = registry.counter("voda_test_series_total", "t")
+        route = _metrics_route(registry, cache_seconds=0)
+        _, first = route(b"", {})
+        c.inc()
+        _, second = route(b"", {})
+        assert isinstance(first, Raw) and isinstance(second, Raw)
+        assert first.data != second.data
+
+    def test_ttl_shares_one_rebuild(self):
+        registry = Registry()
+        c = registry.counter("voda_test_series_total", "t")
+        route = _metrics_route(registry, cache_seconds=60.0)
+        _, first = route(b"", {})
+        c.inc()
+        _, second = route(b"", {})
+        assert second.data == first.data  # inside the TTL window
+
+
+# ---- 6. CLI round-trip ------------------------------------------------------
+
+
+class TestCliBatch:
+    def _write_specs(self, tmp_path, specs):
+        import yaml
+        path = tmp_path / "specs.yaml"
+        path.write_text("---\n".join(yaml.safe_dump(s) for s in specs))
+        return str(path)
+
+    def test_multi_doc_create_bulk_success(self, tmp_path, capsys):
+        from vodascheduler_tpu import cli
+        svc = _Service()
+        try:
+            path = self._write_specs(tmp_path, [
+                {"name": "cli-a", "pool": "pool"},
+                {"name": "cli-b", "pool": "pool"},
+            ])
+            rc = cli.main(["--server", svc.url, "create", "-f", path])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert out.count("job created: cli-") == 2
+            assert len(svc.store.list_jobs()) == 2
+        finally:
+            svc.stop()
+
+    def test_per_item_errors_round_trip(self, tmp_path, capsys):
+        """Satellite: per-item error bodies from a rejected batch render
+        through the CLI — the operator sees WHICH spec sank the batch
+        and that nothing was admitted."""
+        from vodascheduler_tpu import cli
+        svc = _Service()
+        try:
+            path = self._write_specs(tmp_path, [
+                {"name": "cli-ok", "pool": "pool"},
+                {"name": "cli-bad", "pool": "typo"},
+            ])
+            with pytest.raises(SystemExit) as exc:
+                cli.main(["--server", svc.url, "create", "-f", path])
+            assert exc.value.code == 1
+            out = capsys.readouterr().out
+            assert "unknown pool 'typo'" in out
+            assert BATCH_SIBLING_REJECTED in out
+            assert svc.store.list_jobs() == []
+        finally:
+            svc.stop()
+
+    def test_batch_500_prints_error_not_mute(self, tmp_path, capsys):
+        """A failure shape without per-item bodies (e.g. a 500) still
+        reports WHAT failed — a bare exit 1 would leave the operator
+        blind."""
+        from vodascheduler_tpu import cli
+        from vodascheduler_tpu.service.rest import RestServer
+
+        def exploding(body, query):
+            raise RuntimeError("store on fire")
+
+        server = RestServer({("POST", "/training/batch"): exploding},
+                            host="127.0.0.1", port=0)
+        server.start()
+        try:
+            path = self._write_specs(tmp_path, [
+                {"name": "a", "pool": "pool"},
+                {"name": "b", "pool": "pool"},
+            ])
+            with pytest.raises(SystemExit) as exc:
+                cli.main(["--server",
+                          f"http://127.0.0.1:{server.port}",
+                          "create", "-f", path])
+            assert "500" in str(exc.value)
+            assert "store on fire" in str(exc.value)
+        finally:
+            server.stop()
+
+    def test_yaml_native_scalars_reach_the_server(self, tmp_path, capsys):
+        """YAML parses bare dates to datetime.date, which json.dumps
+        rejects — the CLI must stringify and let the server's spec
+        validation answer (clean per-item 400), not die on a local
+        TypeError before any request is sent."""
+        from vodascheduler_tpu import cli
+        svc = _Service()
+        try:
+            path = self._write_specs(tmp_path, [
+                {"name": "dated", "pool": "pool", "deadline": "2026-08-03"},
+                {"name": "plain", "pool": "pool"},
+            ])
+            # Rewrite the quoted date as a bare YAML scalar so safe_load
+            # yields a datetime.date.
+            text = open(path).read().replace("'2026-08-03'", "2026-08-03")
+            open(path, "w").write(text)
+            with pytest.raises(SystemExit) as exc:
+                cli.main(["--server", svc.url, "create", "-f", path])
+            assert exc.value.code == 1
+            out = capsys.readouterr().out
+            assert "deadline" in out      # the server judged the spec
+            assert svc.store.list_jobs() == []
+        finally:
+            svc.stop()
+
+    def test_batch_non_json_200_keeps_tuple_contract(self, tmp_path,
+                                                     capsys):
+        """A 2xx with a non-JSON body (e.g. a proxy answering
+        text/plain) must not crash the (status, body) unpack — the CLI
+        reports the unexpected body instead of a ValueError."""
+        from vodascheduler_tpu import cli
+        from vodascheduler_tpu.service.rest import Raw, RestServer
+
+        server = RestServer(
+            {("POST", "/training/batch"):
+                 lambda body, query: (200, Raw("text/plain", b"OK"))},
+            host="127.0.0.1", port=0)
+        server.start()
+        try:
+            path = self._write_specs(tmp_path, [
+                {"name": "a", "pool": "pool"},
+                {"name": "b", "pool": "pool"},
+            ])
+            rc = cli.main(["--server",
+                           f"http://127.0.0.1:{server.port}",
+                           "create", "-f", path])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "warning: no per-item results" in out
+            assert "OK" in out
+        finally:
+            server.stop()
+
+    def test_top_renders_ingestion_section(self, capsys):
+        from vodascheduler_tpu import cli
+        cli._print_top([], k=5, ingest={
+            "admitted_total": 12.0, "shed_total": 3.0,
+            "events_dropped_total": 0.0,
+            "queue_depth": {"pool": 7},
+            "recent_admit_ms": {"count": 12, "p50": 0.1, "p99": 1.5},
+            "last_burst": {"size": 10, "admitted": 10, "total_ms": 4.0,
+                           "per_item_ms": 0.4, "ts": 0.0},
+        })
+        out = capsys.readouterr().out
+        assert "ingestion plane:" in out
+        assert "shed=3" in out
+        assert "queue_depth[pool=7]" in out
+        assert "p99=1.500ms" in out
+        assert "10/10 admitted" in out
+
+
+# ---- 7. The ingestion gate has teeth ---------------------------------------
+
+
+class TestIngestionGate:
+    def _mini_baseline(self, tmp_path):
+        base = perf_scale.run_suite(ns=(60,), passes=2, seed=7,
+                                    verbose=False)
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps(base))
+        return path, base
+
+    def test_injected_admission_slowdown_fails(self, tmp_path, capsys):
+        path, base = self._mini_baseline(tmp_path)
+        rc = perf_scale.main(["--check", str(path), "--ns", "60",
+                              "--seed", "7",
+                              "--inject-admission-ms", "30",
+                              "--fresh-out", str(tmp_path / "f.json")])
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "ingest_bulk_p99" in out
+        assert "REGRESSED" in out
+
+    def test_committed_baseline_ingestion_columns(self):
+        """The committed artifact pins the tentpole numbers: schema 3,
+        ingestion points for every N, a 10k bulk admission per-item p99
+        in single-digit milliseconds, every storm coalescing into a
+        handful of passes, and ~free cached reads."""
+        with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
+            base = json.load(f)
+        assert base["schema"] == 3
+        points = {p["n_jobs"]: p for p in base["ingestion"]}
+        assert set(points) == {100, 1000, 10000}
+        for p in points.values():
+            agg = p["bulk_admit_per_item_ms"]
+            assert 0 < agg["p50"] <= agg["p95"] <= agg["p99"] <= agg["max"]
+            assert p["storm"]["events"] >= p["n_jobs"]
+            assert p["storm"]["passes_to_quiescent"] <= 3
+            assert p["single_admit_ms"]["p99"] > 0
+        big = points[10000]
+        assert big["bulk_admit_per_item_ms"]["p99"] < 5.0
+        assert big["single_admit_ms"]["p99"] < 20.0
+        assert big["read_cached_ms"]["p99"] < 1.0
+
+    def test_run_ingestion_point_small_n(self):
+        point = perf_scale.run_ingestion_point(60, seed=7)
+        assert point["n_jobs"] == 60
+        assert point["bursts"] >= 1
+        assert point["bulk_admit_per_item_ms"]["p99"] > 0
+        assert point["single_admit_ms"]["p99"] > 0
+        assert point["storm"]["passes_to_quiescent"] >= 1
+        assert point["storm"]["to_quiescent_ms"] > 0
+
+
+# ---- 8. Slow tier: the live 1k-burst admission p99 --------------------------
+
+
+@pytest.mark.slow
+class TestLiveBurstP99:
+    def test_1k_burst_p99_under_20ms_with_pass_in_flight(self):
+        """ISSUE 9 acceptance, measured live: a 1k-job burst admits with
+        per-request p99 < 20 ms WHILE a resched pass holds the scheduler
+        busy. The property under test is the decoupling: admission is
+        validate + store commit + enqueue — the in-flight pass's thread
+        owns the drain (it entered via its own trigger's delivery), so
+        a burst request never waits out the scheduler lock. Before this
+        plane, every event was delivered synchronously into the
+        scheduler on the publisher's thread, so each of these requests
+        would have blocked for the remainder of the pass.
+
+        One sequential client: the bound measures the ingestion path,
+        not this container's GIL/CPU scheduling jitter (an 8-thread
+        convoy on a noisy box swings p99 by 10x run-to-run; the per-
+        request cost it jitters around is the same ~0.1 ms)."""
+        clock, store, bus, backend, sched, admission = _world(
+            num_hosts=16, chips_per_host=8, rate_limit=0.0)
+        for i in range(4):
+            admission.create_training_job(_spec(f"seed-{i}"))
+
+        entered = threading.Event()
+        release = threading.Event()
+        pm = sched.placement_manager
+        orig_place = pm.place
+
+        def blocking_place(requests):
+            if not release.is_set():
+                entered.set()
+                release.wait(timeout=120.0)
+            return orig_place(requests)
+
+        pm.place = blocking_place
+        trigger = threading.Thread(
+            target=lambda: admission.create_training_job(_spec("blocker")),
+            daemon=True)
+        trigger.start()
+        assert entered.wait(timeout=30.0)
+
+        latencies = []
+        try:
+            for i in range(1000):
+                t0 = time.monotonic()
+                admission.create_training_job(
+                    _spec(f"burst-{i:04d}", max_chips=2))
+                latencies.append((time.monotonic() - t0) * 1000.0)
+        finally:
+            release.set()
+            trigger.join(timeout=60.0)
+            pm.place = orig_place
+
+        assert len(latencies) == 1000
+        ordered = sorted(latencies)
+        p99 = ordered[989]
+        assert p99 < 20.0, (
+            f"admission p99 {p99:.3f}ms with a pass in flight "
+            f"(p50 {ordered[499]:.3f}ms max {ordered[-1]:.3f}ms)")
+        # The burst accumulated on the bus while the pass ran — the
+        # pass's drain loop applies it afterwards; nothing is lost.
+        for _ in range(8):
+            clock.advance(7.0)
+            if len(sched.ready_jobs) + len(sched.done_jobs) >= 1005:
+                break
+        assert len(sched.ready_jobs) + len(sched.done_jobs) >= 1005
+        assert bus.pending("pool") == 0
+        sched.stop()

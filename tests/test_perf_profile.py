@@ -124,7 +124,7 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 2
+        assert base["schema"] == 3
         assert base["tool"] == "scripts/perf_scale.py"
         assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}

@@ -29,22 +29,35 @@ from vodascheduler_tpu import config
 
 
 def _request(url: str, method: str = "GET", body: Optional[bytes] = None,
-             content_type: str = "application/json"):
+             content_type: str = "application/json",
+             return_error: bool = False):
+    """GET/POST JSON. With return_error=True an HTTP error returns
+    (status_code, parsed_body) instead of exiting — the batch-create
+    path renders per-item error bodies from a 400/429 response."""
     req = urllib.request.Request(url, data=body, method=method,
                                  headers={"Content-Type": content_type})
     try:
         with urllib.request.urlopen(req, timeout=30.0) as resp:
+            status = resp.status
             data = resp.read().decode()
     except urllib.error.HTTPError as e:
         detail = e.read().decode(errors="replace")
+        if return_error:
+            try:
+                return e.code, json.loads(detail)
+            except json.JSONDecodeError:
+                return e.code, {"error": detail.strip()}
         raise SystemExit(f"error: {e.code} {detail.strip()}")
     except urllib.error.URLError as e:
         raise SystemExit(f"error: cannot reach {url}: {e.reason} "
                          "(is the server running? python -m vodascheduler_tpu.service)")
     try:
-        return json.loads(data)
+        parsed = json.loads(data)
     except json.JSONDecodeError:
-        return data
+        # Non-JSON body on a 2xx (e.g. a proxy answering text/plain):
+        # return_error callers still get their (status, dict) shape.
+        return (status, {"error": data.strip()}) if return_error else data
+    return (status, parsed) if return_error else parsed
 
 
 def _print_table(rows, columns) -> None:
@@ -116,9 +129,48 @@ def main(argv=None) -> int:
     if args.command == "create":
         with open(args.filename, "rb") as f:
             body = f.read()
-        out = _request(f"{args.server}/training", "POST", body,
-                       content_type="application/yaml")
-        print(f"job created: {out['name']}")
+        import yaml as _yaml
+        docs = [d for d in _yaml.safe_load_all(body) if d is not None]
+        # A document may itself be a list of specs; flatten so a list
+        # doc followed by further docs loses nothing.
+        specs = [s for d in docs for s in (d if isinstance(d, list) else [d])]
+        many = len(specs) > 1 or any(isinstance(d, list) for d in docs)
+        if many:
+            # Multi-doc (or list) spec file -> one atomic bulk admission
+            # (POST /training/batch): per-item outcomes, nothing
+            # admitted on a 400/429.
+            # default=str: YAML parses bare dates/timestamps to native
+            # objects json can't encode — stringify and let the server's
+            # spec validation judge them (same outcome the raw-YAML
+            # single-doc path gets).
+            status, out = _request(
+                f"{args.server}/training/batch", "POST",
+                json.dumps({"specs": specs}, default=str).encode(),
+                return_error=True)
+            if status == 429:
+                raise SystemExit(
+                    f"error: 429 {out.get('error', 'admission shed')} "
+                    "(backpressure engaged; retry later)")
+            results = out.get("results", [])
+            for res in results:
+                if "error" in res:
+                    print(f"error: {res.get('name', '?')}: {res['error']}")
+                else:
+                    print(f"job created: {res['name']}")
+            if status == 200 and not results:
+                print("warning: no per-item results in response: "
+                      f"{out.get('error', out)}")
+            if status != 200:
+                if not results:
+                    # A failure shape without per-item bodies (e.g. a
+                    # 500): still say what happened, never exit mute.
+                    raise SystemExit(
+                        f"error: {status} {out.get('error', out)}")
+                raise SystemExit(1)
+        else:
+            out = _request(f"{args.server}/training", "POST", body,
+                           content_type="application/yaml")
+            print(f"job created: {out['name']}")
     elif args.command == "delete":
         from urllib.parse import quote
         out = _request(f"{args.server}/training?name={quote(args.name, safe='')}",
@@ -150,20 +202,23 @@ def main(argv=None) -> int:
         if args.pool:
             q += f"&pool={_q(args.pool, safe='')}"
         records = _request(f"{args.scheduler_server}/debug/profile{q}")
-        _print_top(records, k=args.k)
+        # Ingestion-plane stats ride the service port; best-effort so
+        # `voda top` against a scheduler-only deployment still renders
+        # the profile.
+        try:
+            ingest = _request(f"{args.server}/debug/ingest")
+        except SystemExit:
+            ingest = None
+        _print_top(records, k=args.k, ingest=ingest)
     return 0
 
 
 def _pctl(values, fraction: float) -> float:
-    """Nearest-rank percentile over a small sample (no interpolation —
-    `voda top` reads tens of passes, not millions): ordered[ceil(p*n)-1],
-    so p95 over 20 passes is the 19th value, not the maximum."""
-    import math
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    rank = max(1, math.ceil(fraction * len(ordered)))
-    return ordered[min(len(ordered), rank) - 1]
+    """Nearest-rank percentile over a small sample — the one shared
+    implementation (common/metrics.py), which also fixes the float-ceil
+    fuzz this helper used to carry (ceil(0.95 * 20) == 20)."""
+    from vodascheduler_tpu.common.metrics import nearest_rank_percentile
+    return nearest_rank_percentile(values, fraction)
 
 
 def _dominant_phase(rec: dict):
@@ -175,10 +230,36 @@ def _dominant_phase(rec: dict):
     return name, phases[name].get("wall_ms", 0.0)
 
 
-def _print_top(records: list, k: int = 5) -> None:
+def _print_ingest(ingest: dict) -> None:
+    """Ingestion-plane lines for `voda top` (GET /debug/ingest): how an
+    operator sees backpressure engage — shed count climbing, queue depth
+    at the watermark, admission tails stretching."""
+    recent = ingest.get("recent_admit_ms") or {}
+    depth = ingest.get("queue_depth") or {}
+    depth_s = " ".join(f"{t}={n}" for t, n in sorted(depth.items())) or "-"
+    print("ingestion plane:")
+    print(f"  admitted={ingest.get('admitted_total', 0):.0f} "
+          f"shed={ingest.get('shed_total', 0):.0f} "
+          f"events_dropped={ingest.get('events_dropped_total', 0):.0f} "
+          f"queue_depth[{depth_s}]")
+    print(f"  admit latency (last {recent.get('count', 0)} requests): "
+          f"p50={recent.get('p50', 0.0):.3f}ms "
+          f"p99={recent.get('p99', 0.0):.3f}ms")
+    burst = ingest.get("last_burst")
+    if burst:
+        print(f"  last burst: {burst.get('admitted', 0)}/"
+              f"{burst.get('size', 0)} admitted in "
+              f"{burst.get('total_ms', 0.0):.3f}ms "
+              f"({burst.get('per_item_ms', 0.0):.4f}ms/job)")
+
+
+def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None
+               ) -> None:
     """Human rendering of /debug/profile: per-phase p50/p95 over the
     window, then the slowest passes with their dominant phase and the
     jobs whose deltas triggered them."""
+    if ingest:
+        _print_ingest(ingest)
     if not records:
         print("no profiled passes yet (ring empty; run or trigger a "
               "resched first)")

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import bisect
 import contextlib
+import fractions
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -335,3 +336,20 @@ class Registry:
             lines.extend(headers[name])
             lines.extend(samples[name])
         return "\n".join(lines) + "\n"
+
+
+def nearest_rank_percentile(values, fraction: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation): the
+    smallest sample at or above rank ceil(fraction * n). Rank arithmetic
+    is exact-rational over the fraction's decimal literal, so p95 over
+    20 samples is the 19th value — float ceil(0.95 * 20) lands on 20
+    via 19.000000000000004 — and sub-percent quantiles (p99.9) keep
+    their precision instead of rounding to p100. One implementation for
+    every consumer (`voda top`, ingest_stats, scripts/perf_scale.py)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    frac = fractions.Fraction(str(fraction))
+    rank = -((-frac.numerator * len(ordered)) // frac.denominator)
+    rank = min(len(ordered), max(1, rank))
+    return ordered[rank - 1]

@@ -32,6 +32,10 @@ class JobStore:
         self._lock = threading.RLock()
         self._jobs: Dict[str, TrainingJob] = {}       # by job name
         self._infos: Dict[str, Dict[str, JobInfo]] = {}  # category -> job name -> info
+        # Monotonic mutation stamp: bumped by _dirty() on every write.
+        # Read-path caches (the service's GET /training snapshot) compare
+        # against it to serve unchanged fleets without a rebuild.
+        self._version = 0
         # Flat name -> info index for the allocator's batched per-pass
         # lookup. Only docs whose stored category matches
         # category_of(name) are indexed, so a hit here is exactly what
@@ -57,6 +61,45 @@ class JobStore:
     def delete_job(self, name: str) -> None:
         with self._lock:
             self._jobs.pop(name, None)
+            self._dirty()
+
+    def insert_jobs(self, jobs: List[TrainingJob],
+                    infos: List[JobInfo] = ()) -> None:
+        """Bulk insert for batch admission: the whole batch commits under
+        ONE lock acquisition and ONE persistence write (`_dirty` fires
+        once — on a FileJobStore that is one atomic file rewrite instead
+        of N), the `autoflush=False` batch-boundary idiom applied to the
+        always-flushing default store."""
+        with self._lock:
+            for info in infos:
+                self._infos.setdefault(info.category, {})[info.name] = info
+                if category_of(info.name) == info.category:
+                    self._info_by_name[info.name] = info
+            for job in jobs:
+                self._jobs[job.name] = job
+            self._dirty()
+
+    def delete_jobs(self, names: List[str],
+                    with_infos: bool = False) -> None:
+        """Bulk delete — the batch path's compensating rollback (one lock
+        acquisition, one write), mirroring the reference's
+        publish-failure delete (handlers.go:124-131). With
+        `with_infos=True` the jobs' JobInfo docs go too: a rolled-back
+        job never ran, so its seeded info is a phantom — left behind it
+        would feed `find_category_info()` and grow the store by N docs
+        per failed batch. Normal deletes keep infos (learned curves
+        outlive the run by design)."""
+        with self._lock:
+            for name in names:
+                self._jobs.pop(name, None)
+                if with_infos:
+                    self._info_by_name.pop(name, None)
+                    category = category_of(name)
+                    docs = self._infos.get(category)
+                    if docs is not None:
+                        docs.pop(name, None)
+                        if not docs:
+                            self._infos.pop(category, None)
             self._dirty()
 
     def list_jobs(self, pool: Optional[str] = None) -> List[TrainingJob]:
@@ -116,8 +159,15 @@ class JobStore:
                 out[job.name] = info
         return out
 
-    def _dirty(self) -> None:  # persistence hook
-        pass
+    @property
+    def version(self) -> int:
+        """The current mutation stamp (see __init__); reading it is
+        lock-free (int loads are atomic) — a racing write just makes the
+        caller's cache comparison fail and rebuild."""
+        return self._version
+
+    def _dirty(self) -> None:  # persistence hook (subclasses extend)
+        self._version += 1
 
     def flush(self) -> None:  # persistence hook
         pass
@@ -211,6 +261,7 @@ class FileJobStore(JobStore):
             self._loading = False
 
     def _dirty(self) -> None:
+        super()._dirty()
         if self._loading:
             return
         if not self.autoflush:

@@ -80,6 +80,35 @@ STOP_GRACE_SECONDS = _env_float("VODA_STOP_GRACE_SECONDS", "120")
 # opening 100 sockets against one apiserver. 1 restores serial actuation.
 ACTUATION_WORKERS = int(_env_float("VODA_ACTUATION_WORKERS", "8"))
 
+# --- Ingestion plane (doc/observability.md "Ingestion plane") ---------------
+# Bound on each event-bus topic queue. A queue at the bound DROPS new
+# events (counted as voda_events_dropped_total) rather than growing
+# without limit — but admission sheds with 429 well before that (the
+# watermark below), so a drop only happens to direct bus publishers
+# during a pathological storm.
+EVENT_QUEUE_MAX = int(_env_float("VODA_EVENT_QUEUE_MAX", "50000"))
+
+# Shed watermark: when a pool's queue depth passes this, the admission
+# service refuses new jobs with 429 + Retry-After instead of queueing
+# them (load-shedding keeps the service live while the pool's scheduler
+# digests the backlog). Default: 80% of the queue bound, so shedding
+# always engages before dropping.
+EVENT_SHED_WATERMARK = int(_env_float(
+    "VODA_EVENT_SHED_WATERMARK", str(max(1, EVENT_QUEUE_MAX * 8 // 10))))
+
+# What a 429 response advises in its Retry-After header: roughly one
+# rate-limit window is when the backlog has had a resched pass's worth
+# of draining.
+ADMISSION_RETRY_AFTER_SECONDS = _env_float(
+    "VODA_ADMISSION_RETRY_AFTER_SECONDS", "1")
+
+# Optional TTL cache on /metrics exposition (seconds). 0 disables (every
+# scrape rebuilds — exact, the default); Prometheus-style pollers
+# scraping a 10k-job fleet every few seconds can set e.g. 0.5 to make
+# concurrent scrapes nearly free. The /training read paths need no knob:
+# they are cached on state/store version stamps and always exact.
+METRICS_CACHE_SECONDS = _env_float("VODA_METRICS_CACHE_SECONDS", "0")
+
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the
 # checkpoint-restart path. Must cover the resharded step's XLA compile

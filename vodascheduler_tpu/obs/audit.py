@@ -110,6 +110,7 @@ PHASE_NAMES = frozenset({
 # HERE (and to doc/observability.md) before it can ship.
 SPAN_NAMES = frozenset({
     "resched",               # scheduler: one pass's root span
+    "admission.batch",       # service: one bulk-admission commit+publish
     "allocator.allocate",
     "placement.place",
     "job.start", "job.scale", "job.halt", "job.migrate",

@@ -284,11 +284,27 @@ class Scheduler:
         # serial fallback.
         self._lock = _OwnedRLock()
 
+        # Read-path snapshot cache (doc/observability.md "Ingestion
+        # plane"): status_table()/GET /training serve from a
+        # state-version-stamped cached (rows, json) pair. The version
+        # bumps under the lock at every mutation a reader could observe;
+        # the cache ref itself is swapped atomically and read LOCK-FREE,
+        # so a scrape arriving while a pass holds the lock serves the
+        # last committed snapshot instead of waiting out the decide
+        # phase.
+        self._state_version = 0
+        self._status_cache: Optional[Tuple[int, List[Dict[str, object]],
+                                           bytes]] = None
+
         self._init_metrics(registry or Registry())
 
         backend.set_event_callback(self._on_cluster_event)
         if bus is not None:
-            bus.subscribe(pool_id, self._on_job_event)
+            # Batch mode: a burst drained off the queue arrives as ONE
+            # _on_job_events call — one lock acquisition and one
+            # coalesced trigger set for N events, instead of N
+            # serialized callbacks contending for the scheduler lock.
+            bus.subscribe(pool_id, self._on_job_events, batch=True)
 
         if resume:
             self._construct_status_on_restart()
@@ -456,11 +472,41 @@ class Scheduler:
             self.trigger_resched(reason)
 
     def _on_job_event(self, event: JobEvent) -> None:
-        """Reference: readMsgs goroutine (scheduler.go:829-843)."""
-        if event.verb == EventVerb.CREATE:
-            self.create_training_job(event.job_name)
-        elif event.verb == EventVerb.DELETE:
-            self.delete_training_job(event.job_name)
+        """Reference: readMsgs goroutine (scheduler.go:829-843).
+        Single-event shim over the batch path below."""
+        self._on_job_events([event])
+
+    def _on_job_events(self, events: List[JobEvent]) -> None:
+        """Batch-mode bus subscriber (doc/observability.md "Ingestion
+        plane"): the whole drained burst is applied under ONE lock
+        acquisition and its trigger reasons are deduplicated, so a
+        1k-event storm costs one mutation window and a bounded number of
+        resched passes — not 1k serialized lock round-trips."""
+        self._fire(self._locked_or_deferred(self._handle_job_events_locked,
+                                            list(events)))
+
+    def _handle_job_events_locked(self, events: List[JobEvent]) -> List[str]:
+        reasons: List[str] = []
+        for event in events:
+            try:
+                if event.verb == EventVerb.CREATE:
+                    out = self._create_job_locked(event.job_name)
+                elif event.verb == EventVerb.DELETE:
+                    out = self._delete_job_locked(event.job_name)
+                else:
+                    out = []
+            except Exception:
+                # Per-event isolation: one malformed event (a
+                # re-delivered create for a finished job raising in
+                # transition()) must not drop the rest of the burst —
+                # same posture as the deferred-event replay loop.
+                log.exception("job event %s failed; continuing with the "
+                              "rest of the batch", event)
+                continue
+            for reason in out:
+                if reason not in reasons:
+                    reasons.append(reason)
+        return reasons
 
     def _on_cluster_event(self, event: ClusterEvent) -> None:
         """Reference: MPIJob + node informer handlers (scheduler.go:592-747)."""
@@ -500,6 +546,7 @@ class Scheduler:
         self.ready_jobs[name] = job
         self.job_num_chips.commit(name, 0)
         self.m_jobs_created.inc()
+        self._bump_state_version()
         return ["job_created"]
 
     def delete_training_job(self, name: str) -> None:
@@ -529,6 +576,7 @@ class Scheduler:
             # lock release and the drain would see the chips as free.
             self._pending_stops.append((name, chips))
             self._stops_in_flight[name] = chips
+        self._bump_state_version()
         return ["job_deleted"]
 
     def _drain_pending_stops(self) -> None:
@@ -591,6 +639,7 @@ class Scheduler:
             self._job_done(job)
             self.m_jobs_failed.inc()
             reasons.append("job_failed")
+        self._bump_state_version()
         return reasons
 
     def _job_done(self, job: TrainingJob) -> None:
@@ -906,6 +955,7 @@ class Scheduler:
             # (the booking-release contract vodacheck enforces).
             with prof.phase("commit"):
                 self.job_num_chips.commit_pass(new)
+                self._bump_state_version()
             with prof.phase("diff"):
                 halts, scale_ins, scale_outs, starts = \
                     self.compare_results(old)
@@ -974,6 +1024,7 @@ class Scheduler:
                     self._add_reason(job, "halt_failed")
                     self.job_num_chips.commit(job, old.get(job, 0))
                     halt_failures.append(job)
+                    self._bump_state_version()
 
         wave1 = ([(job, (lambda j=job: _halt_task(j))) for job in halts]
                  + [(job, (lambda j=job: self._apply_scale(
@@ -998,6 +1049,7 @@ class Scheduler:
                     self.job_num_chips.commit(job, old.get(job, 0))
                     self._add_reason(job, "reverted_release_failure")
                 self._placement_dirty = True
+                self._bump_state_version()
             self._schedule_retry()
             self.store.flush()
             self.m_resched_total.inc()
@@ -1338,12 +1390,14 @@ class Scheduler:
                 with self._lock:
                     self._add_reason(name, "scale_failed")
                     self.job_num_chips.commit(name, old_chips)
+                    self._bump_state_version()
                 self._schedule_retry()
                 return
             with self._lock:
                 self._add_reason(name, "scale_failed")
                 if name in live:
                     self.job_num_chips.commit(name, live[name].num_workers)
+                    self._bump_state_version()
                 else:
                     self._revert_to_waiting(name)
             self._schedule_retry()
@@ -1351,6 +1405,7 @@ class Scheduler:
     def _revert_to_waiting(self, name: str) -> None:
         with self._lock:
             self.job_num_chips.commit(name, 0)
+            self._bump_state_version()
             job = self.ready_jobs.get(name)
             if job is not None and job.status == JobStatus.RUNNING:
                 lifecycle.transition(job, JobStatus.WAITING,
@@ -1390,6 +1445,7 @@ class Scheduler:
             if job.metrics.running_seconds == 0:
                 job.metrics.first_start_time = self.clock.now()
             self.store.update_job(job)
+            self._bump_state_version()
 
     def _scale_job(self, name: str,
                    placements: Optional[List[Tuple[str, int]]] = None) -> None:
@@ -1412,6 +1468,7 @@ class Scheduler:
         # time of the backend call, labeled by the tier it took.
         self.h_resize_duration.observe(took, path=path_label)
         with self._lock:
+            self._bump_state_version()
             self._pass_resize_seconds[name] = took
             self._add_reason(name,
                              "resize_inplace" if path == ResizePath.INPLACE
@@ -1450,6 +1507,7 @@ class Scheduler:
                                      pool=self.pool_id)
                 job.metrics.last_waiting_seconds = 0.0
                 self.store.update_job(job)
+                self._bump_state_version()
 
     def _job_status(self, name: str) -> Optional[JobStatus]:
         job = self.ready_jobs.get(name) or self.done_jobs.get(name)
@@ -1655,6 +1713,10 @@ class Scheduler:
                     job.priority = tiresias_promote_priority(job.priority)
                     m.last_waiting_seconds = 0.0
                     priority_changed = True
+        if self.ready_jobs:
+            # An idle pool's tick mutates no row — keep the status
+            # snapshot cache valid so steady-state scrapes stay free.
+            self._bump_state_version()
         return priority_changed
 
     # ---- crash resume (reference: constructStatusOnRestart :1009-1072) ---
@@ -1682,6 +1744,7 @@ class Scheduler:
             job.metrics.last_update_time = self.clock.now()
             self.ready_jobs[job.name] = job
             self.job_num_chips.commit(job.name, n)
+        self._bump_state_version()
         if self.placement_manager is not None:
             self.placement_manager.restore(
                 {name: h.placements for name, h in running.items()
@@ -1690,9 +1753,53 @@ class Scheduler:
 
     # ---- introspection (reference: GET /training table :968-998) ---------
 
+    def _bump_state_version(self) -> None:
+        """Invalidate the read-path snapshot cache. Called under the
+        scheduler lock by every mutation a status_table() reader could
+        observe (status, chips, priority, time accounting)."""
+        self._state_version += 1
+
+    def _snapshot(self) -> Tuple[List[Dict[str, object]], bytes]:
+        """The (rows, json-bytes) status snapshot, version-stamped.
+
+        Fast path is LOCK-FREE: the cache ref is swapped atomically, so
+        a fleet under scrape load pays one dict compare per request. A
+        stale cache rebuilds under the lock — but a reader arriving
+        while a pass (or another rebuild) holds the lock serves the last
+        committed snapshot instead of blocking, so REST reads stay live
+        through an in-flight resched (snapshot isolation: the reader
+        sees the consistent pre-pass state). Rows are shared across
+        callers — treat them as read-only."""
+        cache = self._status_cache
+        if cache is not None and cache[0] == self._state_version:
+            return cache[1], cache[2]
+        if not self._lock.acquire(blocking=False):
+            if cache is not None:
+                return cache[1], cache[2]
+            # No snapshot built yet: the one time a reader must wait.
+            self._lock.acquire()
+        try:
+            version = self._state_version
+            rows = self._status_table_locked()
+        finally:
+            self._lock.release()
+        import json as _json
+        data = (_json.dumps(rows) + "\n").encode()
+        self._status_cache = (version, rows, data)
+        return rows, data
+
     def status_table(self) -> List[Dict[str, object]]:
-        with self._lock:
-            return self._status_table_locked()
+        """Status rows, served from the snapshot cache. The returned
+        list is the caller's to reorder, but the row dicts are SHARED
+        with every concurrent reader (and with the cached JSON) — treat
+        them as read-only."""
+        return list(self._snapshot()[0])
+
+    def status_table_json(self) -> bytes:
+        """Pre-serialized status table for the REST layer: the cached
+        bytes are written straight to the socket (no per-request
+        re-serialization of a 10k-row fleet)."""
+        return self._snapshot()[1]
 
     def _status_table_locked(self) -> List[Dict[str, object]]:
         rows = []

@@ -106,8 +106,11 @@ class VodaApp:
         os.makedirs(self.workdir, exist_ok=True)
         self.clock = Clock()
         self.store = FileJobStore(os.path.join(self.workdir, "state.json"))
-        self.bus = EventBus()
         self.registry = Registry()
+        # Bounded, instrumented event bus (doc/observability.md
+        # "Ingestion plane"): per-pool queue depth and drop counts land
+        # on the shared /metrics surface.
+        self.bus = EventBus(registry=self.registry)
 
         # Decision-audit tracing plane (doc/observability.md): JSONL sink
         # under the workdir unless VODA_TRACE_DIR points elsewhere.
@@ -227,7 +230,8 @@ class VodaApp:
         self.collector = self.collectors[first]
         self.admission = AdmissionService(self.store, self.bus, self.clock,
                                           registry=self.registry,
-                                          valid_pools=set(names))
+                                          valid_pools=set(names),
+                                          tracer=self.tracer)
         # Chip telemetry on the shared /metrics endpoints (reference
         # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
         # Collected only when this process may own a jax backend: hermetic

@@ -34,16 +34,38 @@ from vodascheduler_tpu.common.job import JobSpec
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import job_from_dict, job_to_dict
 from vodascheduler_tpu.obs import tracer as obs_tracer
-from vodascheduler_tpu.service.admission import AdmissionError, AdmissionService
+from vodascheduler_tpu.service.admission import (
+    BATCH_SIBLING_REJECTED,
+    AdmissionError,
+    AdmissionService,
+    AdmissionShed,
+)
 
 log = logging.getLogger(__name__)
 
 # route table: (method, path) -> fn(body_bytes, query_dict) -> (status, payload)
-# payload: dict/list (JSON), or (content_type, str) for raw text.
+# payload: dict/list (JSON), (content_type, str) for raw text, or a Raw
+# (pre-serialized bytes written straight to the socket — the ingestion
+# plane's cached snapshots are encoded once, not per request). A handler
+# may return a third element: a dict of extra response headers (429 uses
+# it for Retry-After).
 # A path ending in "/*" is a prefix route: the remainder of the request
 # path (e.g. the job name in /debug/trace/<job>) is passed to the handler
 # as query["__path__"][0].
 Route = Callable[[bytes, Dict[str, list]], Tuple[int, object]]
+
+
+class Raw:
+    """A pre-serialized response body: `_reply` writes the bytes as-is.
+    Lets cached snapshots (scheduler status table, service job list,
+    metrics exposition) serialize once per state change instead of once
+    per request."""
+
+    __slots__ = ("content_type", "data")
+
+    def __init__(self, content_type: str, data: bytes):
+        self.content_type = content_type
+        self.data = data
 
 
 class RestServer:
@@ -52,6 +74,13 @@ class RestServer:
     def __init__(self, routes: Dict[Tuple[str, str], Route],
                  host: str = "127.0.0.1", port: int = 0):
         class Handler(BaseHTTPRequestHandler):
+            # Socket read timeout: a client that connects and never
+            # sends a request line (or stalls mid-headers) must not pin
+            # a handler thread forever — at fleet scale leaked threads
+            # are the service's OOM. BaseHTTPRequestHandler honors this
+            # attr via socket.settimeout.
+            timeout = 30.0
+
             def log_message(self, fmt, *args):
                 # The raw BaseHTTPRequestHandler line is dropped (klog-
                 # level-5 noise); the structured http_access event emitted
@@ -99,9 +128,23 @@ class RestServer:
                 # inside (allocator.allocate) stitch into its trace.
                 ctx = obs_tracer.TraceContext.from_headers(self.headers)
                 t0 = _walltime.monotonic()
+                headers: Optional[Dict[str, str]] = None
                 try:
                     with obs_tracer.use_context(ctx):
-                        status, payload = fn(body, query)
+                        result = fn(body, query)
+                    status, payload = result[0], result[1]
+                    if len(result) > 2:
+                        headers = result[2]
+                except AdmissionShed as e:
+                    # Backpressure (doc/observability.md "Ingestion
+                    # plane"): the pool's event queue is past its shed
+                    # watermark — tell the client when to come back
+                    # instead of queueing it into an OOM.
+                    status, payload = 429, {
+                        "error": str(e),
+                        "retry_after_seconds": e.retry_after}
+                    headers = {"Retry-After":
+                               str(max(1, int(round(e.retry_after))))}
                 except (AdmissionError, KeyError, ValueError) as e:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:
@@ -124,19 +167,25 @@ class RestServer:
                     obs_tracer.get_tracer().emit(rec)
                 except Exception:  # noqa: BLE001 - never fail a reply
                     log.debug("access event emit failed", exc_info=True)
-                self._reply(status, payload)
+                self._reply(status, payload, headers)
 
-            def _reply(self, status: int, payload) -> None:
-                if (isinstance(payload, tuple) and len(payload) == 2
+            def _reply(self, status: int, payload,
+                       extra_headers: Optional[Dict[str, str]] = None
+                       ) -> None:
+                if isinstance(payload, Raw):
+                    ctype, data = payload.content_type, payload.data
+                elif (isinstance(payload, tuple) and len(payload) == 2
                         and isinstance(payload[0], str)):
                     ctype, text = payload
-                    data = text.encode()
+                    data = text if isinstance(text, bytes) else text.encode()
                 else:
                     ctype = "application/json"
                     data = (json.dumps(payload) + "\n").encode()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for key, value in (extra_headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -152,7 +201,14 @@ class RestServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # Explicitly pinned (stdlib default since 3.7, but this is
+            # load-bearing): handler threads must never block process
+            # exit — a stalled client on a dying control plane would
+            # otherwise hang shutdown.
+            daemon_threads = True
+
+        self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -173,9 +229,35 @@ class RestServer:
             self._thread.join(timeout=5.0)
 
 
-def _metrics_route(registry: Registry) -> Route:
+_METRICS_CTYPE = "text/plain; version=0.0.4"
+
+
+def _metrics_route(registry: Registry,
+                   cache_seconds: Optional[float] = None) -> Route:
+    """Prometheus exposition, serialized to bytes once per scrape. With
+    a TTL (`VODA_METRICS_CACHE_SECONDS` > 0) concurrent scrapers inside
+    the window share one rebuild — a fleet-wide scrape storm costs one
+    exposition walk, at the price of up-to-TTL-stale counters (exact
+    values remain the default: TTL 0)."""
+    import time as _walltime
+
+    ttl = config.METRICS_CACHE_SECONDS if cache_seconds is None \
+        else cache_seconds
+    state = {"at": -float("inf"), "data": b""}
+    lock = threading.Lock()
+
     def metrics(body, query):
-        return 200, ("text/plain; version=0.0.4", registry.exposition())
+        if ttl > 0:
+            with lock:
+                # Single-flight: the rebuild happens under the lock, so
+                # scrapers racing an expired stamp queue behind one
+                # rebuild and then hit the fresh-stamp fast path —
+                # K concurrent scrapers cost one exposition walk.
+                if _walltime.monotonic() - state["at"] > ttl:
+                    state["data"] = registry.exposition().encode()
+                    state["at"] = _walltime.monotonic()
+                return 200, Raw(_METRICS_CTYPE, state["data"])
+        return 200, Raw(_METRICS_CTYPE, registry.exposition().encode())
     return metrics
 
 
@@ -204,22 +286,85 @@ def make_service_server(admission: AdmissionService, registry: Registry,
         name = admission.create_training_job(spec)
         return 200, {"name": name}
 
+    def create_batch(body, query):
+        """Bulk admission (doc/observability.md "Ingestion plane"): a
+        YAML/JSON list of job specs (or `{specs: [...]}`) admitted
+        atomically — per-item results, 200 only when every spec was
+        admitted, 400 with zero residue otherwise (one store commit, one
+        cross-pool-atomic publish_many_multi)."""
+        data = yaml.safe_load(body)
+        if isinstance(data, dict) and "specs" in data:
+            data = data["specs"]
+        if not isinstance(data, list) or not data:
+            raise ValueError("body must be a non-empty list of job "
+                             "specs (or {specs: [...]})")
+        specs: list = []
+        parse_errors: Dict[int, str] = {}
+        for i, item in enumerate(data):
+            try:
+                if not isinstance(item, dict):
+                    raise ValueError("spec must be a mapping")
+                specs.append(JobSpec.from_dict(item))
+            except Exception as e:  # noqa: BLE001 - per-item outcome
+                parse_errors[i] = str(e)
+                specs.append(None)
+        if parse_errors:
+            # Atomicity holds before admission is even consulted: a
+            # batch with any malformed spec admits nothing.
+            results = [
+                {"name": (item.get("name", "?")
+                          if isinstance(item, dict) else "?"),
+                 "error": parse_errors.get(i, BATCH_SIBLING_REJECTED)}
+                for i, item in enumerate(data)]
+            return 400, {"admitted": 0, "results": results}
+        results = admission.create_training_jobs(specs)
+        admitted = sum(1 for r in results if "error" not in r)
+        status = 200 if admitted == len(results) else 400
+        return status, {"admitted": admitted, "results": results}
+
     def delete(body, query):
         name = _job_name_from(body, query)
         admission.delete_training_job(name)
         return 200, {"deleted": name}
 
+    # GET /training snapshot cache: rebuilt only when the store's
+    # mutation stamp moves, so a 10k-job fleet under poll load serves
+    # the same pre-encoded bytes until something actually changes.
+    jobs_cache = {"version": -1, "data": b""}
+    jobs_cache_lock = threading.Lock()
+
     def get_jobs(body, query):
-        jobs = admission.store.list_jobs()
-        return 200, [{
-            "name": j.name, "pool": j.pool, "status": j.status.value,
-            "priority": j.priority, "submit_time": j.submit_time,
-        } for j in sorted(jobs, key=lambda j: j.submit_time)]
+        with jobs_cache_lock:
+            # Single-flight (the _metrics_route idiom): the rebuild runs
+            # under the lock, so K pollers racing a store-version bump
+            # queue behind ONE list_jobs + serialization and then hit
+            # the fresh-stamp fast path. Stamped with the version read
+            # BEFORE the rebuild: a write racing the rebuild just forces
+            # the next reader to rebuild again — never a stale hit.
+            version = admission.store.version
+            if jobs_cache["version"] != version:
+                jobs = admission.store.list_jobs()
+                rows = [{
+                    "name": j.name, "pool": j.pool,
+                    "status": j.status.value, "priority": j.priority,
+                    "submit_time": j.submit_time,
+                } for j in sorted(jobs, key=lambda j: j.submit_time)]
+                jobs_cache["version"] = version
+                jobs_cache["data"] = (json.dumps(rows) + "\n").encode()
+            return 200, Raw("application/json", jobs_cache["data"])
+
+    def debug_ingest(body, query):
+        """Ingestion-plane stats (shed/drop counters, queue depth,
+        recent admission p50/p99, last burst) — backs `voda top`'s
+        ingestion section (doc/observability.md "Ingestion plane")."""
+        return 200, admission.ingest_stats()
 
     return RestServer({
         ("POST", "/training"): create,
+        ("POST", "/training/batch"): create_batch,
         ("DELETE", "/training"): delete,
         ("GET", "/training"): get_jobs,
+        ("GET", "/debug/ingest"): debug_ingest,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
 
@@ -257,7 +402,12 @@ def make_scheduler_server(scheduler, registry: Registry,
         return schedulers[pool]
 
     def get_training(body, query):
-        return 200, pick(body, query).status_table()
+        # Pre-encoded snapshot bytes (scheduler.status_table_json): the
+        # cache is stamped by the scheduler's state version and read
+        # lock-free, so scrapes stay live — and cheap — while a resched
+        # pass is in flight.
+        return 200, Raw("application/json",
+                        pick(body, query).status_table_json())
 
     def put_algorithm(body, query):
         data = yaml.safe_load(body)
