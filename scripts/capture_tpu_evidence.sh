@@ -84,7 +84,7 @@ if stale or not hw.get("models"):
 # the "complete live capture" bar but not the artifact's integrity.
 rows = (hw.get("models", []) + hw.get("attention", [])
         + ([hw["moe"]] if isinstance(hw.get("moe"), dict) else [])
-        + hw.get("resize", []))
+        + hw.get("resize", []) + hw.get("ici", []))
 untagged = [r for r in rows if not str(r.get("provenance", "")).startswith(
     ("measured", "cached_from:", "skipped:"))]
 if untagged:
@@ -132,6 +132,23 @@ if points:
 else:
     print("WARNING: no complete live resize points; doc/resize_measured.json "
           "not written")
+
+# The measured-ICI artifact placement/comms.py derives the per-hop link
+# bandwidth from (doc/placement.md): live-measured points only, same
+# no-restamped-cache rule as the resize artifact above.
+ici_points = [r for r in hw.get("ici", [])
+              if r.get("ppermute_gbps") and r.get("ring_size")
+              and r.get("provenance") == "measured"]
+if ici_points:
+    json.dump({
+        "note": "Measured on-chip by runtime/hwbench.py bench_ici_point "
+                "via bench.py; consumed by placement/comms.py link_gbps.",
+        "points": ici_points,
+    }, open("doc/ici_measured.json", "w"), indent=1)
+    print("wrote doc/ici_measured.json with", len(ici_points), "points")
+else:
+    print("WARNING: no live ICI points; doc/ici_measured.json not written "
+          "(placement comms model stays on ASSUMED_LINK_GBPS)")
 EOF
 
 # 2b. Evidence-plane self-check: the orchestrator's fake-backend dryrun

@@ -81,9 +81,11 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 3  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
+SCHEMA = 4  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # suite grew the top-level "ingestion" section (bulk/single admission,
-# storm-to-quiescent, snapshot-cache reads).
+# storm-to-quiescent, snapshot-cache reads); v4: curves grew the
+# "placement_scoring" column (the bandwidth-aware objective's fleet
+# scoring cost — doc/placement.md) and the gate bounds its total.
 
 # Ingestion measurement shape: the admission slack is deliberately
 # tighter than the decide slack — a per-item bulk admission costs
@@ -179,6 +181,33 @@ def _probe_defragment(sched, hosts: int) -> Dict[str, object]:
             "jobs_placed": len(requests)}
 
 
+def _probe_placement_scoring(sched) -> Dict[str, object]:
+    """One-shot cost probe of the bandwidth-aware scoring plane
+    (doc/placement.md) at fleet size: the batch category->weight lookup
+    (placement/comms.py weights_for_categories — one memo probe per
+    job, one table lookup per distinct category) plus a full fleet
+    contiguity/comms re-score (the incremental pass never pays this;
+    the probe prices the worst case a cache rebuild costs). The gate
+    bounds the total so comms scoring can never quietly eat the decide
+    budget item 2 reclaimed."""
+    from vodascheduler_tpu.placement import comms as comms_mod
+
+    jobs = list(sched.ready_jobs.values())
+    t0 = time.monotonic()
+    weights = comms_mod.weights_for_categories([j.category for j in jobs])
+    weights_ms = (time.monotonic() - t0) * 1000.0
+    pm = sched.placement_manager
+    pm.set_comms_weights({j.name: w for j, w in zip(jobs, weights) if w})
+    t0 = time.monotonic()
+    cross, contig, comms = pm._fleet_stats()
+    score_ms = (time.monotonic() - t0) * 1000.0
+    return {"jobs": len(jobs),
+            "weights_ms": round(weights_ms, 3),
+            "fleet_score_ms": round(score_ms, 3),
+            "total_ms": round(weights_ms + score_ms, 3),
+            "comms_score": comms}
+
+
 def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
               seed: int = DEFAULT_SEED,
               inject: Optional[Tuple[str, float]] = None) -> Dict[str, object]:
@@ -270,6 +299,7 @@ def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
             for name, agg in sorted(phase_stats.items())
         },
         "defragment_probe": _probe_defragment(sched, hosts),
+        "placement_scoring": _probe_placement_scoring(sched),
     }
     sched.stop()
     return curve
@@ -501,6 +531,13 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
                                 f"absent from the fresh run")
                 continue
             check(name, fresh_phase["wall_ms_mean"], stats["wall_ms_mean"])
+        # Placement-scoring column (schema 4): the comms-weight lookup +
+        # fleet re-score probe. Pre-v4 baselines simply skip it.
+        base_ps = base.get("placement_scoring")
+        fresh_ps = curve.get("placement_scoring")
+        if base_ps is not None and fresh_ps is not None:
+            check("placement_scoring", fresh_ps["total_ms"],
+                  base_ps["total_ms"])
 
     # Ingestion columns (schema 3): admission p99 bounds use a tighter
     # slack (sub-ms costs would vanish inside the decide slack);

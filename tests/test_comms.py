@@ -1,0 +1,468 @@
+"""Topology-aware placement plane tests (doc/placement.md): the comms
+cost model, the placement-sensitive fake-backend physics, migration
+payback gating, the topology-mix A/B machinery, and the CLI columns."""
+
+import json
+
+import pytest
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.backend import JobHandle
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, WorkloadProfile
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.placement import PlacementManager, PoolTopology
+from vodascheduler_tpu.placement import comms
+from vodascheduler_tpu.scheduler import Scheduler
+
+
+def spec(name, min_chips=1, max_chips=4, epochs=5):
+    return JobSpec(name=name, pool="pool",
+                   config=JobConfig(min_num_chips=min_chips,
+                                    max_num_chips=max_chips, epochs=epochs))
+
+
+class TestTopologyParse:
+    """Satellite: PoolTopology.parse without a /block part used to die
+    on int('')."""
+
+    def test_bare_torus_defaults_to_single_chip_hosts(self):
+        topo = PoolTopology.parse("4x4x4")
+        assert topo.torus_dims == (4, 4, 4)
+        assert topo.host_block == (1, 1, 1)
+        assert topo.chips_per_host == 1
+        assert topo.num_hosts == 64
+
+    def test_full_form_roundtrips(self):
+        topo = PoolTopology.parse("4x4x4/2x2x1")
+        assert PoolTopology.parse(str(topo)) == topo
+
+    @pytest.mark.parametrize("bad", ("4xx4", "x", "4x4/ax1", ""))
+    def test_malformed_gets_clear_message(self, bad):
+        with pytest.raises(ValueError, match="invalid topology"):
+            PoolTopology.parse(bad)
+
+
+class TestGeometry:
+    def test_spread_bounds_and_degenerates(self):
+        topo = PoolTopology(torus_dims=(16,), host_block=(2,))  # 8 hosts
+        assert topo.host_diameter == 4
+        assert topo.spread([]) == 0.0
+        assert topo.spread([(0,)]) == 0.0
+        # adjacent pair: 1 hop over diameter 4
+        assert topo.spread([(0,), (1,)]) == pytest.approx(0.25)
+        # antipodal pair: the full diameter
+        assert topo.spread([(0,), (4,)]) == pytest.approx(1.0)
+        # torus wrap: 0 and 7 are adjacent
+        assert topo.spread([(0,), (7,)]) == pytest.approx(0.25)
+
+    def test_mean_hop_matches_contiguity(self):
+        topo = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))
+        coords = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+        pairs = 6
+        assert topo.mean_hop_distance(coords) == pytest.approx(
+            topo.contiguity_cost(coords) / pairs)
+
+
+class TestCollectiveModel:
+    def test_families_cover_trace_families(self):
+        comms.sanity_check_families()  # raises on drift
+
+    def test_weights_are_bounded_integers_and_ordered(self):
+        weights = {f: p.weight() for f, p in comms.FAMILY_COLLECTIVES.items()}
+        for w in weights.values():
+            assert isinstance(w, int)
+            assert 0 <= w <= comms.MAX_COMMS_WEIGHT
+        # the LLM families out-weigh the vision families
+        assert weights["mixtral"] > weights["bert"]
+        assert weights["llama8b"] > weights["resnet50"]
+
+    def test_unknown_category_is_count_only(self):
+        assert comms.weight_for_category("perf-00042") == 0
+        assert comms.fraction_for_category("perf-00042") == 0.0
+        assert comms.profile_for_category("perf-00042") is None
+
+    def test_batch_weights_match_scalar(self):
+        cats = ["mixtral", "resnet50", "mixtral", "nope", "bert"]
+        assert comms.weights_for_categories(cats) == [
+            comms.weight_for_category(c) for c in cats]
+
+    def test_comms_fraction_bounds_enforced(self):
+        with pytest.raises(ValueError):
+            comms.CollectiveProfile(comms_fraction=0.95)
+
+    def test_link_gbps_assumed_without_artifact(self, tmp_path):
+        gbps, prov = comms.link_gbps(str(tmp_path / "absent.json"))
+        assert gbps == comms.ASSUMED_LINK_GBPS
+        assert prov == "assumed"
+
+    def test_link_gbps_derived_from_measured_artifact(self, tmp_path):
+        path = tmp_path / "ici_measured.json"
+        path.write_text(json.dumps({"points": [
+            {"ring_size": 4, "ppermute_gbps": 40.0, "device_kind": "TPU v5"},
+            {"ring_size": 8, "ppermute_gbps": 52.0, "device_kind": "TPU v5"},
+        ]}))
+        gbps, prov = comms.link_gbps(str(path))
+        # ring-size-weighted mean: (4*40 + 8*52) / 12 = 48.0
+        assert gbps == pytest.approx(48.0)
+        assert prov.startswith("measured:")
+
+    def test_half_captured_artifact_falls_back(self, tmp_path):
+        path = tmp_path / "ici_measured.json"
+        path.write_text(json.dumps({"points": [
+            {"ring_size": 4, "error": "wedged"}]}))
+        gbps, prov = comms.link_gbps(str(path))
+        assert prov == "assumed" and gbps == comms.ASSUMED_LINK_GBPS
+
+    def test_spec_descriptor_wins_over_family(self):
+        profile = comms.profile_for_job(
+            {"allreduce_bytes_per_chip": 8e9, "comms_fraction": 0.5},
+            "resnet50")
+        assert profile.provenance == "spec"
+        assert profile.comms_fraction == 0.5
+        assert profile.weight() > comms.FAMILY_COLLECTIVES[
+            "resnet50"].weight()
+
+    def test_malformed_descriptor_falls_back_to_family(self):
+        profile = comms.profile_for_job({"comms_fraction": "lots"},
+                                        "resnet50")
+        assert profile == comms.FAMILY_COLLECTIVES["resnet50"]
+        assert comms.profile_for_job({"comms_fraction": 5.0}, "nope") is None
+
+    def test_descriptor_ignores_unknown_fields(self):
+        profile = comms.profile_from_descriptor(
+            {"ring_bytes_per_chip": 1e9, "pod_color": "blue"})
+        assert profile.ring_bytes_per_chip == 1e9
+
+    def test_spec_roundtrips_collectives(self):
+        s = spec("j")
+        s.collectives = {"comms_fraction": 0.2}
+        assert JobSpec.from_dict(s.to_dict()).collectives == \
+            {"comms_fraction": 0.2}
+        assert JobSpec.from_dict(spec("k").to_dict()).collectives is None
+
+    def test_comms_seconds_scale_with_spread(self):
+        topo = PoolTopology(torus_dims=(16,), host_block=(2,))
+        profile = comms.FAMILY_COLLECTIVES["mixtral"]
+        near = comms.comms_seconds_per_step(topo, [(0,), (1,)], profile,
+                                            gbps=45.0)
+        far = comms.comms_seconds_per_step(topo, [(0,), (4,)], profile,
+                                           gbps=45.0)
+        single = comms.comms_seconds_per_step(topo, [(0,)], profile,
+                                              gbps=45.0)
+        assert single == 0.0
+        assert 0.0 < near < far
+
+
+def _backend_with_torus():
+    topo = PoolTopology(torus_dims=(16,), host_block=(2,))
+    clock = VirtualClock(start=1753760000.0)
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=0.0)
+    for coord in topo.host_coords():
+        backend.add_host(topo.host_name(coord), topo.chips_per_host,
+                         announce=False)
+    backend.set_topology(topo)
+    return topo, clock, backend
+
+
+class TestPlacementSensitiveStepTime:
+    """The replay physics: WHERE a job lands moves its modeled step
+    time (cluster/fake.py _effective_speedup)."""
+
+    def test_scattered_placement_is_slower_than_contiguous(self):
+        topo, clock, backend = _backend_with_torus()
+        prof = WorkloadProfile(epoch_seconds_at_1=100.0,
+                               speedup_exponent=0.9, comms_fraction=0.3)
+        backend.register_profile("tight", prof)
+        backend.register_profile("wide", prof)
+        backend.start_job(spec("tight", max_chips=4), 4,
+                          [("host-0", 2), ("host-1", 2)])
+        backend.start_job(spec("wide", max_chips=4), 4,
+                          [("host-2", 2), ("host-6", 2)])  # antipodal
+        clock.advance(50.0)
+        backend.sync_accounting()
+        tight, wide = backend.jobs["tight"], backend.jobs["wide"]
+        assert tight.comms_spread == pytest.approx(0.25)
+        assert wide.comms_spread == pytest.approx(1.0)
+        assert tight.progress_serial > wide.progress_serial
+        assert backend.comms_penalty_chip_seconds > 0.0
+
+    def test_single_host_and_zero_fraction_pay_nothing(self):
+        topo, clock, backend = _backend_with_torus()
+        backend.register_profile("solo", WorkloadProfile(
+            epoch_seconds_at_1=100.0, comms_fraction=0.3))
+        backend.register_profile("free", WorkloadProfile(
+            epoch_seconds_at_1=100.0, comms_fraction=0.0))
+        backend.start_job(spec("solo", max_chips=2), 2, [("host-0", 2)])
+        backend.start_job(spec("free", max_chips=4), 4,
+                          [("host-2", 2), ("host-6", 2)])
+        clock.advance(50.0)
+        backend.sync_accounting()
+        assert backend.comms_penalty_chip_seconds == 0.0
+        solo = backend.jobs["solo"]
+        assert solo.comms_spread == 0.0
+
+    def test_without_topology_physics_is_count_only(self):
+        clock = VirtualClock(start=1753760000.0)
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=0.0)
+        backend.add_host("h0", 2, announce=False)
+        backend.add_host("h1", 2, announce=False)
+        backend.register_profile("j", WorkloadProfile(
+            epoch_seconds_at_1=100.0, comms_fraction=0.3))
+        backend.start_job(spec("j", max_chips=4), 4, [("h0", 2), ("h1", 2)])
+        clock.advance(50.0)
+        backend.sync_accounting()
+        assert backend.jobs["j"].comms_spread == 0.0
+        assert backend.comms_penalty_chip_seconds == 0.0
+
+
+def _scheduler_world(comms_enabled=True):
+    topo = PoolTopology(torus_dims=(16,), host_block=(2,))
+    clock = VirtualClock(start=1753760000.0)
+    store = JobStore()
+    bus = EventBus()
+    backend = FakeClusterBackend(clock)
+    for coord in topo.host_coords():
+        backend.add_host(topo.host_name(coord), topo.chips_per_host,
+                         announce=False)
+    backend.set_topology(topo)
+    pm = PlacementManager("pool", topology=topo, comms_enabled=comms_enabled)
+    pm.add_hosts_from_topology(topo)
+    sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                      clock, bus=bus, placement_manager=pm,
+                      algorithm="ElasticFIFO", rate_limit_seconds=1.0)
+    return topo, clock, backend, pm, sched
+
+
+class TestMigrationPaybackGate:
+    """Optimization migrations are priced (doc/placement.md "Priced
+    migrations"); forced ones never are."""
+
+    def _handle(self, name, pairs):
+        return JobHandle(name=name, num_workers=sum(n for _, n in pairs),
+                         placements=pairs)
+
+    def test_unpaid_when_win_cannot_repay_cost(self):
+        _, _, _, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1.0  # nothing repays in 1 s
+        handle = self._handle("mixtral-20260101-000000",
+                              [("host-0", 2), ("host-4", 2)])
+        target = [("host-0", 2), ("host-1", 2)]
+        assert sched._migration_unpaid(handle.name, handle, target)
+
+    def test_paid_when_window_is_long_enough(self):
+        _, _, _, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1e9
+        handle = self._handle("mixtral-20260101-000000",
+                              [("host-0", 2), ("host-4", 2)])
+        target = [("host-0", 2), ("host-1", 2)]
+        assert not sched._migration_unpaid(handle.name, handle, target)
+
+    def test_zero_fraction_job_never_pays_back(self):
+        _, _, _, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1e12
+        handle = self._handle("perf-1", [("host-0", 2), ("host-4", 2)])
+        target = [("host-0", 2), ("host-1", 2)]
+        assert sched._migration_unpaid(handle.name, handle, target)
+
+    def test_forced_moves_are_never_gated(self):
+        _, _, _, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1.0
+        name = "mixtral-20260101-000000"
+        # size drift
+        assert not sched._migration_unpaid(
+            name, self._handle(name, [("host-0", 2)]),
+            [("host-0", 2), ("host-1", 2)])
+        # workers on a dead host
+        assert not sched._migration_unpaid(
+            name, self._handle(name, [("gone-host", 2), ("host-0", 2)]),
+            [("host-0", 2), ("host-1", 2)])
+        # old chips promised to someone else
+        pm.host_states["host-4"].free_slots = 0
+        assert not sched._migration_unpaid(
+            name, self._handle(name, [("host-0", 2), ("host-4", 2)]),
+            [("host-0", 2), ("host-1", 2)])
+
+    def test_partial_overlap_rebinding_still_gated(self):
+        """The deferral-safety check credits the job's OWN new booking
+        on overlapping hosts: a re-binding that keeps host-0 (with
+        host-0 otherwise full of the job's own slots) must still be
+        priced, not misread as 'old chips promised elsewhere'."""
+        _, _, _, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1.0
+        name = "mixtral-20260101-000000"
+        pm.place({name: 4})  # books host-0:2 + host-1:2 (both now full)
+        assert pm.host_states["host-0"].free_slots == 0
+        handle = self._handle(name, [("host-0", 2), ("host-4", 2)])
+        target = [("host-0", 2), ("host-1", 2)]
+        assert sched._migration_unpaid(name, handle, target)
+        # ...but once ANOTHER job claims the old chips, the move is
+        # forced regardless of payback.
+        pm.place({name: 4, "other": 2})  # other lands on host-4... or 2
+        pm.host_states["host-4"].free_slots = 0
+        pm.host_states["host-4"].job_num_workers["other"] = 2
+        assert not sched._migration_unpaid(name, handle, target)
+
+    def test_count_only_mode_migrates_every_mismatch(self):
+        _, _, _, pm, sched = _scheduler_world(comms_enabled=False)
+        sched.migration_payback_seconds = 1.0
+        handle = self._handle("mixtral-20260101-000000",
+                              [("host-0", 2), ("host-4", 2)])
+        assert not sched._migration_unpaid(
+            handle.name, handle, [("host-0", 2), ("host-1", 2)])
+
+    def test_deferred_migration_is_audited_not_tasked(self):
+        _, clock, backend, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1.0
+        name = "mixtral-20260101-000000"
+        backend.register_profile(name, WorkloadProfile(
+            epoch_seconds_at_1=1e6, comms_fraction=0.25))
+        backend.start_job(spec(name, max_chips=4), 4,
+                          [("host-0", 2), ("host-4", 2)])
+        sched._pass_reasons = {}
+        tasks = sched._migration_tasks(
+            {name: [("host-0", 2), ("host-1", 2)]}, set())
+        assert tasks == []
+        assert "migration_deferred_unpaid" in sched._pass_reasons[name]
+
+    def test_fired_migration_records_priced_cost(self):
+        _, clock, backend, pm, sched = _scheduler_world()
+        sched.migration_payback_seconds = 1e9
+        name = "mixtral-20260101-000000"
+        backend.register_profile(name, WorkloadProfile(
+            epoch_seconds_at_1=1e6, comms_fraction=0.25))
+        backend.start_job(spec(name, max_chips=4), 4,
+                          [("host-0", 2), ("host-4", 2)])
+        sched._pass_reasons = {}
+        sched._pass_resize_seconds = {}
+        tasks = sched._migration_tasks(
+            {name: [("host-0", 2), ("host-1", 2)]}, set())
+        assert len(tasks) == 1
+        tasks[0][1]()  # run the migration task
+        assert "migrated" in sched._pass_reasons[name]
+        assert sched._pass_resize_seconds[name] > 0.0
+
+
+class TestSchedulerCommsWeights:
+    def test_spec_descriptor_drives_the_weight(self):
+        _, clock, backend, pm, sched = _scheduler_world()
+        from vodascheduler_tpu.common.job import TrainingJob
+        s = spec("custom-job")
+        s.collectives = {"allreduce_bytes_per_chip": 4e9,
+                         "comms_fraction": 0.3}
+        job = TrainingJob.from_spec(s, submit_time=clock.now())
+        sched.ready_jobs[s.name] = job
+        sched._refresh_comms_weights({s.name: 4})
+        # 2 x 4 GB / 0.5 GB-per-unit = 16 weight units
+        assert pm.comms_weights[s.name] == 16
+
+    def test_weights_reach_placement_manager_memoized(self):
+        _, clock, backend, pm, sched = _scheduler_world()
+        name = "mixtral-20260101-000000"
+        from vodascheduler_tpu.common.job import TrainingJob
+        job = TrainingJob.from_spec(spec(name), submit_time=clock.now(),
+                                    name=name)
+        sched.ready_jobs[name] = job
+        sched._refresh_comms_weights({name: 4, "perf-1": 2})
+        expected = comms.weight_for_category("mixtral")
+        assert pm.comms_weights == {name: expected}
+        assert sched._comms_weight[name] == expected
+        assert sched._comms_weight["perf-1"] == 0
+
+    def test_disabled_manager_gets_no_weights(self):
+        _, clock, backend, pm, sched = _scheduler_world(comms_enabled=False)
+        sched._refresh_comms_weights({"mixtral-20260101-000000": 4})
+        assert pm.comms_weights == {}
+
+
+class TestAuditCommsColumns:
+    def test_delta_comms_block_is_schema_valid(self):
+        from vodascheduler_tpu.obs import audit as obs_audit
+        rec = {"kind": "resched_audit", "schema": 1, "ts": 0.0,
+               "pool": "p", "seq": 1, "trace_id": "t", "triggers": ["manual"],
+               "algorithm": "ElasticFIFO", "total_chips": 16, "queue": [],
+               "deltas": [{"job": "j", "before": 0, "after": 4,
+                           "reasons": ["started"],
+                           "comms": {"weight": 13, "contiguity": 8,
+                                     "score": 104}}],
+               "duration_ms": 1.0, "outcome": "applied"}
+        assert obs_audit.validate_record(rec) == []
+
+    def test_deferred_reason_is_in_closed_vocab(self):
+        from vodascheduler_tpu.obs import audit as obs_audit
+        assert "migration_deferred_unpaid" in obs_audit.REASON_CODES
+        assert "comms" in obs_audit.PHASE_NAMES
+
+
+class TestTopologyMixTrace:
+    def test_deterministic_and_bimodal(self):
+        from vodascheduler_tpu.replay.trace import topology_mix_trace
+        a = topology_mix_trace(num_jobs=24, seed=5)
+        b = topology_mix_trace(num_jobs=24, seed=5)
+        assert a == b
+        heavy = [t for t in a if t.comms_fraction >= 0.18]
+        filler = [t for t in a if t.model == "resnet50"]
+        assert heavy and filler
+        assert all(t.max_chips >= 16 for t in heavy)
+        assert all(t.max_chips <= 2 for t in filler)
+        assert all(t.comms_fraction == 0.04 for t in filler)
+
+    def test_philly_trace_carries_family_fractions(self):
+        from vodascheduler_tpu.replay.trace import philly_like_trace
+        trace = philly_like_trace(num_jobs=32, seed=3)
+        for t in trace:
+            assert t.comms_fraction == comms.fraction_for_category(t.model)
+
+
+class TestCliColumns:
+    def test_explain_renders_comms_and_priced_migration(self, capsys):
+        from vodascheduler_tpu.cli import _print_explain
+        payload = {"records": [
+            {"ts": 1.0, "seq": 3, "triggers": ["host_removed"],
+             "algorithm": "ElasticTiresias",
+             "deltas": [{"job": "j", "before": 4, "after": 4,
+                         "reasons": ["migrated"], "resize_seconds": 61.5,
+                         "comms": {"weight": 13, "contiguity": 2,
+                                   "score": 26}}]}]}
+        _print_explain("j", payload)
+        out = capsys.readouterr().out
+        assert "comms[w=13 contig=2 score=26]" in out
+        assert "in 61.5s" in out
+        assert "migrated" in out
+
+    def test_top_renders_placement_line(self, capsys):
+        from vodascheduler_tpu.cli import _print_top
+        records = [{"seq": 1, "duration_ms": 2.0, "decide_ms": 1.0,
+                    "actuate_ms": 1.0, "triggers": ["manual"], "jobs": [],
+                    "phases": {},
+                    "placement": {"jobs_cross_host": 3,
+                                  "contiguity_cost": 11,
+                                  "comms_score": 140}}]
+        _print_top(records)
+        out = capsys.readouterr().out
+        assert ("placement: jobs_cross_host=3 contiguity_cost=11 "
+                "comms_score=140") in out
+
+
+class TestHwbenchIci:
+    def test_ici_point_runs_on_cpu(self):
+        """The microbench runs on the 8-device virtual CPU mesh
+        (conftest) and emits the fields the link_gbps derivation
+        reads."""
+        from vodascheduler_tpu.runtime.hwbench import bench_ici_point
+        out = bench_ici_point(mbytes=0.5, k_small=1, k_big=3)
+        assert out["ring_size"] >= 2
+        assert out["ppermute_gbps"] > 0
+        assert out["allgather_gbps"] > 0
+        assert out["device_kind"]
+
+    def test_single_device_ring_refuses_to_fake_a_measurement(self):
+        """A 1-device ring has no collective: the point must error (a
+        tagged skipped row) rather than publish a bytes/second figure
+        for a transfer that never happened — which the capture script
+        would enshrine in doc/ici_measured.json as MEASURED."""
+        from vodascheduler_tpu.runtime.hwbench import bench_ici_point
+        with pytest.raises(RuntimeError, match=">= 2 devices"):
+            bench_ici_point(ring_size=1)

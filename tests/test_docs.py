@@ -181,6 +181,53 @@ class TestApisDoc:
             assert term in doc, f"concurrency-model term {term!r} missing"
 
 
+class TestPlacementDoc:
+    """doc/placement.md is pinned against the live comms model — both
+    directions, same pattern as the other contract docs."""
+
+    def _doc(self):
+        with open(os.path.join(REPO, "doc", "placement.md")) as f:
+            return f.read()
+
+    def test_every_family_profile_documented(self):
+        from vodascheduler_tpu.placement.comms import FAMILY_COLLECTIVES
+        doc = self._doc()
+        for family in FAMILY_COLLECTIVES:
+            assert f"`{family}`" in doc, f"family {family!r} undocumented"
+
+    def test_cost_model_contract_documented(self):
+        doc = self._doc()
+        for term in ("CollectiveProfile", "comms_fraction",
+                     "contiguity_cost", "spread", "host_diameter",
+                     "link_gbps", "ici_measured.json", "bench_ici_point",
+                     "ASSUMED_LINK_GBPS", "weight_for_category",
+                     "profile_for_job", "JobSpec.collectives",
+                     "comms_seconds_per_step", "sanity_check_families"):
+            assert term in doc, f"cost-model term {term!r} missing"
+
+    def test_objective_and_migration_pricing_documented(self):
+        doc = self._doc()
+        for term in ("VODA_PLACEMENT_COMMS", "VODA_MIGRATION_PAYBACK_SECONDS",
+                     "_pick_host", "_bind_hosts", "d / free_slots",
+                     "migration_deferred_unpaid", "resize_seconds",
+                     "payback", "VODA_PURE_PLACEMENT"):
+            assert term in doc, f"objective term {term!r} missing"
+        import vodascheduler_tpu.config as cfg
+        assert hasattr(cfg, "MIGRATION_PAYBACK_SECONDS")
+
+    def test_proof_and_surfacing_documented(self):
+        doc = self._doc()
+        for term in ("topology_mix_trace", "placement_comms_ab",
+                     "comms_penalty_mean", "detail.placement_comms",
+                     "placement_scoring", "voda explain", "voda top",
+                     "set_topology", "perf-gate"):
+            assert term in doc, f"proof/surfacing term {term!r} missing"
+
+    def test_cross_linked_from_observability(self):
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            assert "placement.md" in f.read()
+
+
 def _modelcheck_invariants():
     from vodascheduler_tpu.analysis import modelcheck
     return modelcheck.INVARIANTS

@@ -124,7 +124,7 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 3
+        assert base["schema"] == 4
         assert base["tool"] == "scripts/perf_scale.py"
         assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}
@@ -146,6 +146,13 @@ class TestCommittedBaseline:
             for required in ("allocate", "commit", "diff", "snapshot"):
                 assert required in curve["phases"], (curve["n_jobs"],
                                                     required)
+            # v4: the bandwidth-aware scoring probe (doc/placement.md)
+            # — the gate bounds its total so comms scoring can't eat
+            # the decide budget.
+            scoring = curve["placement_scoring"]
+            assert {"jobs", "weights_ms", "fleet_score_ms",
+                    "total_ms"} <= set(scoring)
+            assert scoring["jobs"] == curve["n_jobs"]
 
     def test_10k_decide_under_target(self):
         """The committed artifact itself pins the tentpole result: a

@@ -105,42 +105,65 @@ def test_bench_scenario_meets_targets():
     """Regression guard for the headline bench (bench.py): the r7 knee
     knobs (rate 20s / hysteresis 2.0 / cooldown 300s, config.py) with
     the headline spot-preemption schedule must clear BOTH halves of the
-    BASELINE metric. Guard values are measurements under CRITICAL-PATH
-    ACTUATION PRICING on top of two-tier resize pricing
-    (doc/elastic-resize.md): every pass now charges its slowest
-    actuation-wave member (the concurrent actuation plane's cost —
-    per-wave max, what a live parallel scheduler pays) against the next
-    rate-limit window, where every earlier sweep charged ZERO (replay
-    could reschedule infinitely fast). Starts price at the spawn round
-    trip only; resizes price at what genuinely blocks the caller (the
-    in-place ack / the cold checkpoint drain), so the knee slowed to
-    20 s and hardened suppression, and the headline moved from the
-    optimistic 0.8673 / 8,602 s to the honest 0.8709 / 10,133 s — a
-    cost-model correction, not a regression (the pre-wave SERIAL engine
-    would have priced 5,728 s of actuation vs 3,918 s critical-path).
-    Earlier guard values (0.8673/8,602 s under zero-cost passes;
-    0.8715/8,694 s under cold-only pricing; 0.9689/9,337 s at assumed
-    pricing) are not comparable. Sweep provenance:
-    scripts/replay_sweep.py, doc/replay_sweep_r7.json."""
+    BASELINE metric. Guard values are measurements under the
+    PLACEMENT-SENSITIVE STEP-TIME MODEL (doc/placement.md) on top of
+    critical-path actuation pricing and two-tier resize pricing: every
+    job's speedup is degraded by its collective traffic x host-set
+    spread (comms_fraction x topology.spread on the exponent), so the
+    same schedule now carries its modeled ICI cost — ~10.6% of fleet
+    throughput on this trace — and the headline moved from the
+    spread-blind 0.8709 / 10,133 s to the honest 0.8700 / 10,749.8 s. A
+    cost-model correction, not a regression, exactly like the r7
+    actuation-pricing move before it (0.8673/8,602 s zero-cost passes;
+    0.8715/8,694 s cold-only pricing are likewise not comparable).
+    Sweep provenance: scripts/replay_sweep.py,
+    doc/replay_sweep_r7.json."""
     _, h = _headline_harness(64, (4, 4, 4))
     r = h.run()
     assert r.completed == 64
     assert r.failed == 0, r                       # preemption kills no job
-    assert r.steady_state_utilization >= 0.86, r  # measured 0.8709
-    assert r.avg_jct_seconds <= 10_500.0, r       # measured 10,133.2 s
-    assert r.p95_jct_seconds <= 19_900.0, r       # measured 19,305.5 s
+    assert r.steady_state_utilization >= 0.86, r  # measured 0.8700
+    assert r.avg_jct_seconds <= 11_100.0, r       # measured 10,749.8 s
+    assert r.p95_jct_seconds <= 21_700.0, r       # measured 21,239.8 s
     assert r.steady_state_seconds > 0.5 * r.makespan_seconds, r
-    assert r.restarts_total <= 185, r             # measured 149
-    assert r.attainable_utilization >= 0.86, r    # measured 0.8736
+    assert r.restarts_total <= 185, r             # measured 143
+    assert r.attainable_utilization >= 0.86, r    # measured 0.8686
+    # The placement-sensitive model is actually pricing something:
+    # the headline's placements lose a nonzero, bounded share of
+    # modeled throughput to ICI spread (measured 0.1062).
+    assert 0.0 < r.comms_penalty_mean < 0.25, r
     # The resize-path mix must show the fast path actually firing: the
     # Philly mode is small (single-host) jobs, whose resizes stay on
     # their host and reshard in place.
     assert r.resizes_inplace_total > 0, r
     # The actuation plane's headline claim: the pass's priced cost is
     # the per-wave critical path, strictly cheaper than the serial sum
-    # the pre-wave engine paid (measured 3,918 vs 5,728 s).
+    # the pre-wave engine paid (measured 4,412 vs 5,367 s).
     assert 0 < r.actuation_critical_path_seconds \
         < r.actuation_serial_sum_seconds, r
+
+
+def test_topology_mix_comms_aware_beats_count_only():
+    """The tentpole's proof row (doc/placement.md "Proof", attached to
+    the bench artifact as detail.placement_comms): on the bimodal
+    topology-sensitive mix — long-lived small fillers fragmenting the
+    torus under wide elastic comms-heavy jobs, defragmentation on in
+    both arms — the comms-aware placement objective must beat the
+    count-only baseline (VODA_PLACEMENT_COMMS=0 semantics) on BOTH
+    modeled step time (busy-weighted comms penalty) and avg JCT, under
+    the SAME placement-sensitive physics. Measured at the pinned seed:
+    aware 5,874.2 s / penalty 0.1146 vs count-only 6,074.1 s / 0.1482
+    (3.3% JCT win, 23% less throughput lost to spread)."""
+    from vodascheduler_tpu.replay.compare import placement_comms_ab
+
+    rows = placement_comms_ab()
+    aware, count = rows["aware"], rows["count_only"]
+    assert aware["completed"] == count["completed"] == 48
+    assert aware["failed"] == count["failed"] == 0
+    assert aware["comms_penalty_mean"] < count["comms_penalty_mean"], rows
+    assert aware["avg_jct_s"] < count["avg_jct_s"], rows
+    assert rows["win"]["jct_ratio"] < 1.0, rows
+    assert rows["win"]["penalty_delta"] > 0.0, rows
 
 
 def _headline_harness(num_jobs: int, torus_dims: tuple,
@@ -171,18 +194,19 @@ def test_v5p128_scale_replay():
     """BASELINE config 5 names v5p-128: double the pool and the job
     count (+ the spot dip) and the whole control plane must still clear
     the north-star bars. Simulated time — runs in under a second.
-    Critical-path-actuation-pricing measurements (r7 knobs):
-    util 0.8505 / avg 8,165.7 s / p95 18,664.8 s. The steady-state
-    window is ~30% of makespan at this scale (the heavy tail drains
-    long after arrivals stop), so no ss_frac assertion here — the
-    64-job guard carries it."""
+    Placement-sensitive step-time measurements (r7 knobs + comms cost
+    model): util 0.8575 / avg 9,030.2 s / p95 20,253.4 s (spread-blind
+    r7 figures: 0.8505 / 8,165.7 / 18,664.8). The steady-state window
+    is ~30% of makespan at this scale (the heavy tail drains long
+    after arrivals stop), so no ss_frac assertion here — the 64-job
+    guard carries it."""
     _, h = _headline_harness(128, (4, 4, 8))
     r = h.run()
     assert r.completed == 128
     assert r.failed == 0, r
     assert r.steady_state_utilization >= 0.84, r
-    assert r.avg_jct_seconds <= 8_600.0, r
-    assert r.p95_jct_seconds <= 19_300.0, r
+    assert r.avg_jct_seconds <= 9_400.0, r
+    assert r.p95_jct_seconds <= 20_800.0, r
 
 
 def test_algorithm_compare_runs_all_registered():

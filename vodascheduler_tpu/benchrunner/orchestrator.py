@@ -301,6 +301,9 @@ def to_hardware_section(summary: Dict[str, Any]) -> Dict[str, Any]:
             out.setdefault("resize", []).append(entry(row, {
                 "model": spec.get("model_name"),
                 "batch": spec.get("global_batch_size")}))
+        elif section == "ici":
+            out.setdefault("ici", []).append(entry(row, {
+                "ring_size": spec.get("ring_size")}))
         else:
             out.setdefault("debug", []).append(entry(row, {
                 "point_id": row.get("point_id")}))

@@ -38,6 +38,7 @@ DEFAULT_TIMEOUTS: Dict[str, float] = {
     "attention": 900.0,  # two kernels (flash + XLA), fwd+bwd each
     "moe": 1800.0,       # four dispatch-variant compiles in one point
     "resize": 2400.0,    # two sequential children incl. a cold start
+    "ici": 600.0,        # two tiny collective compiles + scan timing
     "debug": 60.0,       # test scaffolding
 }
 
@@ -123,6 +124,7 @@ def default_registry(
         attention_points: Optional[Sequence[Tuple[int, int]]] = None,
         moe_batch: Optional[int] = 8,
         resize_points: Sequence[Tuple[str, int]] = (),
+        ici_points: Sequence[int] = (0,),
 ) -> List[BenchPoint]:
     """The production point set for bench.py's hardware section.
 
@@ -150,6 +152,11 @@ def default_registry(
         points.append(BenchPoint(
             f"moe:b{moe_batch}", "moe", {"global_batch_size": moe_batch},
             risk=40))
+    for ring in ici_points:
+        # The ICI collective microbench (placement/comms.py link_gbps
+        # derivation): small payloads, cheap compiles — low risk.
+        points.append(BenchPoint(
+            f"ici:r{ring}", "ici", {"ring_size": ring}, risk=5))
     for model, batch in resize_points:
         # Resize spawns its own chip-claiming children; it must run after
         # every in-process measurement has exited, i.e. last.

@@ -135,6 +135,16 @@ def _run_moe(spec: Mapping[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _run_ici(spec: Mapping[str, Any]) -> Dict[str, Any]:
+    """ICI collective microbench (placement/comms.py): ppermute /
+    all-gather bytes-per-second vs ring size — the measured grounding
+    for the placement cost model's per-hop link bandwidth."""
+    _configure_jax_platform()
+    _require_accelerator()
+    from vodascheduler_tpu.runtime.hwbench import bench_ici_point
+    return bench_ici_point(**spec)
+
+
 def _run_resize(spec: Mapping[str, Any]) -> Dict[str, Any]:
     # resize_bench spawns its own measurement children (a restart IS a
     # fresh process); they enforce the accelerator contract themselves.
@@ -166,6 +176,7 @@ _HANDLERS = {
     "attention": _run_attention,
     "moe": _run_moe,
     "resize": _run_resize,
+    "ici": _run_ici,
     "debug": _run_debug,
 }
 

@@ -264,6 +264,15 @@ def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None
         print("no profiled passes yet (ring empty; run or trigger a "
               "resched first)")
         return
+    placement = next((r["placement"] for r in reversed(records)
+                      if r.get("placement")), None)
+    if placement:
+        # Fleet placement columns (doc/placement.md): how spread out
+        # the pool is and what the comms-weighted objective scores it.
+        print(f"placement: jobs_cross_host="
+              f"{placement.get('jobs_cross_host', 0)} "
+              f"contiguity_cost={placement.get('contiguity_cost', 0)} "
+              f"comms_score={placement.get('comms_score', 0)}")
     print(f"scheduler profile over last {len(records)} pass(es):")
     per_phase = {}
     for rec in records:
@@ -310,7 +319,14 @@ def _print_explain(job: str, payload: dict, limit: int = 20) -> None:
         reasons = ",".join(delta.get("reasons", ()))
         extra = ""
         if "resize_seconds" in delta:
+            # For a `migrated` delta this is the PRICED resharding cost
+            # of the move (doc/placement.md "Priced migrations").
             extra = f" in {delta['resize_seconds']}s"
+        comms = delta.get("comms")
+        if comms:
+            extra += (f" comms[w={comms.get('weight')} "
+                      f"contig={comms.get('contiguity')} "
+                      f"score={comms.get('score')}]")
         print(f"  [{rec.get('ts', 0):.1f}] resched#{rec.get('seq')} "
               f"({'+'.join(rec.get('triggers', ()))}, "
               f"{rec.get('algorithm')}): "

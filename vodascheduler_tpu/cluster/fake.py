@@ -52,6 +52,13 @@ class WorkloadProfile:
     # chips -> speedup; missing counts interpolate via Amdahl-like power law
     speedup: Optional[Dict[int, float]] = None
     speedup_exponent: float = 0.9      # used when no explicit curve
+    # Share of a contiguously-placed step spent on ICI collectives
+    # (placement/comms.py CollectiveProfile.comms_fraction): with a
+    # topology installed (set_topology), the backend degrades the
+    # job's speedup by comms_fraction x the normalized spread of its
+    # host set — the placement-sensitive step-time model ROADMAP item 3
+    # asks for. 0.0 = placement-insensitive (the pre-comms model).
+    comms_fraction: float = 0.0
     fail_at_epoch: Optional[int] = None  # inject a failure
     # Checkpoint-restart pause for THIS workload (overrides the backend
     # default): restore + recompile scales with model size, so a ResNet
@@ -101,6 +108,10 @@ class _SimJob:
     generation: int = 0               # invalidates stale timers
     restarts: int = 0
     resizes_inplace: int = 0
+    # Normalized spread of the current host set (topology.spread),
+    # recomputed whenever placements change; degrades the speedup via
+    # the profile's comms_fraction (see _effective_speedup).
+    comms_spread: float = 0.0
 
     @property
     def total_serial(self) -> float:
@@ -144,6 +155,17 @@ class FakeClusterBackend(ClusterBackend):
             if inplace_overhead_seconds is None
             else inplace_overhead_seconds)
         self.hosts: Dict[str, int] = {}
+        # Placement-sensitive step-time model (ROADMAP item 3,
+        # doc/placement.md): when a topology is installed, a job's
+        # speedup is degraded by comms_fraction x spread(host set) —
+        # WHERE a job lands now moves its modeled step time. Off (None)
+        # by default so direct backend tests keep count-only physics.
+        self._topology = None
+        self._host_coords: Dict[str, Tuple[int, ...]] = {}
+        # ∫ chips x modeled step-time penalty dt: the fleet's comms
+        # loss, reported by replay as comms_penalty_mean (busy-weighted
+        # mean fraction of throughput lost to placement spread).
+        self.comms_penalty_chip_seconds: float = 0.0
         self.jobs: Dict[str, _SimJob] = {}
         self.profiles: Dict[str, WorkloadProfile] = {}
         self.default_profile = WorkloadProfile()
@@ -236,6 +258,39 @@ class FakeClusterBackend(ClusterBackend):
         total += (end - t_prev) * chips
         return total
 
+    def set_topology(self, topology) -> None:
+        """Install the pool torus (placement/topology.py PoolTopology):
+        host names resolve to grid coords and the step-time model
+        becomes placement-sensitive. The replay harness always installs
+        its topology; hermetic tests that want count-only physics
+        simply never call this."""
+        with self._state_lock:
+            self._topology = topology
+            self._host_coords = {topology.host_name(c): c
+                                 for c in topology.host_coords()}
+
+    def _spread_of(self, placements: List[Tuple[str, int]]) -> float:
+        """Normalized spread of a placement's host set; 0.0 without a
+        topology, an empty placement, or unknown host names."""
+        if self._topology is None or not placements:
+            return 0.0
+        coords = [self._host_coords[h] for h, n in placements
+                  if n > 0 and h in self._host_coords]
+        return self._topology.spread(coords)
+
+    def _effective_speedup(self, sim: _SimJob) -> float:
+        """The job's speedup at its current size AND placement: the
+        profile curve degraded by `comms_fraction x spread` on the
+        exponent — a contiguous block keeps (nearly) the ideal curve, a
+        scattered host set pays its collectives over long ICI paths
+        every step. Power-law form so explicit speedup curves degrade
+        consistently with exponent-modeled ones."""
+        base = sim.profile.speedup_at(sim.num_workers)
+        f = sim.profile.comms_fraction
+        if f <= 0.0 or sim.comms_spread <= 0.0 or base <= 1.0:
+            return base
+        return base ** (1.0 - f * sim.comms_spread)
+
     def list_hosts(self) -> Dict[str, int]:
         with self._state_lock:
             return dict(self.hosts)
@@ -298,6 +353,7 @@ class FakeClusterBackend(ClusterBackend):
                               placements=placements or [], last_update=now)
                 self.jobs[spec.name] = sim
                 self.metrics_rows.setdefault(spec.name, [])
+            sim.comms_spread = self._spread_of(sim.placements)
             sim.restarts += 1
             self.restarts_total += 1
             overhead = self._overhead(sim)
@@ -376,6 +432,7 @@ class FakeClusterBackend(ClusterBackend):
             sim.num_workers = num_workers
             if placements is not None:
                 sim.placements = placements
+            sim.comms_spread = self._spread_of(sim.placements)
             if inplace:
                 sim.resizes_inplace += 1
                 self.resizes_inplace_total += 1
@@ -418,6 +475,7 @@ class FakeClusterBackend(ClusterBackend):
             self._accrue(sim)
             sim.num_workers = 0
             sim.placements = []
+            sim.comms_spread = 0.0
             sim.generation += 1  # cancel pending timers
             # A halt's checkpoint drain is folded into the NEXT start's
             # restart overhead (that's where the sim charges it), so the
@@ -459,7 +517,7 @@ class FakeClusterBackend(ClusterBackend):
     def _rate(self, sim: _SimJob, at: float) -> float:
         if sim.num_workers <= 0 or at < sim.busy_until:
             return 0.0
-        return sim.profile.speedup_at(sim.num_workers)
+        return self._effective_speedup(sim)
 
     def _accrue(self, sim: _SimJob) -> None:
         """Bring progress up to now. Callers hold the state lock."""
@@ -467,9 +525,16 @@ class FakeClusterBackend(ClusterBackend):
         start = max(sim.last_update, sim.busy_until)
         if now > start and sim.num_workers > 0:
             dt = now - start
+            rate = self._effective_speedup(sim)
             sim.progress_serial = min(sim.total_serial,
-                                      sim.progress_serial + dt * sim.profile.speedup_at(sim.num_workers))
+                                      sim.progress_serial + dt * rate)
             self.busy_chip_seconds += dt * sim.num_workers
+            ideal = sim.profile.speedup_at(sim.num_workers)
+            if ideal > 0.0 and rate < ideal:
+                # Busy-weighted comms loss: chips x the fraction of
+                # throughput the placement's spread cost this window.
+                self.comms_penalty_chip_seconds += (
+                    dt * sim.num_workers * (1.0 - rate / ideal))
         sim.last_update = now
 
     def sync_accounting(self) -> None:
@@ -484,7 +549,7 @@ class FakeClusterBackend(ClusterBackend):
         """Schedule the next epoch-completion (or failure) timer."""
         if sim.num_workers <= 0:
             return
-        rate = sim.profile.speedup_at(sim.num_workers)
+        rate = self._effective_speedup(sim)
         if rate <= 0:
             return
         next_epoch = sim.epochs_done + 1
@@ -528,7 +593,7 @@ class FakeClusterBackend(ClusterBackend):
         # TPU includes restart pauses and partial epochs at the old size and
         # would pollute the learned speedup curves with spurious negative
         # marginal gains.
-        rate = sim.profile.speedup_at(sim.num_workers)
+        rate = self._effective_speedup(sim)
         clean_epoch_time = (sim.profile.epoch_seconds_at_1 / rate
                             if rate > 0 else now - sim.epoch_started_at)
         self.metrics_rows[sim.spec.name].append(MetricsRow(

@@ -165,6 +165,12 @@ class JobSpec:
     global_batch_size: int = 128
     steps_per_epoch: int = 100
     workdir: str = ""              # checkpoints + metrics CSVs live here
+    # Optional collective-traffic descriptor (doc/placement.md): keys
+    # from placement/comms.py CollectiveProfile (ring_bytes_per_chip,
+    # p2p_bytes_per_chip, allreduce_bytes_per_chip, comms_fraction).
+    # None = derive from the job's category's model family. Drives the
+    # bandwidth-aware placement objective and migration pricing.
+    collectives: Optional[Dict[str, float]] = None
     extra: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:

@@ -109,6 +109,17 @@ ADMISSION_RETRY_AFTER_SECONDS = _env_float(
 # they are cached on state/store version stamps and always exact.
 METRICS_CACHE_SECONDS = _env_float("VODA_METRICS_CACHE_SECONDS", "0")
 
+# Migration payback window (doc/placement.md): an optimization
+# migration (pure re-binding — same size, all hosts alive) fires only
+# when its modeled step-time win, earned over this many seconds of
+# continued running, repays the priced resharding cost (the family's
+# measured/assumed cold-restart cost). Three resize-cooldown windows by
+# default: a placement improvement the job won't keep long enough to
+# amortize is a restart for nothing. Forced migrations (host loss) are
+# never gated.
+MIGRATION_PAYBACK_SECONDS = _env_float(
+    "VODA_MIGRATION_PAYBACK_SECONDS", "900")
+
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the
 # checkpoint-restart path. Must cover the resharded step's XLA compile

@@ -50,6 +50,9 @@ REASON_CODES = frozenset({
     "scale_out",                 # n -> m, m > n
     "scale_in",                  # n -> m, 0 < m < n
     "migrated",                  # same size, host binding changed
+    "migration_deferred_unpaid",  # re-binding priced: modeled step-time win
+                                  # does not repay the resharding cost
+                                  # within the payback window
     "resize_inplace",            # the backend took the Tier-A live reshard
     "resize_cold",               # checkpoint-restart resize
     "hysteresis_suppressed",     # small grow clipped back to the old size
@@ -92,6 +95,7 @@ PHASE_NAMES = frozenset({
     "allocate",          # decide: the allocator.allocate call (incl. job-info fetch)
     "algorithm",         # decide: the pure scheduling algorithm + feasibility rounding (nested in allocate)
     "hysteresis",        # decide: scale-out suppression gate
+    "comms",             # decide: per-job comms-weight refresh + migration payback pricing
     "placement",         # decide: placement.place/defragment
     "hungarian",         # decide: the cold Hungarian assignment solve (nested in placement)
     "hungarian_warm",    # decide: warm-started incremental Hungarian re-solve (nested in placement)

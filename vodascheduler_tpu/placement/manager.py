@@ -51,6 +51,10 @@ class PlacementDecision:
     num_jobs_cross_host: int = 0
     total_contiguity_cost: int = 0
     workers_migrated: int = 0
+    # Fleet comms score: sum over jobs of comms_weight x contiguity cost
+    # — the integer objective the bandwidth-aware placement minimizes
+    # (doc/placement.md). 0 with comms scoring disabled or no weights.
+    total_comms_score: int = 0
 
 
 class PlacementManager:
@@ -58,11 +62,23 @@ class PlacementManager:
 
     def __init__(self, pool_id: str = "default",
                  topology: Optional[PoolTopology] = None,
-                 registry=None, fast_diff: Optional[bool] = None):
+                 registry=None, fast_diff: Optional[bool] = None,
+                 comms_enabled: Optional[bool] = None):
         self.pool_id = pool_id
         self.topology = topology
         self.host_states: Dict[str, HostState] = {}
         self.job_placements: Dict[str, JobPlacement] = {}
+        # --- bandwidth-aware placement (ROADMAP item 3) ---
+        # Integer per-job comms weights (placement/comms.py): the host
+        # pick and the defragment bind score candidate host sets by
+        # contiguity x weight. Empty map (or the VODA_PLACEMENT_COMMS=0
+        # count-only reference knob) reproduces the pre-comms decisions
+        # exactly — the A/B the bench's topology mix runs.
+        self.comms_enabled = (os.environ.get("VODA_PLACEMENT_COMMS") != "0"
+                              if comms_enabled is None
+                              else bool(comms_enabled))
+        self.comms_weights: Dict[str, int] = {}
+        self._comms_total = 0
         # --- decide-path fast kernels (ROADMAP item 2) ---
         # The incremental pass used to snapshot + re-diff + re-score
         # every job every pass (O(jobs) dict/list churn while the
@@ -156,6 +172,65 @@ class PlacementManager:
     def total_chips(self) -> int:
         return sum(h.total_slots for h in self.host_states.values())
 
+    # ---- comms weights (bandwidth-aware objective) -----------------------
+
+    def set_comms_weights(self, weights: Dict[str, int]) -> None:
+        """Install per-job integer comms weights (the scheduler derives
+        them from job categories each pass, memoized). Weights are
+        category-static in practice; if one DOES change for a job with
+        cached stats, the incremental comms total is patched in place so
+        the fast path's running total never drifts from the fleet sum."""
+        old = self.comms_weights
+        if self._caches_valid:
+            for job, w in weights.items():
+                prev = old.get(job, 0)
+                if prev != w and job in self._job_stats:
+                    self._comms_total += (w - prev) * self._job_stats[job][1]
+            for job, prev in old.items():
+                if job not in weights and job in self._job_stats:
+                    self._comms_total -= prev * self._job_stats[job][1]
+        self.comms_weights = dict(weights)
+
+    def _weight_of(self, job: str) -> int:
+        if not self.comms_enabled:
+            return 0
+        return self.comms_weights.get(job, 0)
+
+    def job_comms_stats(self, job: str) -> Optional[Tuple[int, int, int]]:
+        """(weight, contiguity cost, comms score) of one placed job —
+        the columns `voda explain` / `voda top` surface. None for jobs
+        with no placement."""
+        placement = self.job_placements.get(job)
+        if placement is None:
+            return None
+        if self._caches_valid and job in self._job_stats:
+            contig = self._job_stats[job][1]
+        else:
+            contig = self._job_stats_of(placement)[1]
+        weight = self._weight_of(job)
+        return weight, contig, weight * contig
+
+    def job_spread(self, job: str) -> float:
+        """Normalized spread of one job's CURRENT host set — sugar over
+        spread_of_pairs for introspection/tests. 0.0 without a topology
+        or placement."""
+        placement = self.job_placements.get(job)
+        if placement is None:
+            return 0.0
+        return self.spread_of_pairs(
+            [(hs.host, hs.num_slots) for hs in placement.host_slots])
+
+    def spread_of_pairs(self, pairs: List[Tuple[str, int]]) -> float:
+        """Normalized spread of an arbitrary (host, chips) binding —
+        prices a PROPOSED placement (the migration gate compares the
+        backend's live binding against this pass's target)."""
+        if self.topology is None:
+            return 0.0
+        coords = [self.host_states[h].coord for h, n in pairs
+                  if n > 0 and h in self.host_states
+                  and self.host_states[h].coord is not None]
+        return self.topology.spread(coords)
+
     # ---- the placement pass ----------------------------------------------
 
     def place(self, job_requests: ScheduleResult) -> PlacementDecision:
@@ -198,8 +273,8 @@ class PlacementManager:
         old_worker_hosts = {job: self._expand_workers(p)
                             for job, p in self.job_placements.items()}
         self._release_slots(job_requests)
-        cross, contiguity = self._place_incremental(job_requests)
-        return self._decision(old_worker_hosts, cross, contiguity)
+        cross, contiguity, comms = self._place_incremental(job_requests)
+        return self._decision(old_worker_hosts, cross, contiguity, comms)
 
     def _place_fast(self, job_requests: ScheduleResult) -> PlacementDecision:
         """The touched-set pass: copy-on-write snapshots at first
@@ -250,6 +325,7 @@ class PlacementManager:
         stats: Dict[str, Tuple[int, int]] = {}
         cross_total = 0
         contig_total = 0
+        comms_total = 0
         for job, placement in self.job_placements.items():
             view[job] = [(hs.host, hs.num_slots)
                          for hs in placement.host_slots]
@@ -257,10 +333,12 @@ class PlacementManager:
             stats[job] = (crossed, contig)
             cross_total += crossed
             contig_total += contig
+            comms_total += self._weight_of(job) * contig
         self._placements_view = view
         self._job_stats = stats
         self._cross_total = cross_total
         self._contig_total = contig_total
+        self._comms_total = comms_total
         self._caches_valid = True
 
     def _job_stats_of(self, placement: JobPlacement) -> Tuple[int, int]:
@@ -306,9 +384,10 @@ class PlacementManager:
                 continue
             my_hosts = [host_states[hs.host] for hs in placement.host_slots
                         if hs.host in host_states and hs.num_slots > 0]
+            weight = self._weight_of(job)
             while delta > 0:
                 best = self._pick_host(hosts, delta, my_hosts,
-                                       prefer_own=True)
+                                       prefer_own=True, weight=weight)
                 if best is None:
                     break  # tolerated inconsistency: place what fits
                 take = min(best.free_slots, delta)
@@ -341,6 +420,7 @@ class PlacementManager:
                 crossed, contig = stats.pop(job, (0, 0))
                 self._cross_total -= crossed
                 self._contig_total -= contig
+                self._comms_total -= self._weight_of(job) * contig
                 continue
             pairs = [(hs.host, hs.num_slots) for hs in placement.host_slots]
             view[job] = pairs
@@ -349,6 +429,7 @@ class PlacementManager:
             stats[job] = (crossed, contig)
             self._cross_total += crossed - old_crossed
             self._contig_total += contig - old_contig
+            self._comms_total += self._weight_of(job) * (contig - old_contig)
 
             new_hosts = self._expand_pairs(pairs)
             old_hosts = self._expand_pairs(old_pairs)
@@ -366,6 +447,7 @@ class PlacementManager:
             num_jobs_cross_host=self._cross_total,
             total_contiguity_cost=self._contig_total,
             workers_migrated=migrated,
+            total_comms_score=self._comms_total,
         )
 
     @staticmethod
@@ -392,10 +474,15 @@ class PlacementManager:
             logical = [HostState(name=f"TBD-{i}", total_slots=h.total_slots,
                                  coord=h.coord)
                        for i, h in enumerate(self._hosts_sorted())]
-            cross, contiguity = self._best_fit(job_requests, logical)
+            cross, contiguity, comms = self._best_fit(job_requests, logical)
             self._bind_hosts(logical)
             self._update_job_placements()
-            decision = self._decision(old_worker_hosts, cross, contiguity)
+            # The bind may have relabeled coords under the packed jobs:
+            # re-score contiguity/comms from the POST-bind world (the
+            # packed-on-logical stats would misprice any moved block).
+            cross, contiguity, comms = self._fleet_stats()
+            decision = self._decision(old_worker_hosts, cross, contiguity,
+                                      comms)
             # The repack rewrote the world: the fast path's incremental
             # view/stats rebuild on its next pass.
             self._caches_valid = False
@@ -408,8 +495,23 @@ class PlacementManager:
         self.m_full_restarts.set(len(decision.full_restarts))
         self.m_jobs_cross_host.set(decision.num_jobs_cross_host)
 
+    def _fleet_stats(self) -> Tuple[int, int, int]:
+        """(#jobs crossing hosts, total contiguity, total comms score)
+        over the whole current fleet — the post-bind re-score defragment
+        needs (the Hungarian relabel moves coords under packed jobs)."""
+        cross = 0
+        contiguity = 0
+        comms = 0
+        for job, placement in self.job_placements.items():
+            crossed, contig = self._job_stats_of(placement)
+            cross += crossed
+            contiguity += contig
+            comms += self._weight_of(job) * contig
+        return cross, contiguity, comms
+
     def _decision(self, old_worker_hosts: Dict[str, List[str]],
-                  cross: int, contiguity: int) -> PlacementDecision:
+                  cross: int, contiguity: int,
+                  comms: int = 0) -> PlacementDecision:
         migrations: Dict[str, List[int]] = {}
         full_restarts: List[str] = []
         migrated = 0
@@ -432,12 +534,14 @@ class PlacementManager:
             num_jobs_cross_host=cross,
             total_contiguity_cost=contiguity,
             workers_migrated=migrated,
+            total_comms_score=comms,
         )
 
-    def _place_incremental(self, job_requests: ScheduleResult) -> Tuple[int, int]:
+    def _place_incremental(self, job_requests: ScheduleResult
+                           ) -> Tuple[int, int, int]:
         """Pack only growth deltas and new jobs into current free slots.
-        Returns (#jobs crossing hosts, total contiguity cost) over ALL
-        placed jobs."""
+        Returns (#jobs crossing hosts, total contiguity cost, total
+        comms score) over ALL placed jobs."""
         hosts = self._hosts_sorted()
         # Biggest demand first, like _best_fit.
         for job, requested in sorted(job_requests.items(),
@@ -451,9 +555,10 @@ class PlacementManager:
                 continue  # pinned: same size (or release already trimmed it)
             my_hosts = [self.host_states[hs.host] for hs in placement.host_slots
                         if hs.host in self.host_states and hs.num_slots > 0]
+            weight = self._weight_of(job)
             while delta > 0:
                 best = self._pick_host(hosts, delta, my_hosts,
-                                       prefer_own=True)
+                                       prefer_own=True, weight=weight)
                 if best is None:
                     break  # tolerated inconsistency: place what fits
                 take = min(best.free_slots, delta)
@@ -472,18 +577,7 @@ class PlacementManager:
                 del self.job_placements[job]
 
         # Stats over the whole fleet.
-        cross = 0
-        contiguity = 0
-        for placement in self.job_placements.values():
-            used = {hs.host for hs in placement.host_slots if hs.num_slots > 0}
-            if len(used) > 1:
-                cross += 1
-                if self.topology is not None:
-                    coords = [self.host_states[h].coord for h in used
-                              if h in self.host_states
-                              and self.host_states[h].coord is not None]
-                    contiguity += self.topology.contiguity_cost(coords)
-        return cross, contiguity
+        return self._fleet_stats()
 
     # ---- step 1: release (reference :337-411) ----------------------------
 
@@ -527,25 +621,28 @@ class PlacementManager:
         return sorted(self.host_states.values(), key=lambda h: h.name)
 
     def _best_fit(self, job_requests: ScheduleResult,
-                  hosts: List[HostState]) -> Tuple[int, int]:
+                  hosts: List[HostState]) -> Tuple[int, int, int]:
         """Pack requests onto empty logical hosts. Returns (#jobs crossing
-        hosts, total contiguity cost)."""
+        hosts, total contiguity cost, total comms score)."""
         requests = sorted(job_requests.items(), key=lambda kv: kv[1],
                           reverse=True)
         total_free = sum(h.total_slots for h in hosts)
         cross_host = 0
         total_contiguity = 0
+        total_comms = 0
 
         for job, requested in requests:
             remaining = requested
             my_hosts: List[HostState] = []
+            weight = self._weight_of(job)
             while remaining > 0:
                 if total_free == 0:
                     # Tolerated inconsistency with the scheduler's capacity
                     # view (reference :433-454): place what fits, never
                     # crash.
                     break
-                best = self._pick_host(hosts, remaining, my_hosts)
+                best = self._pick_host(hosts, remaining, my_hosts,
+                                       weight=weight)
                 if best is None:
                     break
                 take = min(best.free_slots, remaining)
@@ -558,19 +655,38 @@ class PlacementManager:
                 cross_host += 1
                 if self.topology is not None:
                     coords = [h.coord for h in my_hosts if h.coord is not None]
-                    total_contiguity += self.topology.contiguity_cost(coords)
-        return cross_host, total_contiguity
+                    contig = self.topology.contiguity_cost(coords)
+                    total_contiguity += contig
+                    total_comms += weight * contig
+        return cross_host, total_contiguity, total_comms
 
     def _pick_host(self, hosts: List[HostState], requested: int,
                    my_hosts: List[HostState],
-                   prefer_own: bool = False) -> Optional[HostState]:
-        """Best-fit with ICI tie-breaking.
+                   prefer_own: bool = False,
+                   weight: int = 0) -> Optional[HostState]:
+        """Best-fit with ICI tie-breaking — comms-weighted when the job
+        carries a communication weight.
 
         Reference semantics (:456-480): prefer the host with the *fewest*
         free slots still >= requested (consolidation); if none fits, spill
         onto the host with the most free slots. TPU delta: among candidates
         of equal free-slot count, prefer the one closest (torus distance)
         to hosts the job already occupies.
+
+        Bandwidth-aware delta (ROADMAP item 3, doc/placement.md): for a
+        job with comms weight > 0 that already has an anchor, contiguity
+        leads instead of tie-breaking:
+          - fitting: take the CLOSEST host that fits the whole delta
+            (free-slot tightness demoted to the tie-break — the job's
+            collectives pay hops every step, the packing looseness is
+            someone else's future problem);
+          - spill: minimize hop distance per chip obtained (d / free):
+            a near fragment beats a far empty host only when its
+            per-chip hop cost is genuinely lower, so the job neither
+            scatters across far empties nor shatters into fragments.
+        Weight 0 (or comms scoring disabled) reduces exactly to the
+        count-only pick in both branches, making VODA_PLACEMENT_COMMS=0
+        a true reference path.
 
         `prefer_own` (the incremental grow path): when a host the job
         already occupies can absorb the whole remaining delta, take it —
@@ -586,12 +702,40 @@ class PlacementManager:
                 return min(own, key=lambda h: h.free_slots)
         fitting = [h for h in hosts if h.free_slots >= requested]
         if fitting:
+            if (weight > 0 and self.comms_enabled
+                    and self.topology is not None and my_hosts):
+                anchor = [h.coord for h in my_hosts if h.coord is not None]
+                if anchor:
+                    topology = self.topology
+
+                    def cost(h: HostState):
+                        d = (sum(topology.host_distance(h.coord, a)
+                                 for a in anchor)
+                             if h.coord is not None else 1 << 30)
+                        return (d, h.free_slots)
+
+                    # min() is first-wins on ties: same deterministic
+                    # list-order tie-break as the count-only path.
+                    return min(fitting, key=cost)
             best_free = min(h.free_slots for h in fitting)
             candidates = [h for h in fitting if h.free_slots == best_free]
         else:
             nonempty = [h for h in hosts if h.free_slots > 0]
             if not nonempty:
                 return None
+            if (weight > 0 and self.comms_enabled
+                    and self.topology is not None and my_hosts):
+                anchor = [h.coord for h in my_hosts if h.coord is not None]
+                if anchor:
+                    topology = self.topology
+
+                    def spill_score(h: HostState):
+                        d = (sum(topology.host_distance(h.coord, a)
+                                 for a in anchor)
+                             if h.coord is not None else 1 << 30)
+                        return (d / h.free_slots, -h.free_slots, d)
+
+                    return min(nonempty, key=spill_score)
             max_free = max(h.free_slots for h in nonempty)
             candidates = [h for h in nonempty if h.free_slots == max_free]
         if len(candidates) > 1 and self.topology is not None and my_hosts:
@@ -610,6 +754,42 @@ class PlacementManager:
         if n == 0:
             return
         score = [[self._overlap(lg, ph) for ph in physical] for lg in logical]
+        # Comms-weighted bind (doc/placement.md): _best_fit packed jobs
+        # contiguously on logical hosts whose coords mirror the sorted
+        # physical fleet; a bind that relabels a logical host far from
+        # its packed coord tears that contiguity up again. Score each
+        # (logical, physical) pair as
+        #     int(overlap) * STAY - comms_load(lg) * hop(lg, ph)
+        # with STAY strictly greater than any achievable penalty, so
+        # stay-put workers remain the primary objective (migration
+        # minimization — the reference's contract) and the comms term
+        # breaks ties among equally-stay-put optima toward bindings
+        # that keep comms-heavy blocks where they were packed. All
+        # integer, so the canonical lex-min extraction and warm-start
+        # theorems (hungarian.py) keep holding; with comms disabled or
+        # no weights the matrix is the raw overlap — bit-identical to
+        # the count-only bind.
+        if (self.comms_enabled and self.topology is not None
+                and self.comms_weights):
+            topology = self.topology
+            loads = [sum(self._weight_of(job)
+                         for job in lg.job_num_workers) for lg in logical]
+            max_penalty = max(loads, default=0) * topology.host_diameter
+            if max_penalty > 0:
+                # Dominance must hold per ASSIGNMENT, not per cell: the
+                # solver compares total scores, and n rows can each pay
+                # up to max_penalty — a stay = max_penalty + 1 scale
+                # would let summed comms penalties outbid a stay-put
+                # worker (one extra migration to save hops, the exact
+                # trade the primary objective forbids).
+                stay = len(logical) * max_penalty + 1
+                score = [
+                    [int(score[i][j]) * stay
+                     - (loads[i] * topology.host_distance(lg.coord, ph.coord)
+                        if lg.coord is not None and ph.coord is not None
+                        else 0)
+                     for j, ph in enumerate(physical)]
+                    for i, lg in enumerate(logical)]
         # Warm-started canonical assignment: duals + matching carried
         # from the previous defragment; only rows whose overlap vector
         # changed re-solve (canonical extraction guarantees the result

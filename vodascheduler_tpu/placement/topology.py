@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import math
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,6 +278,35 @@ class PoolTopology:
         return sum(self.host_distance(a, b)
                    for i, a in enumerate(coords) for b in coords[i + 1:])
 
+    @property
+    def host_diameter(self) -> int:
+        """Longest torus distance between any two hosts (each axis wraps,
+        so the farthest point sits half-way around every dimension)."""
+        return sum(d // 2 for d in self.host_grid)
+
+    def mean_hop_distance(self, coords: Iterable[Tuple[int, ...]]) -> float:
+        """Mean pairwise inter-host hop distance of a host set — the
+        per-collective-byte hop multiplier the comms cost model prices
+        (placement/comms.py). 0.0 for zero or one host."""
+        coords = list(coords)
+        k = len(coords)
+        if k <= 1:
+            return 0.0
+        return self.contiguity_cost(coords) / (k * (k - 1) / 2.0)
+
+    def spread(self, coords: Iterable[Tuple[int, ...]]) -> float:
+        """Normalized placement spread in [0, 1]: mean pairwise hop
+        distance over the torus diameter. 0 = single host (all traffic
+        intra-host); an adjacent block pays its real (small) inter-host
+        hops; 1 = hosts scattered at maximal distance. The replay
+        simulator degrades a job's speedup exponent by
+        `comms_fraction * spread` (cluster/fake.py), and the migration
+        payback gate prices a move by the spread delta it buys."""
+        diameter = self.host_diameter
+        if diameter <= 0:
+            return 0.0
+        return min(1.0, self.mean_hop_distance(coords) / diameter)
+
     def slice_for(self, num_chips: int) -> Optional[SliceShape]:
         """Best contiguous shape for num_chips on this torus, if any."""
         shapes = feasible_shapes(num_chips, self.torus_dims)
@@ -291,10 +320,21 @@ class PoolTopology:
 
     @staticmethod
     def parse(s: str) -> "PoolTopology":
+        """Parse "4x4x4/2x2x1" (torus dims / host block). A bare torus
+        with no "/block" part defaults to 1-chip hosts (every chip its
+        own host block) — previously this raised a bare int("")
+        ValueError. Malformed dims get a clear message instead."""
         torus, _, block = s.partition("/")
-        return PoolTopology(
-            torus_dims=tuple(int(d) for d in torus.split("x")),
-            host_block=tuple(int(d) for d in block.split("x")))
+        try:
+            torus_dims = tuple(int(d) for d in torus.split("x"))
+            host_block = (tuple(int(d) for d in block.split("x"))
+                          if block else (1,) * len(torus_dims))
+        except ValueError:
+            raise ValueError(
+                f"invalid topology {s!r}: expected "
+                f"'<d>x<d>x...[/<b>x<b>x...]', e.g. '4x4x4/2x2x1'"
+            ) from None
+        return PoolTopology(torus_dims=torus_dims, host_block=host_block)
 
 
 def default_pool(num_hosts: int, chips_per_host: int = 4) -> PoolTopology:

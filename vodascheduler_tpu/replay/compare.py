@@ -25,7 +25,10 @@ from vodascheduler_tpu.replay.simulator import (
     ReplayReport,
     config5_preemptions,
 )
-from vodascheduler_tpu.replay.trace import philly_like_trace
+from vodascheduler_tpu.replay.trace import (
+    philly_like_trace,
+    topology_mix_trace,
+)
 
 
 def compare_algorithms(
@@ -60,6 +63,53 @@ def compare_algorithms(
             preemptions=events)
         reports.append(harness.run())
     return reports
+
+
+def placement_comms_ab(
+    num_jobs: int = 48,
+    seed: int = 20260803,
+    algorithm: str = "ElasticTiresias",
+    torus_dims: tuple = (4, 4, 4),
+    defrag_cross_host_threshold: int = 3,
+) -> Dict[str, Dict[str, object]]:
+    """The topology-sensitive A/B (doc/placement.md "Proof"): replay the
+    bimodal topology mix twice — comms-aware placement objective ON vs
+    the count-only baseline (VODA_PLACEMENT_COMMS=0 semantics) — under
+    the SAME placement-sensitive step-time model, same trace, same pool,
+    same knobs. Defragmentation is on in both arms (threshold 3), so
+    the run also prices consolidation migrations: the aware arm
+    payback-gates each re-binding against its resharding cost and binds
+    with the comms-weighted Hungarian; the count-only arm fires every
+    re-binding and binds on stay-put overlap alone. Returns
+    {"aware": row, "count_only": row, "win": ...}; bench.py attaches it
+    as detail.placement_comms and the tier-1 guard pins that aware
+    beats count-only on modeled step-time penalty AND avg JCT."""
+    rows: Dict[str, Dict[str, object]] = {}
+    for label, enabled in (("aware", True), ("count_only", False)):
+        trace = topology_mix_trace(num_jobs=num_jobs, seed=seed)
+        topology = PoolTopology(torus_dims=torus_dims, host_block=(2, 2, 1))
+        harness = ReplayHarness(
+            trace, algorithm=algorithm, topology=topology,
+            placement_comms=enabled,
+            defrag_cross_host_threshold=defrag_cross_host_threshold)
+        r = harness.run()
+        rows[label] = {
+            "avg_jct_s": round(r.avg_jct_seconds, 1),
+            "p95_jct_s": round(r.p95_jct_seconds, 1),
+            "comms_penalty_mean": r.comms_penalty_mean,
+            "steady_state_util": round(r.steady_state_utilization, 4),
+            "completed": r.completed,
+            "failed": r.failed,
+            "restarts": r.restarts_total,
+        }
+    aware, count = rows["aware"], rows["count_only"]
+    rows["win"] = {
+        "jct_ratio": round(aware["avg_jct_s"] / count["avg_jct_s"], 4)
+        if count["avg_jct_s"] else 1.0,
+        "penalty_delta": round(count["comms_penalty_mean"]
+                               - aware["comms_penalty_mean"], 4),
+    }
+    return rows
 
 
 def as_rows(reports: Sequence[ReplayReport]) -> List[Dict[str, object]]:

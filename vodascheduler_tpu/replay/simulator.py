@@ -67,6 +67,13 @@ class ReplayReport:
     # price_actuation).
     actuation_critical_path_seconds: float = 0.0
     actuation_serial_sum_seconds: float = 0.0
+    # Placement-sensitive step-time model (doc/placement.md): the
+    # busy-weighted mean fraction of throughput lost to placement
+    # spread (0 = every job ran contiguously), and whether the
+    # comms-aware placement objective was on for this run — the A/B
+    # axis the bench's topology-sensitive mix reports.
+    comms_penalty_mean: float = 0.0
+    placement_comms: bool = True
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -124,6 +131,20 @@ class ReplayHarness:
         preemptions: Sequence[PreemptionEvent] = (),
         start_epoch: float = 1753760000.0,
         tracer: Optional[obs_tracer.Tracer] = None,
+        # Comms-aware placement objective (doc/placement.md): None =
+        # the environment default (VODA_PLACEMENT_COMMS, on unless 0);
+        # False forces the count-only reference placement — the A/B
+        # baseline the bench's topology mix runs. The SIMULATOR's
+        # placement-sensitive step-time model stays on either way
+        # (physics is not a policy knob), so both arms are judged
+        # under the same cost model.
+        placement_comms: Optional[bool] = None,
+        # Scheduler defragmentation threshold (full repack + Hungarian
+        # bind once this many jobs span hosts; 0 = off, the production
+        # default). The topology mix enables it so the A/B also prices
+        # consolidation migrations: the aware arm payback-gates them,
+        # the count-only arm fires every re-binding.
+        defrag_cross_host_threshold: int = 0,
     ):
         self.trace = list(trace)
         self.algorithm = algorithm
@@ -154,8 +175,15 @@ class ReplayHarness:
 
         self.topology = topology or PoolTopology(torus_dims=(4, 4, 4),
                                                  host_block=(2, 2, 1))
-        pm = PlacementManager(pool, topology=self.topology)
+        pm = PlacementManager(pool, topology=self.topology,
+                              comms_enabled=placement_comms)
+        self.placement_comms = pm.comms_enabled
         pm.add_hosts_from_topology(self.topology)
+        # Placement-sensitive physics: the backend degrades each job's
+        # speedup by its comms fraction x host-set spread, so placement
+        # quality moves modeled step time (and the placements the
+        # scheduler hands to start/scale are no longer cosmetic).
+        self.backend.set_topology(self.topology)
         for coord in self.topology.host_coords():
             self.backend.add_host(self.topology.host_name(coord),
                                   self.topology.chips_per_host, announce=False)
@@ -171,6 +199,7 @@ class ReplayHarness:
                 config.RESIZE_COOLDOWN_SECONDS
                 if resize_cooldown_seconds is None
                 else resize_cooldown_seconds),
+            defrag_cross_host_threshold=defrag_cross_host_threshold,
             tracer=self.tracer,
             # A live pass occupies real time while its actuation waves
             # run; under the VirtualClock it would occupy none, letting
@@ -355,4 +384,9 @@ class ReplayHarness:
                 self.scheduler.actuation_critical_path_seconds_total, 1),
             actuation_serial_sum_seconds=round(
                 self.scheduler.actuation_serial_sum_seconds_total, 1),
+            comms_penalty_mean=round(
+                self.backend.comms_penalty_chip_seconds
+                / self.backend.busy_chip_seconds, 4)
+            if self.backend.busy_chip_seconds > 0 else 0.0,
+            placement_comms=self.placement_comms,
         )

@@ -37,6 +37,12 @@ class TraceJob:
     # Tier-A (in-place) resize cost for this job; None falls back to the
     # backend default (restart_costs.default_inplace_seconds in replay).
     inplace_overhead_seconds: Optional[float] = None
+    # Share of a contiguously-placed step spent on ICI collectives
+    # (placement/comms.py FAMILY_COLLECTIVES): the simulator degrades
+    # the speedup exponent by this x the job's placement spread, so
+    # WHERE the job lands moves its modeled step time. 0.0 keeps the
+    # job placement-insensitive (old traces load unchanged).
+    comms_fraction: float = 0.0
 
     def job_spec(self, pool: str) -> JobSpec:
         return JobSpec(
@@ -50,6 +56,7 @@ class TraceJob:
         return WorkloadProfile(
             epoch_seconds_at_1=self.epoch_seconds_at_1,
             speedup_exponent=self.speedup_exponent,
+            comms_fraction=self.comms_fraction,
             fail_at_epoch=self.fail_at_epoch,
             restart_overhead_seconds=self.restart_overhead_seconds,
             inplace_overhead_seconds=self.inplace_overhead_seconds)
@@ -90,6 +97,7 @@ def philly_like_trace(
       range (Philly mode is small jobs; LLM families claim large slices)
     - duration: log-normal heavy tail on epoch count
     """
+    from vodascheduler_tpu.placement.comms import fraction_for_category
     from vodascheduler_tpu.replay.restart_costs import family_restart_costs
 
     rng = random.Random(seed)
@@ -136,6 +144,66 @@ def philly_like_trace(
             fail_at_epoch=fail_at,
             restart_overhead_seconds=restart_costs[model].restart_s,
             inplace_overhead_seconds=restart_costs[model].inplace_s,
+            comms_fraction=fraction_for_category(model),
+        ))
+    return jobs
+
+
+def topology_mix_trace(
+    num_jobs: int = 48,
+    seed: int = 20260803,
+    arrival_rate_per_hour: float = 40.0,
+    heavy_fraction: float = 0.4,
+) -> List[TraceJob]:
+    """The topology-sensitive workload mix (doc/placement.md): a bimodal
+    stream where placement quality — not just host count — moves JCT.
+
+    Two populations interleave:
+      - filler: small short resnet50 jobs (1-2 chips, comms-light) that
+        churn through the pool, punching free-slot fragments into the
+        torus as they complete;
+      - heavy: wide elastic llama8b/mixtral jobs (8-32 chips,
+        comms_fraction 0.18-0.25) whose collectives pay for every hop
+        between their hosts.
+
+    On a fragmented torus the count-only best-fit sends a heavy job's
+    growth to the TIGHTEST fragment wherever it sits; the comms-aware
+    objective trades that packing tightness for contiguity in proportion
+    to the job's per-step traffic. Replaying this mix with the objective
+    on vs off (ReplayHarness placement_comms) under the SAME
+    placement-sensitive step-time model is the bench's A/B proof row.
+    """
+    from vodascheduler_tpu.placement.comms import fraction_for_category
+    from vodascheduler_tpu.replay.restart_costs import family_restart_costs
+
+    rng = random.Random(f"{seed}-topomix")
+    restart_costs = family_restart_costs()
+    jobs: List[TraceJob] = []
+    t = 0.0
+    for _ in range(num_jobs):
+        t += rng.expovariate(arrival_rate_per_hour / 3600.0)
+        if rng.random() < heavy_fraction:
+            model = rng.choice(("llama8b", "mixtral"))
+            max_chips = rng.choice((16, 32))
+            min_chips = max(8, max_chips // 4)
+            epochs = rng.randint(4, 8)
+        else:
+            model = "resnet50"
+            max_chips = rng.choice((1, 2, 2))
+            min_chips = 1
+            epochs = rng.randint(4, 12)
+        fam = MODEL_FAMILIES[model]
+        jobs.append(TraceJob(
+            submit_offset_seconds=t,
+            model=model,
+            min_chips=min_chips,
+            max_chips=max_chips,
+            epochs=epochs,
+            epoch_seconds_at_1=float(fam["epoch_seconds"]),
+            speedup_exponent=float(fam["exponent"]),
+            restart_overhead_seconds=restart_costs[model].restart_s,
+            inplace_overhead_seconds=restart_costs[model].inplace_s,
+            comms_fraction=fraction_for_category(model),
         ))
     return jobs
 

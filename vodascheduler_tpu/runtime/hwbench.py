@@ -370,14 +370,98 @@ def bench_moe_dispatch(global_batch_size: int = 8,
     return out
 
 
+def bench_ici_point(ring_size: int = 0, mbytes: float = 64.0,
+                    k_small: int = 2, k_big: int = 10) -> Dict[str, Any]:
+    """ICI collective microbench: ppermute and all-gather bytes/second
+    around a ring of `ring_size` devices (0 = every visible device).
+
+    Grounds the placement comms-cost model (placement/comms.py): the
+    per-hop link bandwidth `link_gbps()` prices placements with is
+    derived from these points when doc/ici_measured.json carries them
+    (the restart_costs derivation idiom — measured, not assumed). Each
+    measured iteration is one ring ppermute (and one all-gather) of a
+    per-device payload, timed by the same two-point scan differencing
+    as every other hwbench number, so dispatch overhead cancels.
+    """
+    devices = jax.devices()
+    n = len(devices) if ring_size <= 0 else min(ring_size, len(devices))
+    if n < 2:
+        # A 1-device "ring" has no collective: both bodies reduce to a
+        # no-op and the timing would publish a plausible-looking
+        # bytes/second figure for a transfer that never happened —
+        # which capture_tpu_evidence.sh would then enshrine as the
+        # MEASURED per-hop bandwidth. Error instead (per-point
+        # isolation turns this into a tagged skipped/error row).
+        raise RuntimeError(
+            f"ICI microbench needs >= 2 devices to form a ring "
+            f"(have {len(devices)}, requested ring_size={ring_size})")
+    per_device = int(mbytes * 1e6) // 4  # f32 elements
+    mesh = jax.sharding.Mesh(np.array(devices[:n]), ("ring",))
+    try:  # jax >= 0.6 (replication check kwarg renamed along the way)
+        _shard_map_raw = jax.shard_map
+        _replication_kwargs = {"check_vma": False}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as _shard_map_raw
+        _replication_kwargs = {"check_rep": False}
+
+    def _shard_map(fn, **kwargs):
+        return _shard_map_raw(fn, **kwargs, **_replication_kwargs)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jax.device_put(
+        jnp.ones((n, per_device), dtype=jnp.float32),
+        NamedSharding(mesh, P("ring", None)))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out: Dict[str, Any] = {"ring_size": n,
+                           "mbytes_per_device": round(per_device * 4 / 1e6, 1),
+                           "device_kind": jax.devices()[0].device_kind}
+    for name, body in (
+            ("ppermute",
+             lambda b: jax.lax.ppermute(b, "ring", perm) if n > 1 else b),
+            ("allgather",
+             lambda b: (jax.lax.all_gather(b, "ring")[0]
+                        if n > 1 else b))):
+        def make_scanned(k, body=body):
+            def local_fn(block):
+                def step(carry, _):
+                    # Data-dependent perturbation: XLA must not hoist
+                    # the collective out of the scan as loop-invariant.
+                    nxt = body(block * (1.0 + carry * 0.0))
+                    return jnp.float32(nxt.ravel()[0]), None
+                final, _ = jax.lax.scan(step, jnp.float32(0.0), None,
+                                        length=k)
+                return final[None]
+
+            fn = jax.jit(_shard_map(
+                local_fn, mesh=mesh, in_specs=(P("ring", None),),
+                out_specs=P("ring")))
+            return lambda: fn(x)[0]
+
+        it_s = time_per_iteration(make_scanned, k_small=k_small,
+                                  k_big=k_big)
+        # Bytes past one device per iteration: the payload it ships to
+        # its ring neighbor (all-gather ships the same payload n-1 hops,
+        # normalized back to the single-hop figure for comparability).
+        hops = 1 if name == "ppermute" else max(1, n - 1)
+        out[f"{name}_gbps"] = round(
+            per_device * 4 * hops / it_s / 1e9, 3)
+        out[f"{name}_ms"] = round(it_s * 1e3, 4)
+    return out
+
+
 DEFAULT_ATTENTION_POINTS: Sequence[Tuple[int, int]] = (
     (8, 1024), (4, 2048), (2, 4096), (1, 8192))
+
+
+DEFAULT_ICI_POINTS: Sequence[int] = (0,)  # 0 = ring over every device
 
 
 def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
         ("llama_350m", 8),),
         attention_points: Sequence[Tuple[int, int]] = DEFAULT_ATTENTION_POINTS,
         moe_batch: Optional[int] = 8,
+        ici_points: Sequence[int] = DEFAULT_ICI_POINTS,
         emit: Optional[Callable[[str, Any], None]] = None,
         ) -> Dict[str, Any]:
     """The full hardware section in ONE process (standalone mode).
@@ -443,6 +527,15 @@ def run_hardware_bench(model_points: Sequence[Tuple[str, int]] = (
                 "batch": bsz, "seq": seq,
                 "error": f"{type(e).__name__}: {e}"})
         emit("attention", out["attention"][-1])
+    for ring in ici_points:
+        # The ICI microbench (placement/comms.py link_gbps derivation):
+        # per-point isolation like every other section.
+        try:
+            out.setdefault("ici", []).append(bench_ici_point(ring))
+        except Exception as e:  # noqa: BLE001
+            out.setdefault("ici", []).append({
+                "ring_size": ring, "error": f"{type(e).__name__}: {e}"})
+        emit("ici", out["ici"][-1])
     if moe_batch:
         try:
             out["moe"] = bench_moe_dispatch(moe_batch)

@@ -167,6 +167,18 @@ class Scheduler:
         # migrations. 0 disables defragmentation.
         self.defrag_cross_host_threshold = defrag_cross_host_threshold
         self._last_cross_host = 0
+        # Bandwidth-aware placement (ROADMAP item 3, doc/placement.md):
+        # per-job integer comms weights (memoized by name — weights are
+        # category-static) handed to the placement manager each pass,
+        # and the last pass's fleet placement totals for the perf
+        # record / `voda top`. Migration payback gating prices a pure
+        # re-binding against the family's resharding cost over this
+        # window; per-category cost memo below.
+        self.migration_payback_seconds = config.MIGRATION_PAYBACK_SECONDS
+        self._comms_weight: Dict[str, int] = {}
+        self._last_contiguity_cost = 0
+        self._last_comms_score = 0
+        self._migration_cost_cache: Dict[str, float] = {}
         self._last_resize_at: Dict[str, float] = {}
         # Jobs needing re-placement after host churn even if their chip
         # count is unchanged (e.g. their host died).
@@ -982,13 +994,18 @@ class Scheduler:
             placed = False
             if ((changed or self._placement_dirty)
                     and self.placement_manager is not None):
+                requests = {j: n for j, n in self.job_num_chips.items()
+                            if n > 0}
+                # Draining deletions keep their host slots until the
+                # backend released them (phantom same-size requests:
+                # _release_slots leaves an unchanged request alone).
+                requests.update(reserved)
+                with prof.phase("comms"):
+                    # Per-job comms weights for the bandwidth-aware
+                    # objective (memoized; a steady-state pass costs
+                    # its NEW jobs, not the fleet).
+                    self._refresh_comms_weights(requests)
                 with prof.phase("placement"):
-                    requests = {j: n for j, n in self.job_num_chips.items()
-                                if n > 0}
-                    # Draining deletions keep their host slots until the
-                    # backend released them (phantom same-size requests:
-                    # _release_slots leaves an unchanged request alone).
-                    requests.update(reserved)
                     if (self.defrag_cross_host_threshold > 0
                             and self._last_cross_host
                             >= self.defrag_cross_host_threshold):
@@ -997,6 +1014,9 @@ class Scheduler:
                     else:
                         decision = self.placement_manager.place(requests)
                     self._last_cross_host = decision.num_jobs_cross_host
+                    self._last_contiguity_cost = \
+                        decision.total_contiguity_cost
+                    self._last_comms_score = decision.total_comms_score
                     placements = decision.placements
                     placed = True
                     self._placement_dirty = False
@@ -1174,13 +1194,135 @@ class Scheduler:
             bins[index] += cost
         return max(bins)
 
+    def _refresh_comms_weights(self, requests: ScheduleResult) -> None:
+        """Install this pass's per-job comms weights on the placement
+        manager (placement/comms.py): category-derived, memoized by job
+        name so a steady-state pass pays one dict probe per job and a
+        lookup only for jobs it has never seen. No-op when placement is
+        absent or the comms objective is disabled
+        (VODA_PLACEMENT_COMMS=0 — the count-only reference path)."""
+        pm = self.placement_manager
+        if pm is None or not pm.comms_enabled:
+            return
+        from vodascheduler_tpu.placement import comms as comms_mod
+
+        cache = self._comms_weight
+        weights: Dict[str, int] = {}
+        ready = self.ready_jobs
+        for job in requests:
+            w = cache.get(job)
+            if w is None:
+                tj = ready.get(job)
+                if tj is None:
+                    w = 0
+                else:
+                    # Spec descriptor wins over the family default
+                    # (doc/placement.md "Collective profiles").
+                    profile = comms_mod.profile_for_job(
+                        tj.spec.collectives, tj.category)
+                    w = 0 if profile is None else profile.weight()
+                cache[job] = w
+            if w:
+                weights[job] = w
+        # Bound the memo by the live request set (completed/deleted
+        # jobs drop out), same policy as the allocator's prior cache.
+        if len(cache) > 2 * len(requests) + 64:
+            keep = set(requests)
+            self._comms_weight = {k: v for k, v in cache.items()
+                                  if k in keep}
+        pm.set_comms_weights(weights)
+
+    def _migration_cost_seconds(self, job_name: str) -> float:
+        """Priced resharding cost of migrating `job_name`: a migration
+        is a checkpoint-restart, so the family's measured/assumed cold
+        restart cost (replay/restart_costs.py) is the honest price —
+        the same number the replay's two-tier pricing charges. Memoized
+        per category."""
+        from vodascheduler_tpu.common.job import category_of
+
+        category = category_of(job_name)
+        cost = self._migration_cost_cache.get(category)
+        if cost is None:
+            from vodascheduler_tpu.replay.restart_costs import (
+                default_restart_seconds,
+                family_restart_costs,
+            )
+            try:
+                costs = family_restart_costs()
+                cost = (costs[category].restart_s if category in costs
+                        else default_restart_seconds())
+            except Exception:  # noqa: BLE001 - pricing must never wedge a pass
+                cost = 30.0
+            self._migration_cost_cache[category] = cost
+        return cost
+
+    def _migration_unpaid(self, job_name: str, handle,
+                          target: List[Tuple[str, int]]) -> bool:
+        """Whether an OPTIMIZATION migration (pure re-binding: same
+        size, every current host alive) fails its payback test: the
+        modeled step-time win — the job's comms fraction times the
+        spread the move recovers — earned over the payback window must
+        repay the priced resharding cost (doc/placement.md "Priced
+        migrations"). Forced migrations (size mismatch, workers on a
+        dead host) are never gated; with the comms objective disabled
+        every mismatch migrates, the pre-comms behavior.
+
+        Deliberate consequence (lazy consolidation): a job whose
+        profile models no comms win (fraction 0 — unknown category, no
+        descriptor) NEVER pays back, so its defragment re-bindings
+        defer until the chips it squats on are actually claimed — at
+        which point the promised-elsewhere check below flips the move
+        to forced. Consolidation happens when the space is needed,
+        not speculatively at a restart's price."""
+        pm = self.placement_manager
+        if (pm is None or not pm.comms_enabled or pm.topology is None):
+            return False
+        live_pairs = list(handle.placements)
+        if sum(n for _, n in live_pairs) != sum(n for _, n in target):
+            return False  # size drifted: reconcile unconditionally
+        hosts = pm.host_states
+        if any(h not in hosts for h, n in live_pairs if n > 0):
+            return False  # workers on a dead/removed host: forced
+        # Deferring keeps the job running on its OLD chips while the
+        # placement manager books its NEW ones; that is only safe while
+        # the old chips are still free in the manager's view (nothing
+        # else has been promised them). The job's OWN new booking on an
+        # overlapping host is credited back — those chips are promised
+        # to nobody else, and without the credit every partial-overlap
+        # re-binding on a tight host would read as forced and bypass
+        # the gate. The moment another job claims the old chips, this
+        # check fails and the migration fires as forced — the deferral
+        # can never turn into a cross-job chip conflict.
+        if any(n > 0
+               and (hosts[h].free_slots
+                    + hosts[h].job_num_workers.get(job_name, 0)) < n
+               for h, n in live_pairs):
+            return False
+        from vodascheduler_tpu.placement import comms as comms_mod
+        from vodascheduler_tpu.common.job import category_of
+
+        tj = self.ready_jobs.get(job_name)
+        profile = comms_mod.profile_for_job(
+            tj.spec.collectives if tj is not None else None,
+            category_of(job_name))
+        fraction = 0.0 if profile is None else profile.comms_fraction
+        spread_old = pm.spread_of_pairs(live_pairs)
+        spread_new = pm.spread_of_pairs(target)
+        win_rate = max(0.0, spread_old - spread_new) * fraction
+        return (win_rate * self.migration_payback_seconds
+                <= self._migration_cost_seconds(job_name))
+
     def _migration_tasks(self, placements: Dict[str, List[Tuple[str, int]]],
                          already_restarted: set) -> List[Tuple[str, object]]:
         """Wave-2 tasks for same-size jobs whose host binding no longer
         matches what the backend is running — including jobs whose
         workers died with a removed host (those produce no index-level
         move in the placement diff, so the backend's live view is the
-        ground truth to compare)."""
+        ground truth to compare). Optimization re-bindings are payback-
+        gated (_migration_unpaid): a migration is a checkpoint-restart,
+        and one that cannot repay its resharding cost in modeled
+        step-time win within the payback window is deferred (audited as
+        migration_deferred_unpaid; re-priced every placed pass)."""
         live = self.backend.running_jobs()
         tasks: List[Tuple[str, object]] = []
         for job_name, target in placements.items():
@@ -1189,10 +1331,14 @@ class Scheduler:
             handle = live.get(job_name)
             if handle is None:
                 continue
-            if sorted(handle.placements) != sorted(target):
-                tasks.append((job_name,
-                              (lambda j=job_name, t=target:
-                               self._migrate_job(j, t))))
+            if sorted(handle.placements) == sorted(target):
+                continue
+            if self._migration_unpaid(job_name, handle, target):
+                self._add_reason(job_name, "migration_deferred_unpaid")
+                continue
+            tasks.append((job_name,
+                          (lambda j=job_name, t=target:
+                           self._migrate_job(j, t))))
         return tasks
 
     def _migrate_job(self, job_name: str,
@@ -1220,8 +1366,19 @@ class Scheduler:
                 self._placement_dirty = True
             self._schedule_retry()
             return
+        # Priced resharding cost of the move, surfaced as the delta's
+        # resize_seconds (`voda explain`): the backend's modeled price
+        # when it offers one, else the family cold-restart price the
+        # payback gate used.
+        try:
+            price = self.backend.actuation_price_seconds(job_name)
+        except Exception:  # noqa: BLE001 - a hint, never load-bearing
+            price = None
+        if not price:
+            price = self._migration_cost_seconds(job_name)
         with self._lock:
             self._add_reason(job_name, "migrated")
+            self._pass_resize_seconds[job_name] = price
             self._last_resize_at[job_name] = self.clock.now()
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
@@ -1554,6 +1711,17 @@ class Scheduler:
                 if job in self._pass_resize_seconds:
                     delta["resize_seconds"] = round(
                         self._pass_resize_seconds[job], 4)
+                if self.placement_manager is not None:
+                    # Placement columns (doc/placement.md): the job's
+                    # comms weight x contiguity score, rendered by
+                    # `voda explain`. Only for jobs that still hold a
+                    # placement and only when nonzero — count-only
+                    # pools emit the pre-comms record shape.
+                    stats = self.placement_manager.job_comms_stats(job)
+                    if stats is not None and (stats[0] or stats[1]):
+                        delta["comms"] = {"weight": stats[0],
+                                          "contiguity": stats[1],
+                                          "score": stats[2]}
                 deltas.append(delta)
             rec = {
                 "kind": "resched_audit",
@@ -1618,6 +1786,14 @@ class Scheduler:
                 "actuate_ms": round(actuate_s * 1000.0, 3),
                 "phases": phases,
             }
+            if self.placement_manager is not None:
+                # Fleet placement totals after the last placed pass
+                # (additive field; `voda top` renders the line).
+                rec["placement"] = {
+                    "jobs_cross_host": self._last_cross_host,
+                    "contiguity_cost": self._last_contiguity_cost,
+                    "comms_score": self._last_comms_score,
+                }
             self.profile_ring.append(rec)
         for name, stats in phases.items():
             self.h_phase_seconds.observe(stats["wall_ms"] / 1000.0,
