@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-selftest lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-selftest lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -56,8 +56,17 @@ modelcheck:
 	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
 		--profile bounded
 
+# 2-pool fleet profile: the REAL AdmissionService + FleetRouter over
+# two schedulers on a shared store/bus/clock — route/churn/storm
+# actions with the cross-pool invariants (cross_pool_booking,
+# stranded_between_pools) joined to the single-pool catalog.
+modelcheck-fleet:
+	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
+		--profile fleet
+
 # Prove the checker has teeth: every seeded-bug scheduler variant must
-# be caught AND its counterexample must replay deterministically.
+# be caught AND its counterexample must replay deterministically
+# (including the fleet router's books-on-A-starts-on-B bug).
 modelcheck-selftest:
 	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
 		--selftest
@@ -95,6 +104,7 @@ trace-dryrun:
 # observatory" + "Ingestion plane").
 perf-baseline:
 	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py \
+		--fleet-ns 16000,100000 \
 		--out doc/perf_baseline.json
 
 # CI perf-regression gate: re-measure a bounded N set and fail if the
@@ -115,6 +125,7 @@ perf-baseline:
 perf-gate:
 	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py \
 		--check doc/perf_baseline.json --ns 100,1000 \
+		--fleet-ns 16000 \
 		--tolerance 4.0 --slack-ms 50 \
 		--fresh-out doc/perf_gate_fresh.json
 
@@ -127,19 +138,32 @@ $(NATIVE_SO): $(NATIVE_SRC)
 	mv $@.tmp $@
 
 # Build + smoke-test: the library loads, the warm Hungarian kernel
-# answers (and matches a VODA_NO_NATIVE pure-Python solve — the ctypes
-# fallback contract exercised in the same breath).
+# answers, the fleet batch kernels (greedy sweep, ElasticTiresias
+# auction, comms scoring) answer AND their VODA_NO_NATIVE ctypes
+# fallbacks engage — plus a bounded differential sweep proving the
+# native decisions match the Python fastpath/oracle bit-for-bit.
 native: $(NATIVE_SO)
-	$(PY) -c "from vodascheduler_tpu import native; assert native.get_lib() is not None; \
-	assert hasattr(native.get_lib(), 'voda_hungarian_warm'), 'stale .so: rebuild'; \
+	$(PY) -c "from vodascheduler_tpu import native; lib = native.get_lib(); assert lib is not None; \
+	assert hasattr(lib, 'voda_hungarian_warm'), 'stale .so: rebuild'; \
+	assert hasattr(lib, 'voda_et_schedule'), 'stale .so: rebuild (fleet kernels missing)'; \
 	from vodascheduler_tpu.placement import hungarian; \
 	score = [[2.0, 0.0], [0.0, 2.0]]; \
 	out, state = hungarian.solve_max_warm(score, None); \
 	assert out == [(0, 0), (1, 1)], out; \
+	assert native.alloc_sweep([0, 1], [1, 2], [4, 4], [1, 2], 4, 1) == [2, 2]; \
+	assert native.comms_score([4, 4], [0, 2], [0, 0, 1, 0], [3], [1]) == ([1], (1, 1, 3)); \
+	from vodascheduler_tpu.algorithms import fastpath; \
+	problems = fastpath.self_check(n_pools=25); \
+	assert not problems, problems[:3]; \
 	import os; os.environ['VODA_NO_NATIVE'] = '1'; \
 	assert native.hungarian_warm(score, [-1, -1], [0.0, 0.0], [0.0, 0.0], [0, 1]) is None; \
+	assert native.alloc_sweep([0], [1], [1], [1], 1, 0) is None; \
+	assert native.et_schedule([0], [1], [1], [1], [0], [0], [0], 1, 10, 2.0, [0], [0, 3], [0.0, 1.0, 2.0]) is None; \
+	assert native.comms_score([2], [0, 1], [0], [1], [0]) is None; \
 	assert hungarian.solve_max(score) == out; \
-	print('native kernels OK (voda_hungarian_warm + ctypes fallback)')"
+	problems = fastpath.self_check(n_pools=10); \
+	assert not problems, problems[:3]; \
+	print('native kernels OK (hungarian + sweep + auction + comms, ctypes fallbacks)')"
 
 docker:
 	docker build -f deploy/docker/Dockerfile.controlplane -t voda-controlplane:latest .

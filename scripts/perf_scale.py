@@ -81,11 +81,27 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 4  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
+SCHEMA = 5  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # suite grew the top-level "ingestion" section (bulk/single admission,
 # storm-to-quiescent, snapshot-cache reads); v4: curves grew the
 # "placement_scoring" column (the bandwidth-aware objective's fleet
-# scoring cost — doc/placement.md) and the gate bounds its total.
+# scoring cost — doc/placement.md); v5: the top-level "fleet" section —
+# N jobs routed across >=8 heterogeneous pools, concurrent multi-pool
+# decide fan-outs on the fleet executor, per-pool decide p95, fleet
+# pass throughput, and router latency (doc/observability.md "Fleet
+# decide").
+
+# Fleet points measured by default: the gate-bounded small fleet and
+# the 100k-job headline (ROADMAP "next order of magnitude").
+DEFAULT_FLEET_NS = (16000, 100000)
+# 16 heterogeneous pools (>=8 per the fleet acceptance): ~6.3k jobs per
+# pool at the 100k headline — the per-GPU-type sharding the reference
+# deploys, sized so each pool's decide sits inside the 50 ms pin with
+# headroom for scheduling noise while TOTAL fleet capacity covers the
+# next order of magnitude.
+FLEET_POOLS = 16
+FLEET_WORKERS = 8
+FLEET_PASSES = 3
 
 # Ingestion measurement shape: the admission slack is deliberately
 # tighter than the decide slack — a per-item bulk admission costs
@@ -437,8 +453,197 @@ def run_ingestion_point(n_jobs: int, seed: int = DEFAULT_SEED,
     return point
 
 
+def build_fleet(total_jobs: int, n_pools: int, seed: int):
+    """One heterogeneous fleet: `n_pools` pools (alternating 4- and
+    8-chip hosts, a mix of algorithms) over ONE shared store/bus/clock/
+    allocator, a FleetRouter in front of admission, and a
+    FleetCoordinator fanning decide passes onto a bounded executor —
+    the production composition (service/app.py), sized so fleet demand
+    saturates fleet capacity. Rate limit 0: every churn trigger decides
+    immediately, so measured passes are always full-queue decides."""
+    from vodascheduler_tpu.allocator import ResourceAllocator
+    from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+    from vodascheduler_tpu.common.clock import VirtualClock
+    from vodascheduler_tpu.common.events import EventBus
+    from vodascheduler_tpu.common.store import JobStore
+    from vodascheduler_tpu.obs import tracer as obs_tracer
+    from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.scheduler import Scheduler
+    from vodascheduler_tpu.scheduler.fleet import (
+        FleetCoordinator,
+        FleetRouter,
+    )
+    from vodascheduler_tpu.service import AdmissionService
+
+    clock = VirtualClock(start=1753760000.0)
+    tracer = obs_tracer.Tracer(clock=clock)
+    store = JobStore()
+    bus = EventBus()
+    allocator = ResourceAllocator(store)
+    schedulers = {}
+    algorithms = ("ElasticTiresias", "ElasticTiresias", "ElasticFIFO",
+                  "ElasticTiresias", "ElasticSRJF")
+    per_pool = total_jobs // n_pools
+    for i in range(n_pools):
+        name = f"fleet-p{i}"
+        chips_per_host = 8 if i % 2 == 0 else 4
+        backend = FakeClusterBackend(clock)
+        hosts = max(2, per_pool // chips_per_host)
+        for h in range(hosts):
+            backend.add_host(f"{name}-host-{h}", chips_per_host,
+                             announce=False)
+        pm = PlacementManager(name)
+        schedulers[name] = Scheduler(
+            name, backend, store, allocator, clock, bus=bus,
+            placement_manager=pm, algorithm=algorithms[i % len(algorithms)],
+            rate_limit_seconds=0.0, tracer=tracer)
+    router = FleetRouter(schedulers, enabled=True, tracer=tracer, bus=bus)
+    fleet = FleetCoordinator(schedulers, workers=FLEET_WORKERS,
+                             tracer=tracer, router=router)
+    admission = AdmissionService(store, bus, clock, router=router)
+    return clock, store, schedulers, fleet, router, admission
+
+
+def _fleet_spec(i: int, rng: random.Random):
+    from vodascheduler_tpu.common.job import JobConfig, JobSpec
+    max_chips = rng.choice((1, 2, 2, 4, 4, 8))
+    # pool "": the router places it by fleet-wide score.
+    return JobSpec(name=f"fleet-{i:06d}", pool="",
+                   config=JobConfig(min_num_chips=1, max_num_chips=max_chips,
+                                    epochs=100000))
+
+
+def run_fleet_point(total_jobs: int, n_pools: int = FLEET_POOLS,
+                    passes: int = FLEET_PASSES,
+                    seed: int = DEFAULT_SEED) -> Dict[str, object]:
+    """Measure the fleet control plane at one size (schema 5): admit
+    `total_jobs` router-placed jobs across `n_pools` heterogeneous
+    pools, then run `passes` churn-loaded concurrent decide fan-outs on
+    the fleet executor. Reports per-pool decide aggregates (the <50 ms
+    pin applies to p95), the fleet pass critical path vs its serial
+    sum (what the executor buys), fleet-wide pass throughput, router
+    decision latency, and the admission cost of the fill."""
+    clock, store, schedulers, fleet, router, admission = build_fleet(
+        total_jobs, n_pools, seed)
+    rng = random.Random(seed)
+
+    # Fill through the REAL bulk admission path (one store commit + one
+    # cross-pool publish per burst; every spec router-placed).
+    t_fill = time.monotonic()
+    alive: List[str] = []
+    next_id = 0
+    burst = max(100, min(5000, total_jobs // 10))
+    remaining = total_jobs
+    while remaining > 0:
+        take = min(burst, remaining)
+        specs = [_fleet_spec(next_id + k, rng) for k in range(take)]
+        next_id += take
+        remaining -= take
+        results = admission.create_training_jobs(specs)
+        assert all("error" not in r for r in results), results[:2]
+        alive.extend(r["name"] for r in results)
+        clock.advance(1.0)
+    fill_s = time.monotonic() - t_fill
+    clock.advance(10.0)
+
+    # The fill just minted ~1M long-lived objects (jobs, infos, specs,
+    # placements); without a freeze, gen-2 collections rescan all of
+    # them and the pauses land inside measured decide windows — pure
+    # startup artifact, not steady-state cost. Freeze the post-fill
+    # heap (the production idiom for exactly this: move the boot heap
+    # out of the collector's working set), measure, unfreeze.
+    import gc
+    gc.collect()
+    gc.freeze()
+
+    # Warm-up fan-out, then measured churn rounds. Two distinct
+    # measurements per round, deliberately separated:
+    # - per-pool decide cost: the churn-triggered passes run SERIALLY
+    #   (rate limit 0 decides inline on the admitting thread), so each
+    #   sample is what one pool's decide costs uncontended — the fleet
+    #   restatement of the PR 8 <50 ms pin. A GIL-contended wall would
+    #   conflate "decide got slower" with "executor width".
+    # - fleet fan-out: run_fleet_pass decides EVERY pool concurrently
+    #   on the bounded executor; its wall (vs the per-pool serial sum)
+    #   is what the fleet executor buys end to end.
+    fleet.run_fleet_pass()
+    last_seq = {name: (s.profile_records(1) or [{}])[-1].get("seq", 0)
+                for name, s in schedulers.items()}
+    decide_ms: List[float] = []
+    pool_decide: Dict[str, List[float]] = {n: [] for n in schedulers}
+    fan_walls: List[float] = []
+    fan_serial: List[float] = []
+
+    def _collect_serial() -> None:
+        for name, sched in schedulers.items():
+            samples = [r for r in sched.profile_records(0)
+                       if r["seq"] > last_seq[name]]
+            if samples:
+                last_seq[name] = samples[-1]["seq"]
+            for r in samples:
+                decide_ms.append(r["decide_ms"])
+                pool_decide[name].append(r["decide_ms"])
+
+    for _ in range(passes):
+        for _k in range(n_pools):
+            victim = alive.pop(rng.randrange(len(alive)))
+            admission.delete_training_job(victim)
+        newcomers = [_fleet_spec(next_id + k, rng) for k in range(n_pools)]
+        next_id += n_pools
+        results = admission.create_training_jobs(newcomers)
+        alive.extend(r["name"] for r in results)
+        clock.advance(1.0)
+        _collect_serial()
+        out = fleet.run_fleet_pass()
+        fan_walls.append(out["wall_ms"])
+        fan_serial.append(sum(out["per_pool_ms"].values()))
+        # Drop the fan-out's own (contended) samples from the serial
+        # decide aggregate.
+        for name, sched in schedulers.items():
+            last_seq[name] = (sched.profile_records(1)
+                              or [{}])[-1].get("seq", last_seq[name])
+
+    per_pool: Dict[str, Dict[str, object]] = {}
+    for name, sched in sorted(schedulers.items()):
+        per_pool[name] = {
+            "algorithm": sched.algorithm,
+            "jobs": len(sched.ready_jobs),
+            "total_chips": sched.total_chips,
+            "passes": len(pool_decide[name]),
+            "decide_ms": _agg(pool_decide[name]),
+        }
+        sched.stop()
+    fleet.close()
+    gc.unfreeze()
+    wall_mean_s = statistics.mean(fan_walls) / 1000.0
+    point = {
+        "total_jobs": total_jobs,
+        "pools": n_pools,
+        "workers": FLEET_WORKERS,
+        "fleet_passes": passes,
+        "fill_bulk_ms_per_job": round(fill_s * 1000.0 / total_jobs, 4),
+        "per_pool_decide_ms": _agg(decide_ms),
+        "per_pool": per_pool,
+        "fleet_pass_wall_ms": _agg(fan_walls),
+        "fleet_pass_serial_sum_ms": _agg(fan_serial),
+        "fleet_pass_speedup": round(
+            statistics.mean(fan_serial) / max(1e-9,
+                                              statistics.mean(fan_walls)),
+            2),
+        "fleet_throughput_jobs_per_s": round(
+            total_jobs / max(1e-9, wall_mean_s), 1),
+        "router": router.stats(),
+    }
+    return point
+
+
 def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
-              seed: int = DEFAULT_SEED, verbose: bool = True) -> dict:
+              seed: int = DEFAULT_SEED, verbose: bool = True,
+              fleet_ns=()) -> dict:
+    """The full measurement suite. The fleet section (schema 5) is
+    opt-in via `fleet_ns` — the 100k point costs minutes, so only the
+    baseline-regen entry (`make perf-baseline` → --fleet-ns) pays it;
+    hermetic in-process callers default to none."""
     curves = []
     for n in ns:
         t0 = time.monotonic()
@@ -461,6 +666,19 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
                   f"({time.monotonic() - t0:.1f}s to measure)",
                   file=sys.stderr)
         ingestion.append(point)
+    fleet = []
+    for n in (fleet_ns or ()):
+        t0 = time.monotonic()
+        point = run_fleet_point(n, seed=seed)
+        if verbose:
+            print(f"perf_scale: fleet N={n}: per-pool decide "
+                  f"{point['per_pool_decide_ms']['p95']}ms p95, fleet pass "
+                  f"{point['fleet_pass_wall_ms']['mean']}ms "
+                  f"(x{point['fleet_pass_speedup']} vs serial), router p99 "
+                  f"{point['router']['route_ms']['p99']}ms "
+                  f"({time.monotonic() - t0:.1f}s to measure)",
+                  file=sys.stderr)
+        fleet.append(point)
     return {
         "schema": SCHEMA,
         "tool": "scripts/perf_scale.py",
@@ -480,6 +698,7 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
         "python": platform.python_version(),
         "curves": curves,
         "ingestion": ingestion,
+        "fleet": fleet,
     }
 
 
@@ -496,7 +715,7 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
     phases are noise-bound)."""
     problems: List[str] = []
     base_by_n = {c["n_jobs"]: c for c in baseline.get("curves", [])}
-    for curve in fresh["curves"]:
+    for curve in fresh.get("curves", []):
         n = curve["n_jobs"]
         base = base_by_n.get(n)
         if base is None:
@@ -589,6 +808,48 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"N={n}: storm coalescing regressed: {fresh_passes} "
                 f"passes to quiescent vs baseline {base_passes} "
                 f"(bound {bound_passes:.0f})")
+
+    # Fleet columns (schema 5): the per-pool decide p95 carries BOTH a
+    # relative bound and the absolute <50 ms acceptance pin (the fleet
+    # restatement of the PR 8 decide target); the fan-out wall and the
+    # router p99 are bounded like the other latency columns (router at
+    # the ingestion slack — routing is sub-ms). Pre-v5 baselines skip.
+    base_fleet = {c["total_jobs"]: c for c in baseline.get("fleet", [])}
+    fresh_fleet = {c["total_jobs"]: c for c in fresh.get("fleet", [])}
+    for n in sorted(fresh_fleet):
+        fc, bc = fresh_fleet[n], base_fleet.get(n)
+        if bc is None:
+            problems.append(f"fleet N={n}: no baseline fleet point "
+                            f"(regenerate with make perf-baseline)")
+            continue
+
+        def fcheck(label: str, fresh_ms: float, base_ms: float,
+                   slack: float = slack_ms) -> None:
+            bound = base_ms * tolerance + slack
+            verdict = "ok" if fresh_ms <= bound else "REGRESSED"
+            print(f"  F={n:>6} {label:<18} base={base_ms:>10.3f}ms "
+                  f"fresh={fresh_ms:>10.3f}ms bound={bound:>10.3f}ms "
+                  f"{verdict}")
+            if fresh_ms > bound:
+                problems.append(
+                    f"fleet N={n}: {label} regressed: {fresh_ms:.3f}ms vs "
+                    f"baseline {base_ms:.3f}ms (bound {bound:.3f}ms)")
+
+        fcheck("fleet_decide_p95", fc["per_pool_decide_ms"]["p95"],
+               bc["per_pool_decide_ms"]["p95"])
+        # The absolute acceptance pin binds the 100k headline point
+        # (measured at baseline-regen time; tier-1 also pins the
+        # committed artifact) — not the bounded gate point, whose small
+        # absolute numbers sit inside CI scheduling noise.
+        if n >= 100000 and fc["per_pool_decide_ms"]["p95"] >= 50.0:
+            problems.append(
+                f"fleet N={n}: per-pool decide p95 "
+                f"{fc['per_pool_decide_ms']['p95']:.3f}ms breaches the "
+                f"absolute 50 ms fleet pin")
+        fcheck("fleet_pass_wall", fc["fleet_pass_wall_ms"]["mean"],
+               bc["fleet_pass_wall_ms"]["mean"])
+        fcheck("router_p99", fc["router"]["route_ms"]["p99"],
+               bc["router"]["route_ms"]["p99"], slack=ing_slack)
     return problems
 
 
@@ -600,6 +861,13 @@ def main(argv=None) -> int:
     parser.add_argument("--ns", default=None,
                         help="comma-separated job counts "
                              f"(default {','.join(map(str, DEFAULT_NS))})")
+    parser.add_argument("--fleet-ns", default=None,
+                        help="comma-separated FLEET job totals (schema 5). "
+                             "Omitted = no fleet section (the 100k point "
+                             "costs minutes); make perf-baseline passes "
+                             f"{','.join(map(str, DEFAULT_FLEET_NS))} and "
+                             "make perf-gate re-measures the bounded "
+                             f"{DEFAULT_FLEET_NS[0]} point")
     parser.add_argument("--passes", type=int, default=DEFAULT_PASSES)
     parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
     parser.add_argument("--out", default=None,
@@ -628,6 +896,10 @@ def main(argv=None) -> int:
 
     ns = (tuple(int(x) for x in args.ns.split(",")) if args.ns
           else DEFAULT_NS)
+    if args.fleet_ns is None or args.fleet_ns.strip().lower() == "none":
+        fleet_ns = ()
+    else:
+        fleet_ns = tuple(int(x) for x in args.fleet_ns.split(","))
 
     if args.check:
         with open(args.check) as f:
@@ -647,7 +919,8 @@ def main(argv=None) -> int:
                          inject_admission_ms=args.inject_admission_ms)
                          for n in ns]}
         else:
-            fresh = run_suite(ns, passes=args.passes, seed=args.seed)
+            fresh = run_suite(ns, passes=args.passes, seed=args.seed,
+                              fleet_ns=fleet_ns)
         fresh_out = args.fresh_out or os.path.join(
             os.path.dirname(args.check), "perf_gate_fresh.json")
         with open(fresh_out, "w") as f:
@@ -663,7 +936,8 @@ def main(argv=None) -> int:
               f"({len(problems)} regression(s))")
         return 1 if problems else 0
 
-    result = run_suite(ns, passes=args.passes, seed=args.seed)
+    result = run_suite(ns, passes=args.passes, seed=args.seed,
+                       fleet_ns=fleet_ns)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1, sort_keys=True)

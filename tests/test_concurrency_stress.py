@@ -56,7 +56,13 @@ def _build():
                       placement_manager=pm, algorithm="ElasticTiresias",
                       rate_limit_seconds=5.0, actuation_parallel=True)
     admission = AdmissionService(store, bus, clock)
-    return clock, store, backend, sched, admission, topology
+    # Fleet coordinator over the pool (doc/observability.md "Fleet
+    # decide"): the storm drives pump/fleet_stats through it so the
+    # witness records the fleet lock's (leaf) behavior and the pinned
+    # lock_order.json regenerates with the fleet node.
+    from vodascheduler_tpu.scheduler.fleet import FleetCoordinator
+    fleet = FleetCoordinator({"stress": sched}, workers=2)
+    return clock, store, backend, sched, admission, topology, fleet
 
 
 LOCK_ORDER_PINNED = os.path.join(os.path.dirname(os.path.dirname(
@@ -64,7 +70,7 @@ LOCK_ORDER_PINNED = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def test_scheduler_survives_concurrent_hammering(lock_witness):
-    clock, store, backend, sched, admission, topology = _build()
+    clock, store, backend, sched, admission, topology, fleet = _build()
     # Runtime half of the invariant-enforcement plane
     # (doc/static-analysis.md): witness the storm's actual lock
     # acquisitions. Any order cycle, any backend mutator entered with a
@@ -74,6 +80,7 @@ def test_scheduler_survives_concurrent_hammering(lock_witness):
     lock_witness.instrument(backend, "_state_lock",
                             "fake_backend._state_lock")
     lock_witness.instrument(clock, "_lock", "virtual_clock._lock")
+    lock_witness.instrument(fleet, "_lock", "fleet._lock")
     lock_witness.guard_backend(backend, "fake_backend")
     errors = []
     stop = threading.Event()
@@ -132,6 +139,12 @@ def test_scheduler_survives_concurrent_hammering(lock_witness):
             table = sched.status_table()
             for row in table:
                 assert row["chips"] >= 0
+            # Pump through the fleet coordinator (the production driver)
+            # and read the lock-free fleet view mid-storm — witnessing
+            # that fleet._lock nests into nothing (a leaf).
+            fleet.run_pending()
+            snap = fleet.fleet_snapshot()
+            assert snap["totals"]["pools"] == 1
             sched.pump()
             sched.update_time_metrics()
             time.sleep(0.001)

@@ -166,6 +166,43 @@ class TestApisDoc:
             assert knob in doc, f"ingestion knob {knob} undocumented"
             assert hasattr(cfg, attr), f"documented knob {knob} gone"
 
+    def test_fleet_decide_documented(self):
+        """The fleet control plane's contract is pinned both ways:
+        observability.md documents the executor model, lock order,
+        router, knobs, the `fleet_route` record and every ROUTE_REASONS
+        code (and names no undeclared one); apis.md documents the fleet
+        routes and the CLI verb."""
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        assert "Fleet decide" in doc
+        for term in ("FleetCoordinator", "FleetRouter", "fleet_route",
+                     "VODA_FLEET_WORKERS", "VODA_FLEET_ROUTER",
+                     "fleet-generation token", "fleet_snapshot",
+                     "lock_order.json", "fleet._lock", "/debug/fleet",
+                     "voda top --fleet", "fleet_pass_speedup"):
+            assert term in doc, f"fleet term {term!r} missing"
+        from vodascheduler_tpu.obs import ROUTE_REASONS, SPAN_NAMES
+        assert "fleet" in SPAN_NAMES
+        for code in sorted(ROUTE_REASONS):
+            assert f"`{code}`" in doc, f"route reason {code!r} undocumented"
+        # Reverse: the route-reason table's rows name only declared codes.
+        import re as _re
+        table = _re.findall(
+            r"\| `([a-z_]+)` \| [^|]*router[^|]*\||"
+            r"\| `(explicit_pool|single_pool|best_score|"
+            r"affinity_preferred|router_disabled)` \|", doc)
+        documented = {x for pair in table for x in pair if x}
+        assert documented <= (ROUTE_REASONS | {"route"}), \
+            f"undeclared route reasons documented: {documented - ROUTE_REASONS}"
+        with open(os.path.join(REPO, "doc", "apis.md")) as f:
+            apis = f.read()
+        for term in ("/debug/fleet", "voda top --fleet", "fleet_route",
+                     "VODA_FLEET_ROUTER"):
+            assert term in apis, f"apis.md: fleet term {term!r} missing"
+        import vodascheduler_tpu.config as cfg
+        assert hasattr(cfg, "FLEET_WORKERS")
+        assert hasattr(cfg, "FLEET_ROUTER")
+
     def test_observability_doc_covers_concurrency_model(self):
         """The concurrent actuation plane's contract is documented: the
         decide/actuate split, the wave vocabulary (matching the

@@ -1,0 +1,519 @@
+"""Fleet control plane (doc/observability.md "Fleet decide"): the
+concurrent multi-pool decide coordinator, the cross-pool admission
+router, the native fleet batch kernels' differential proofs, the
+16-pool teardown hygiene, and the perf_scale schema-5 fleet point."""
+
+import json
+import os
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus, EventQueueFull, JobEvent
+from vodascheduler_tpu.common.job import JobConfig, JobSpec
+from vodascheduler_tpu.common.metrics import Registry
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import EventVerb
+from vodascheduler_tpu.obs import ROUTE_REASONS, audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
+from vodascheduler_tpu.placement import PlacementManager
+from vodascheduler_tpu.placement.topology import PoolTopology
+from vodascheduler_tpu.scheduler import FleetCoordinator, FleetRouter, Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(name, pool="", min_chips=1, max_chips=2, collectives=None):
+    return JobSpec(name=name, pool=pool,
+                   config=JobConfig(min_num_chips=min_chips,
+                                    max_num_chips=max_chips, epochs=1),
+                   collectives=collectives)
+
+
+def build_fleet(pools=("a", "b"), chips=(8, 8), topologies=None,
+                router_enabled=True, rate_limit=0.5):
+    clock = VirtualClock(start=1753760000.0)
+    tracer = obs_tracer.Tracer(clock=clock, ring_size=512)
+    store = JobStore()
+    bus = EventBus()
+    allocator = ResourceAllocator(store)
+    schedulers = {}
+    backends = {}
+    for i, pool in enumerate(pools):
+        backend = FakeClusterBackend(clock)
+        topo = topologies[i] if topologies else None
+        if topo is not None:
+            for coord in topo.host_coords():
+                backend.add_host(topo.host_name(coord),
+                                 topo.chips_per_host, announce=False)
+        else:
+            backend.add_host(f"{pool}-host-0", chips[i], announce=False)
+        pm = PlacementManager(pool, topology=topo)
+        schedulers[pool] = Scheduler(
+            pool, backend, store, allocator, clock, bus=bus,
+            placement_manager=pm, algorithm="ElasticFIFO",
+            rate_limit_seconds=rate_limit, tracer=tracer)
+        backends[pool] = backend
+    router = FleetRouter(schedulers, enabled=router_enabled,
+                         tracer=tracer, bus=bus)
+    fleet = FleetCoordinator(schedulers, workers=4, tracer=tracer,
+                             router=router)
+    admission = AdmissionService(store, bus, clock, valid_pools=set(pools),
+                                 tracer=tracer, router=router)
+    return (clock, store, bus, schedulers, backends, router, fleet,
+            admission, tracer)
+
+
+class TestFleetRouter:
+    def test_explicit_pool_passes_through(self):
+        _, _, _, scheds, _, router, _, _, tracer = build_fleet()
+        pool, reasons = router.route(_spec("j", pool="a"))
+        assert pool == "a"
+        assert reasons == ["explicit_pool"]
+
+    def test_unpooled_spec_routes_to_freest_pool(self):
+        (clock, store, bus, scheds, _, router, _, admission,
+         tracer) = build_fleet(chips=(8, 2))
+        pool, reasons = router.route(_spec("j"))
+        assert pool == "a"  # 8 free chips beats 2
+        assert "best_score" in reasons
+
+    def test_auto_is_routed_and_tie_breaks_deterministically(self):
+        _, _, _, _, _, router, _, _, _ = build_fleet(chips=(4, 4))
+        pool, _ = router.route(_spec("j", pool="auto"))
+        assert pool == "a"  # equal scores: lexicographic pool name
+
+    def test_affinity_steers_comms_heavy_family(self):
+        # Equal capacity; pool b has the denser host block. A job with a
+        # heavy collectives descriptor prefers b; a zero-comms job ties
+        # to a.
+        topo_a = PoolTopology(torus_dims=(8,), host_block=(1,))
+        topo_b = PoolTopology(torus_dims=(4, 2), host_block=(2, 2))
+        _, _, _, _, _, router, _, _, tracer = build_fleet(
+            topologies=[topo_a, topo_b])
+        heavy = _spec("llm", collectives={"allreduce_bytes_per_chip": 4e9,
+                                          "comms_fraction": 0.3})
+        pool, reasons = router.route(heavy)
+        assert pool == "b"
+        assert "affinity_preferred" in reasons
+        pool, reasons = router.route(_spec("tiny"))
+        assert pool == "a"
+        assert "affinity_preferred" not in reasons
+
+    def test_router_disabled_static_path(self):
+        _, _, _, _, _, router, _, _, _ = build_fleet(router_enabled=False)
+        with pytest.raises(ValueError):
+            router.route(_spec("j", pool=""))
+        # Explicit pools still pass through when disabled.
+        pool, reasons = router.route(_spec("j", pool="b"))
+        assert pool == "b" and reasons == ["explicit_pool"]
+
+    def test_fleet_route_records_schema_valid(self):
+        _, _, _, _, _, router, _, _, tracer = build_fleet()
+        router.route(_spec("j1"))
+        router.route(_spec("j2", pool="a"))
+        recs = tracer.records(kind="fleet_route")
+        assert len(recs) == 2
+        for rec in recs:
+            assert obs_audit.validate_record(rec) == []
+            assert set(rec["reasons"]) <= ROUTE_REASONS
+        stats = router.stats()
+        assert stats["decisions_total"] == 2
+        assert stats["by_reason"]["explicit_pool"] == 1
+
+    def test_inflight_correction_spreads_a_burst(self):
+        # A bulk batch routes every spec before its CREATEs publish, so
+        # live backlog is frozen — the in-flight correction must spread
+        # the burst instead of dumping it all on one argmax pool.
+        (clock, store, bus, scheds, _, router, _, admission,
+         tracer) = build_fleet(chips=(8, 8), rate_limit=1000.0)
+        results = admission.create_training_jobs(
+            [_spec(f"j{i}") for i in range(8)])
+        assert all("error" not in r for r in results)
+        routed = [store.get_job(r["name"]).pool for r in results]
+        assert set(routed) == {"a", "b"}
+        assert 2 <= routed.count("a") <= 6  # roughly balanced
+
+    def test_failed_batch_aborts_routes_no_phantom_backlog(self):
+        # A rejected burst must leave the in-flight correction and the
+        # audit stream exactly as it found them: retried 429s/400s
+        # would otherwise accrete phantom backlog that permanently
+        # skews future scores, and the trace would assert placements
+        # that never happened.
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=1000.0)
+        bad = [_spec("ok1"), _spec("bad", pool="nope"), _spec("ok2")]
+        results = admission.create_training_jobs(bad)
+        assert any("error" in r for r in results)
+        assert router._routed_to == {}
+        assert tracer.records(kind="fleet_route") == []
+        assert router.stats()["decisions_total"] == 0
+        # A committed burst counts and audits normally afterwards.
+        good = admission.create_training_jobs([_spec("ok3"), _spec("ok4")])
+        assert all("error" not in r for r in good)
+        assert len(tracer.records(kind="fleet_route")) == 2
+        assert router.stats()["decisions_total"] == 2
+
+    def test_load_cache_is_version_keyed(self):
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=1000.0)
+        router.route(_spec("j1"))
+        token1 = router._load_cache[0]
+        router.route(_spec("j2"))
+        assert router._load_cache[0] == token1  # quiet fleet: cache held
+        # A scheduler mutation invalidates on the next route.
+        admission.create_training_job(_spec("j3", pool="a"))
+        clock.advance(2.0)
+        router.route(_spec("j4"))
+        assert router._load_cache[0] != token1
+
+    def test_routed_admission_lands_and_completes(self):
+        (clock, store, bus, scheds, backends, router, fleet, admission,
+         tracer) = build_fleet()
+        name = admission.create_training_job(_spec("solo"))
+        job = store.get_job(name)
+        assert job.pool in ("a", "b")
+        clock.advance(5.0)
+        assert name in scheds[job.pool].ready_jobs
+        # The OTHER pool never heard of it.
+        other = "b" if job.pool == "a" else "a"
+        assert name not in scheds[other].ready_jobs
+
+
+class TestFleetCoordinator:
+    def test_run_fleet_pass_runs_every_pool_and_bumps_generation(self):
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+        for i in range(4):
+            admission.create_training_job(_spec(f"j{i}"))
+        clock.advance(2.0)
+        out = fleet.run_fleet_pass()
+        assert out["generation"] == 1
+        assert sorted(out["pools"]) == ["a", "b"]
+        assert set(out["per_pool_ms"]) == {"a", "b"}
+        out2 = fleet.run_fleet_pass()
+        assert out2["generation"] == 2
+        spans = [r for r in tracer.records(kind="span")
+                 if r.get("name") == "fleet"]
+        assert len(spans) == 2
+        fleet.close()
+
+    def test_fleet_snapshot_is_lock_free_and_correct(self):
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+        admission.create_training_job(_spec("j0", pool="a"))
+        clock.advance(2.0)
+        # Snapshot must not block even while a scheduler lock is held.
+        with scheds["a"]._lock:
+            snap = fleet.fleet_snapshot()
+        assert snap["totals"]["pools"] == 2
+        assert snap["pools"]["a"]["ready_jobs"] == 1
+        assert snap["pools"]["a"]["total_chips"] == 8
+        assert snap["pools"]["b"]["ready_jobs"] == 0
+
+    def test_fleet_stats_shape(self):
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+        admission.create_training_job(_spec("j0"))
+        clock.advance(2.0)
+        fleet.run_fleet_pass()
+        stats = fleet.fleet_stats()
+        assert set(stats["profile"]) == {"a", "b"}
+        for pool_stats in stats["profile"].values():
+            assert "decide_ms_p95" in pool_stats
+        assert stats["router"]["decisions_total"] >= 1
+        assert stats["last_pass"]["generation"] == fleet.generation
+        fleet.close()
+
+    def test_pool_failure_is_isolated(self):
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+
+        def boom():
+            raise RuntimeError("pool a broke")
+
+        scheds["a"].pump = boom
+        out = fleet.run_fleet_pass()  # must not raise
+        assert "b" in out["per_pool_ms"]
+        fleet.close()
+
+    def test_close_is_idempotent_and_joins_threads(self):
+        before = {t.ident for t in threading.enumerate()}
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+        fleet.run_fleet_pass()
+        fleet.close()
+        fleet.close()
+        # No fleet thread born in this test survives the close (other
+        # tests' unclosed fleets may still park idle daemon workers).
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("voda-fleet")
+                  and t.ident not in before]
+        assert leaked == []
+        with pytest.raises(RuntimeError):
+            fleet._pool_executor()
+
+
+class TestTeardownHygiene:
+    """Satellite: pools >> 8 must tear down cleanly — drainer threads
+    enumerable and joined, no metric identity collisions, no leaked
+    voda-* threads."""
+
+    def test_16_pool_storm_and_clean_teardown(self):
+        before = {t.ident for t in threading.enumerate()}
+        pools = tuple(f"p{i:02d}" for i in range(16))
+        (clock, store, bus, scheds, backends, router, fleet, admission,
+         tracer) = build_fleet(pools=pools, chips=(4,) * 16,
+                               rate_limit=0.0)
+        specs = [_spec(f"j{i}") for i in range(64)]
+        results = admission.create_training_jobs(specs)
+        assert all("error" not in r for r in results)
+        clock.advance(5.0)
+        fleet.run_fleet_pass()
+        # Drainer threads are enumerable by name while live.
+        for t in bus.drainer_threads():
+            assert t.name.startswith("voda-event-drain-")
+        fleet.close()
+        bus.close()
+        for sched in scheds.values():
+            sched.stop()
+        # Everything joined: no fleet or drainer threads survive.
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith(("voda-fleet", "voda-event-drain"))
+                  and t.ident not in before]
+        assert leaked == []
+        assert bus.drainer_threads() == []
+
+    def test_bus_close_refuses_new_handoffs(self):
+        bus = EventBus()
+        bus.close()
+        with pytest.raises(EventQueueFull):
+            bus.publish_many("a", (JobEvent(EventVerb.CREATE, "j"),),
+                             all_or_nothing=True)
+        with pytest.raises(EventQueueFull):
+            bus.publish_many_multi({"a": [JobEvent(EventVerb.CREATE, "j")]})
+        # Best-effort publish after close drops (logged), never raises.
+        bus.publish("a", JobEvent(EventVerb.CREATE, "j"))
+        assert bus.pending("a") == 0
+
+    def test_registry_rejects_identity_collision(self):
+        registry = Registry()
+        registry.counter("voda_x_total", "x", const_labels={"pool": "a"})
+        registry.counter("voda_x_total", "x", const_labels={"pool": "b"})
+        with pytest.raises(ValueError):
+            registry.counter("voda_x_total", "x",
+                             const_labels={"pool": "a"})
+
+
+class TestDebugFleetRoute:
+    def test_debug_fleet_and_cli_rendering(self):
+        from vodascheduler_tpu.service.rest import make_scheduler_server
+        (clock, store, bus, scheds, _, router, fleet, admission,
+         tracer) = build_fleet(rate_limit=0.0)
+        admission.create_training_job(_spec("j0"))
+        clock.advance(2.0)
+        fleet.run_fleet_pass()
+        server = make_scheduler_server(scheds, Registry(),
+                                       host="127.0.0.1", port=0,
+                                       fleet=fleet)
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/fleet",
+                    timeout=10.0) as resp:
+                stats = json.loads(resp.read().decode())
+        finally:
+            server.stop()
+        assert stats["totals"]["pools"] == 2
+        assert "router" in stats and "profile" in stats
+        # CLI rendering smoke: must not raise on the live payload.
+        from vodascheduler_tpu.cli import _print_fleet
+        _print_fleet(stats)
+
+
+class TestNativeFleetKernels:
+    """Differential proofs for the new batch kernels: native ==
+    python fastpath == oracle, including tie evolution and dict order
+    (fastpath.self_check runs native-forced; the explicit layer tests
+    here pin native == python with the floors zeroed)."""
+
+    def test_self_check_native_and_pure(self, monkeypatch):
+        from vodascheduler_tpu import native
+        from vodascheduler_tpu.algorithms import fastpath
+        if native.get_lib() is None:
+            pytest.skip("native kernels unavailable")
+        assert fastpath.self_check(n_pools=60) == []
+        monkeypatch.setenv("VODA_NO_NATIVE", "1")
+        assert fastpath.self_check(n_pools=30) == []
+
+    def test_native_equals_python_fastpath_all_algorithms(self,
+                                                          monkeypatch):
+        import copy
+
+        from vodascheduler_tpu import native
+        from vodascheduler_tpu.algorithms import fastpath
+        from vodascheduler_tpu.algorithms.base import InvalidAllocationError
+        if native.get_lib() is None:
+            pytest.skip("native kernels unavailable")
+        monkeypatch.setattr(fastpath, "_SWEEP_NATIVE_MIN", 0)
+        monkeypatch.setattr(fastpath, "_ET_PHASES_NATIVE_MIN", 0)
+        rng = random.Random(42)
+        kernels = (fastpath.fifo, fastpath.elastic_fifo, fastpath.srjf,
+                   fastpath.elastic_srjf, fastpath.tiresias,
+                   fastpath.elastic_tiresias)
+        for trial in range(60):
+            jobs, total = fastpath.random_pool(rng,
+                                               degenerate=(trial % 5 == 2))
+            for fn in kernels:
+                def run(no_native):
+                    if no_native:
+                        os.environ["VODA_NO_NATIVE"] = "1"
+                    else:
+                        os.environ.pop("VODA_NO_NATIVE", None)
+                    try:
+                        try:
+                            return fn(copy.deepcopy(jobs), total)
+                        except InvalidAllocationError as e:
+                            return ("raises", str(e))
+                    finally:
+                        os.environ.pop("VODA_NO_NATIVE", None)
+                a, b = run(False), run(True)
+                assert a == b, (trial, fn.__name__)
+                if isinstance(a, dict):
+                    assert list(a) == list(b), (trial, fn.__name__,
+                                                "dict order diverged")
+
+    def test_comms_score_native_equals_reference(self):
+        from vodascheduler_tpu import native
+        if native.get_lib() is None:
+            pytest.skip("native kernels unavailable")
+        rng = random.Random(11)
+        for trial in range(30):
+            topo = PoolTopology.parse(
+                rng.choice(["4x4x4/2x2x1", "8x8/2x2", "16/1", "4x4/1x1"]))
+            pm = PlacementManager("p", topology=topo)
+            for coord in topo.host_coords():
+                pm.add_host(topo.host_name(coord), topo.chips_per_host)
+            for _ in range(rng.randint(1, 12)):
+                pm.place({f"j{k}": rng.randint(1, 6)
+                          for k in range(rng.randint(1, 10))})
+            pm.set_comms_weights({f"j{k}": rng.randint(0, 8)
+                                  for k in range(10)})
+            ref = pm._fleet_stats_reference()
+            nat = pm._fleet_stats_native()
+            assert nat is not None
+            assert tuple(ref) == tuple(nat), trial
+
+    def test_no_native_fallbacks_return_none(self, monkeypatch):
+        from vodascheduler_tpu import native
+        monkeypatch.setenv("VODA_NO_NATIVE", "1")
+        assert native.alloc_sweep([0], [1], [1], [1], 1, 0) is None
+        assert native.et_schedule([0], [1], [1], [1], [0], [0], [0], 1,
+                                  10, 2.0, [0], [0, 3],
+                                  [0.0, 1.0, 2.0]) is None
+        assert native.comms_score([2], [0, 1], [0], [1], [0]) is None
+
+
+class TestFleetModelcheck:
+    """Satellite: the 2-pool fleet profile and its seeded-bug teeth."""
+
+    def test_fleet_profile_clean(self):
+        from vodascheduler_tpu.analysis import modelcheck as mc
+        config = mc.fleet_config()
+        # Bounded for tier-1 runtime; the full profile runs via
+        # `modelcheck --profile fleet`.
+        import dataclasses
+        config = dataclasses.replace(config, depth=8, max_states=600)
+        result = mc.explore(config)
+        assert result.ok, result.counterexample
+        assert result.states >= 200
+
+    def test_misrouting_admission_caught_and_replays(self):
+        from vodascheduler_tpu.analysis import modelcheck as mc
+        result = mc.explore(
+            mc.fleet_config(variant="route-book-start-mismatch"))
+        assert result.counterexample is not None
+        assert result.counterexample["violation"].startswith(
+            "cross_pool_booking")
+        assert mc.replay_counterexample(result.counterexample)
+
+    def test_fleet_invariants_documented(self):
+        from vodascheduler_tpu.analysis.modelcheck import INVARIANTS
+        assert "cross_pool_booking" in INVARIANTS
+        assert "stranded_between_pools" in INVARIANTS
+
+
+class TestFleetPerfPoint:
+    """Schema-5 fleet point: shape, gate bounds, and the committed
+    baseline's 100k acceptance pins."""
+
+    def _mini_fleet_point(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "perf_scale", os.path.join(REPO, "scripts", "perf_scale.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod, mod.run_fleet_point(800, n_pools=8, passes=2, seed=3)
+
+    def test_fleet_point_shape(self):
+        mod, point = self._mini_fleet_point()
+        assert point["pools"] == 8
+        assert len(point["per_pool"]) == 8
+        assert point["per_pool_decide_ms"]["p95"] >= 0
+        assert point["fleet_pass_wall_ms"]["mean"] > 0
+        assert point["router"]["decisions_total"] >= 800
+        assert point["router"]["route_ms"]["p99"] >= 0
+        algos = {p["algorithm"] for p in point["per_pool"].values()}
+        assert len(algos) >= 2  # heterogeneous
+
+    def test_fleet_gate_bounds_and_absolute_pin(self, capsys):
+        mod, point = self._mini_fleet_point()
+        baseline = {"schema": mod.SCHEMA, "curves": [], "ingestion": [],
+                    "fleet": [point]}
+        fresh = {"schema": mod.SCHEMA, "curves": [], "ingestion": [],
+                 "fleet": [json.loads(json.dumps(point))]}
+        assert mod.compare(baseline, fresh) == []
+        # A doctored per-pool decide p95 past the absolute 50 ms pin
+        # fails even within the relative tolerance band — the pin binds
+        # the >=100k headline point.
+        head = json.loads(json.dumps(point))
+        head["total_jobs"] = 100000
+        doctored = json.loads(json.dumps(head))
+        doctored["per_pool_decide_ms"]["p95"] = max(
+            55.0, point["per_pool_decide_ms"]["p95"])
+        problems = mod.compare(
+            {"schema": mod.SCHEMA, "fleet": [head]},
+            {"schema": mod.SCHEMA, "fleet": [doctored]},
+            tolerance=1000.0)
+        assert any("50 ms fleet pin" in p for p in problems)
+        # A missing baseline fleet point is loud, not silent.
+        problems = mod.compare({"schema": mod.SCHEMA},
+                               {"schema": mod.SCHEMA, "fleet": [point]})
+        assert any("no baseline fleet point" in p for p in problems)
+        capsys.readouterr()
+
+    def test_committed_baseline_fleet_acceptance(self):
+        """The acceptance pins, against the committed artifact: 100k
+        jobs across >= 8 heterogeneous pools, per-pool decide p95 under
+        50 ms, fleet throughput and router p99 present."""
+        with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
+            baseline = json.load(f)
+        assert baseline["schema"] >= 5
+        fleet = {c["total_jobs"]: c for c in baseline["fleet"]}
+        assert 100000 in fleet, "100k fleet point missing from baseline"
+        head = fleet[100000]
+        assert head["pools"] >= 8
+        algos = {p["algorithm"] for p in head["per_pool"].values()}
+        assert len(algos) >= 2
+        assert 0 < head["per_pool_decide_ms"]["p95"] < 50.0
+        assert head["fleet_pass_speedup"] > 1.5
+        assert head["fleet_throughput_jobs_per_s"] > 0
+        assert head["router"]["route_ms"]["p99"] > 0
+        # The gate-bounded small fleet point rides alongside.
+        assert any(n < 100000 for n in fleet)

@@ -124,7 +124,8 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 4
+        assert base["schema"] == 5  # v5: + the fleet section
+        assert base["fleet"], "fleet section missing (make perf-baseline)"
         assert base["tool"] == "scripts/perf_scale.py"
         assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}

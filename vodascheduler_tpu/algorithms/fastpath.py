@@ -282,6 +282,170 @@ def _finish(vec: JobVec, order: List[int], result: List[int],
     return {names[i]: result[i] for i in order}
 
 
+# ---- native batch dispatch (voda_native.cc; doc/observability.md
+# "Fleet decide") -------------------------------------------------------------
+#
+# The integer sweeps and the ElasticTiresias auction have C++ twins in
+# native/_voda_native.so. Dispatch order is native -> python fastpath ->
+# oracle, each layer bit-identical to the next: VODA_NO_NATIVE drops the
+# first layer (native.get_lib() returns None), VODA_PURE_ALLOCATOR drops
+# the first two (enabled() above). The differential suite runs all three.
+
+_SWEEP_MINIMUMS = 0   # allocate_minimums only (FIFO / SRJF)
+_SWEEP_ELASTIC = 1    # + water-filled distribute_leftover
+_SWEEP_FIXED = 2      # fixed NumProc (Tiresias)
+
+# Below this queue length the pure-Python integer sweeps beat the
+# numpy-marshalling round trip into the native kernel (measured ~5.2 ms
+# python vs ~6.2 ms native at 10k; crossover sits in the tens of
+# thousands). Tests force 0 to route every differential trial through
+# the kernel regardless of pool size.
+_SWEEP_NATIVE_MIN = 20000
+
+
+def _native_sweep(vec: JobVec, order: List[int], total_chips: int,
+                  mode: int) -> Optional[List[int]]:
+    """The per-index result list from the native sweep kernel, or None
+    (unavailable / VODA_NO_NATIVE / below the marshalling-economics
+    floor). Unread arrays are aliased to an already-extracted field so
+    a FIFO pass never pays the maxes/nums extraction sweeps it doesn't
+    need."""
+    from vodascheduler_tpu import native
+
+    if vec.n < _SWEEP_NATIVE_MIN:
+        return None
+    if mode == _SWEEP_FIXED:
+        nums = vec.nums
+        return native.alloc_sweep(order, nums, nums, nums, total_chips,
+                                  mode)
+    mins = vec.mins
+    maxes = vec.maxes if mode == _SWEEP_ELASTIC else mins
+    return native.alloc_sweep(order, mins, maxes, mins, total_chips, mode)
+
+
+# Full-native auction engages only when the pool's jobs share at most
+# this many distinct speedup curves. A fleet of fresh jobs shares ONE
+# linear-prior dict (allocator._base_prior) and marshals for free; a
+# pool where every job carries its own learned curve would pay an
+# O(jobs x levels) dict-to-row extraction that costs more than the
+# retained Python lazy-heap auction it replaces — there the native
+# kernel still runs phases 0/1/compaction (pure integers) and hands
+# (result, free) to the Python auction.
+_ET_NATIVE_CURVES_MAX = 64
+
+# Phases-only native mode engages above this queue length: below it the
+# three Python integer sweeps cost less than the array marshalling they
+# would replace (measured ~9 ms python vs ~12 ms native at 10k learned-
+# curve jobs; the ratio inverts past a few tens of thousands of jobs
+# where numpy's ~40 ns/element conversion beats ~400 ns/element of
+# Python loop).
+_ET_PHASES_NATIVE_MIN = 50000
+
+
+def _native_et(vec: JobVec, order: List[int], total_chips: int
+               ) -> Optional[Tuple[List[int], Optional[int]]]:
+    """Native ElasticTiresias dispatch, or None (no kernel /
+    VODA_NO_NATIVE). Returns (result, None) when the native auction
+    completed the schedule, or (result, free) when only the integer
+    phases ran natively and the caller must run the Python auction.
+    Curve rows cover levels 0..max_chips+1 (the auction re-keys at
+    result+1 after a min-grant, which can read one level past max —
+    dict.get semantics, row guard in the kernel)."""
+    from vodascheduler_tpu.algorithms.elastic_tiresias import (
+        COMPACTION_THRESHOLD,
+        FLOOR_LIFT_AGE_SECONDS,
+        FLOOR_LIFT_WEIGHT,
+        LEASE_SECONDS,
+    )
+    from vodascheduler_tpu import native
+
+    if native.get_lib() is None:
+        return None
+    mins, maxes = vec.mins, vec.maxes
+    n = vec.n
+    infos = vec.infos
+    # Dispatch economics: the kernel only repays its array marshalling
+    # when the auction is substantial (leftover chips beyond the fixed
+    # NumProc demand — each costs the Python heap a pop/push round) or
+    # the queue is fleet-sized (the three integer sweeps alone dominate
+    # marshalling past ~50k jobs). A saturated 10k pool decides faster
+    # on the pure-Python fastpath, so it stays there.
+    auction_heavy = total_chips > sum(vec.nums)
+    if not auction_heavy and n < _ET_PHASES_NATIVE_MIN:
+        return None
+    # Distinct-curve probe, cheap-first: a 256-job sample bounds the
+    # count from below, so a per-job-learned-curves pool bails without
+    # sweeping all n ids.
+    sample = {0 if info is None else id(info.speedup)
+              for info in infos[:256]}
+    if len(sample) > _ET_NATIVE_CURVES_MAX:
+        curve_ids = sample
+    else:
+        curve_ids = {0 if info is None else id(info.speedup)
+                     for info in infos}
+    # min <= 0 stays off the full-native path: the initial gain divides
+    # by min and the Python expression's ZeroDivisionError is the
+    # contract — C++ would mint an inf instead.
+    full = (len(curve_ids) <= _ET_NATIVE_CURVES_MAX
+            and min(mins, default=1) > 0)
+    if not full and n < _ET_PHASES_NATIVE_MIN:
+        return None  # pure-Python fastpath beats marshalling here
+    is_running, ssr, running_s = vec.is_running, vec.ssr, vec.running
+    lease_ok = [1 if (r and s < LEASE_SECONDS) else 0
+                for r, s in zip(is_running, ssr)]
+    lift_ok = [1 if rs > FLOOR_LIFT_AGE_SECONDS else 0 for rs in running_s]
+    if full and len(curve_ids) == 1:
+        # The fleet steady state: every job shares one curve dict (the
+        # linear prior) or carries none — one row, no per-job loop.
+        speedup = next((info.speedup for info in infos
+                        if info is not None), None)
+        levels = (max(maxes) if maxes else 0) + 2
+        curve_idx = [0] * n
+        if speedup is None:
+            flat = [0.0] * levels
+        else:
+            get = speedup.get
+            flat = [get(g, 0.0) for g in range(levels)]
+        offsets = [0, levels]
+    elif full:
+        curve_index: Dict[int, int] = {}
+        curve_dicts: List[Optional[dict]] = []
+        curve_levels: List[int] = []
+        curve_idx = []
+        for i in range(n):
+            info = infos[i]
+            speedup = info.speedup if info is not None else None
+            key = 0 if speedup is None else id(speedup)
+            c = curve_index.get(key)
+            if c is None:
+                c = curve_index[key] = len(curve_dicts)
+                curve_dicts.append(speedup)
+                curve_levels.append(0)
+            need = maxes[i] + 2
+            if need > curve_levels[c]:
+                curve_levels[c] = need
+            curve_idx.append(c)
+        offsets = [0]
+        flat = []
+        for speedup, levels in zip(curve_dicts, curve_levels):
+            if speedup is None:
+                flat.extend([0.0] * levels)
+            else:
+                get = speedup.get
+                flat.extend([get(g, 0.0) for g in range(levels)])
+            offsets.append(len(flat))
+    else:
+        curve_idx, offsets, flat = [0] * n, [0, 0], []
+    out = native.et_schedule(order, mins, maxes, vec.nums, vec.prios,
+                             lease_ok, lift_ok, total_chips,
+                             COMPACTION_THRESHOLD, FLOOR_LIFT_WEIGHT,
+                             curve_idx, offsets, flat, run_auction=full)
+    if out is None:
+        return None
+    result, free = out
+    return (result, None) if full else (result, free)
+
+
 # ---- the kernels -----------------------------------------------------------
 
 
@@ -290,8 +454,10 @@ def fifo(jobs: List[TrainingJob], total_chips: int) -> Optional[ScheduleResult]:
         return None
     vec = JobVec(jobs)
     order = _stable_order(vec.submit, vec.n)
-    result = [0] * vec.n
-    _allocate_minimums(vec, order, result, total_chips)
+    result = _native_sweep(vec, order, total_chips, _SWEEP_MINIMUMS)
+    if result is None:
+        result = [0] * vec.n
+        _allocate_minimums(vec, order, result, total_chips)
     return _finish(vec, order, result, total_chips)
 
 
@@ -301,9 +467,11 @@ def elastic_fifo(jobs: List[TrainingJob],
         return None
     vec = JobVec(jobs)
     order = _stable_order(vec.submit, vec.n)
-    result = [0] * vec.n
-    free = _allocate_minimums(vec, order, result, total_chips)
-    _distribute_leftover(vec, order, result, free)
+    result = _native_sweep(vec, order, total_chips, _SWEEP_ELASTIC)
+    if result is None:
+        result = [0] * vec.n
+        free = _allocate_minimums(vec, order, result, total_chips)
+        _distribute_leftover(vec, order, result, free)
     return _finish(vec, order, result, total_chips)
 
 
@@ -312,8 +480,10 @@ def srjf(jobs: List[TrainingJob], total_chips: int) -> Optional[ScheduleResult]:
         return None
     vec = JobVec(jobs)
     order = _stable_order(vec.remaining_seconds(), vec.n)
-    result = [0] * vec.n
-    _allocate_minimums(vec, order, result, total_chips)
+    result = _native_sweep(vec, order, total_chips, _SWEEP_MINIMUMS)
+    if result is None:
+        result = [0] * vec.n
+        _allocate_minimums(vec, order, result, total_chips)
     return _finish(vec, order, result, total_chips)
 
 
@@ -323,9 +493,11 @@ def elastic_srjf(jobs: List[TrainingJob],
         return None
     vec = JobVec(jobs)
     order = _stable_order(vec.remaining_seconds(), vec.n)
-    result = [0] * vec.n
-    free = _allocate_minimums(vec, order, result, total_chips)
-    _distribute_leftover(vec, order, result, free)
+    result = _native_sweep(vec, order, total_chips, _SWEEP_ELASTIC)
+    if result is None:
+        result = [0] * vec.n
+        free = _allocate_minimums(vec, order, result, total_chips)
+        _distribute_leftover(vec, order, result, free)
     return _finish(vec, order, result, total_chips)
 
 
@@ -335,14 +507,16 @@ def tiresias(jobs: List[TrainingJob],
         return None
     vec = JobVec(jobs)
     order = _lex_order(vec.prios, vec.first_start, vec.n)
-    result = [0] * vec.n
-    nums = vec.nums
-    free = total_chips
-    for i in order:
-        want = nums[i]
-        if free >= want:
-            result[i] = want
-            free -= want
+    result = _native_sweep(vec, order, total_chips, _SWEEP_FIXED)
+    if result is None:
+        result = [0] * vec.n
+        nums = vec.nums
+        free = total_chips
+        for i in order:
+            want = nums[i]
+            if free >= want:
+                result[i] = want
+                free -= want
     return _finish(vec, order, result, total_chips)
 
 
@@ -451,46 +625,57 @@ def elastic_tiresias(jobs: List[TrainingJob],
     vec = JobVec(jobs)
     n = vec.n
     order = _lex_order(vec.prios, vec.first_start, n)
-    mins, maxes, nums, prios = vec.mins, vec.maxes, vec.nums, vec.prios
-    result = [0] * n
-    free = total_chips
-    pendings = n
-    leased = [False] * n
+    native_out = _native_et(vec, order, total_chips)
+    if native_out is not None:
+        result, free = native_out
+        if free is None:
+            # Full native run (auction included).
+            _validate(vec, result, total_chips)
+            names = vec.names
+            return {names[i]: result[i] for i in range(n)}
+        # Native phases + retained Python auction below.
+    else:
+        mins, maxes, nums, prios = vec.mins, vec.maxes, vec.nums, vec.prios
+        result = [0] * n
+        free = total_chips
+        pendings = n
+        leased = [False] * n
 
-    # Phase 0: running jobs inside their preemption lease keep their
-    # minimum, in queue order.
-    is_running, ssr = vec.is_running, vec.ssr
-    for i in order:
-        if is_running[i] and ssr[i] < LEASE_SECONDS and free >= mins[i]:
-            result[i] = mins[i]
-            free -= mins[i]
-            pendings -= 1
-            leased[i] = True
-
-    # Phase 1: fixed NumProc allocation by queue; leased jobs top up to
-    # their full NumProc all-or-nothing.
-    for i in order:
-        if leased[i]:
-            extra = nums[i] - result[i]
-            if 0 < extra <= free:
-                result[i] += extra
-                free -= extra
-            continue
-        if free >= nums[i]:
-            result[i] = nums[i]
-            free -= nums[i]
-            pendings -= 1
-
-    # Compaction: deep pending backlog shrinks running low-priority
-    # (queue >= 1) jobs to their minimum.
-    if pendings > COMPACTION_THRESHOLD:
+        # Phase 0: running jobs inside their preemption lease keep
+        # their minimum, in queue order.
+        is_running, ssr = vec.is_running, vec.ssr
         for i in order:
-            if prios[i] < 1:
-                continue
-            if result[i] != 0:
-                free += result[i] - mins[i]
+            if is_running[i] and ssr[i] < LEASE_SECONDS and free >= mins[i]:
                 result[i] = mins[i]
+                free -= mins[i]
+                pendings -= 1
+                leased[i] = True
 
+        # Phase 1: fixed NumProc allocation by queue; leased jobs top
+        # up to their full NumProc all-or-nothing.
+        for i in order:
+            if leased[i]:
+                extra = nums[i] - result[i]
+                if 0 < extra <= free:
+                    result[i] += extra
+                    free -= extra
+                continue
+            if free >= nums[i]:
+                result[i] = nums[i]
+                free -= nums[i]
+                pendings -= 1
+
+        # Compaction: deep pending backlog shrinks running low-priority
+        # (queue >= 1) jobs to their minimum.
+        if pendings > COMPACTION_THRESHOLD:
+            for i in order:
+                if prios[i] < 1:
+                    continue
+                if result[i] != 0:
+                    free += result[i] - mins[i]
+                    result[i] = mins[i]
+
+    mins, maxes, prios = vec.mins, vec.maxes, vec.prios
     # Phase 2: greedy marginal-gain auction via lazy heap.
     if free > 0:
         infos = vec.infos
@@ -653,6 +838,25 @@ def self_check(n_pools: int = 50, seed: int = 20260803,
 
     problems: List[str] = []
     rng = random.Random(seed)
+    # Force the native kernels into play for every trial (the size
+    # floors exist for marshalling economics, not correctness — the
+    # differential proof must cover the native layer at EVERY pool
+    # size; the VODA_NO_NATIVE re-run of this sweep covers the pure
+    # fastpath layer).
+    global _SWEEP_NATIVE_MIN, _ET_PHASES_NATIVE_MIN
+    saved = (_SWEEP_NATIVE_MIN, _ET_PHASES_NATIVE_MIN)
+    _SWEEP_NATIVE_MIN = _ET_PHASES_NATIVE_MIN = 0
+    try:
+        return _self_check_inner(n_pools, rng, sizes, problems)
+    finally:
+        _SWEEP_NATIVE_MIN, _ET_PHASES_NATIVE_MIN = saved
+
+
+def _self_check_inner(n_pools, rng, sizes, problems: List[str]) -> List[str]:
+    import copy
+
+    from vodascheduler_tpu.algorithms import new_algorithm
+
     for p in range(n_pools):
         size = None if sizes is None else sizes[p % len(sizes)]
         jobs, total = random_pool(rng, size=size,

@@ -160,11 +160,17 @@ class ResourceAllocator:
         # Per-job linear-speedup priors, reused across passes: a fresh
         # job with no learned doc gets the same base prior every pass,
         # and building one is ~500 dict entries — at 10k fresh jobs that
-        # was most of the job-info fetch cost. Entries are evicted
-        # implicitly: once a doc exists in the store the prior is never
-        # consulted for that job again (and the cache is bounded by the
-        # ready queue via the per-pass sweep in _attach_job_info).
-        self._base_infos: dict = {}
+        # was most of the job-info fetch cost. Scoped PER SCHEDULER
+        # (request.scheduler_id): one allocator serves every pool of a
+        # fleet, and a single shared dict bounded by "this pass's queue"
+        # saw 10 pools' entries, tripped its bound on EVERY pass, and
+        # re-minted each pool's priors while evicting the other nine's —
+        # an O(fleet) rebuild inside every decide window (the 100k-fleet
+        # p95 regression the fleet perf point caught). Per-pool maps keep
+        # each bound honest: once a doc exists in the store the prior is
+        # never consulted for that job again, and each pool's cache is
+        # bounded by its own ready queue.
+        self._base_infos_by_pool: dict = {}
         registry = registry or Registry()
         # Reference metric names: pkg/allocator/allocator/metrics.go.
         self.m_requests = registry.counter(
@@ -200,7 +206,8 @@ class ResourceAllocator:
                                 "num_jobs": len(request.ready_jobs)}) as sp:
             if algo.needs_job_info:
                 t0 = time.monotonic()
-                attached = self._attach_job_info(request.ready_jobs)
+                attached = self._attach_job_info(request.ready_jobs,
+                                                 request.scheduler_id)
                 self.m_info_seconds.observe(time.monotonic() - t0,
                                             algorithm=algo.name)
                 sp.set_attr("jobinfo", attached)
@@ -224,7 +231,8 @@ class ResourceAllocator:
             sp.set_attr("granted_chips", sum(result.values()))
         return result
 
-    def _attach_job_info(self, jobs: List[TrainingJob]) -> int:
+    def _attach_job_info(self, jobs: List[TrainingJob],
+                         scheduler_id: str = "") -> int:
         """Attach each job's info doc for this pass and return how many
         were served from LEARNED docs (exact or category fallback) —
         the allocate span's `jobinfo` attr; the remainder to `num_jobs`
@@ -239,7 +247,7 @@ class ResourceAllocator:
         base prior, cached per job name — semantics per job are
         unchanged (exact doc, else newest category doc, else prior)."""
         infos = self.store.job_infos_for(jobs)
-        base_cache = self._base_infos
+        base_cache = self._base_infos_by_pool.setdefault(scheduler_id, {})
         learned = 0
         for job in jobs:
             info = infos.get(job.name)
@@ -251,10 +259,10 @@ class ResourceAllocator:
             else:
                 learned += 1
             job.info = info
-        # Bound the prior cache by the live queue: names no longer in
-        # the ready set (completed/deleted jobs) drop out.
+        # Bound each pool's prior cache by ITS live queue: names no
+        # longer in the ready set (completed/deleted jobs) drop out.
         if len(base_cache) > 2 * len(jobs) + 64:
             keep = {job.name for job in jobs}
-            self._base_infos = {k: v for k, v in base_cache.items()
-                                if k in keep}
+            self._base_infos_by_pool[scheduler_id] = {
+                k: v for k, v in base_cache.items() if k in keep}
         return learned

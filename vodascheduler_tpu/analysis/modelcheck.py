@@ -76,6 +76,8 @@ from vodascheduler_tpu.obs import audit as obs_audit
 from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import PlacementManager
 from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.scheduler.fleet import FleetRouter
+from vodascheduler_tpu.service.admission import AdmissionService
 
 # The invariant catalog (documented in doc/static-analysis.md; the
 # per-step checks and the drain checks reference these ids verbatim).
@@ -104,6 +106,16 @@ INVARIANTS: Dict[str, str] = {
     "stranded_job": (
         "No stable state leaves a WAITING job unscheduled while enough "
         "chips sit free and no pass is pending."),
+    "cross_pool_booking": (
+        "Fleet profile: no scheduler owns (or books chips for) a job "
+        "whose store record names a different pool — a router that "
+        "books on pool A and starts on pool B is caught the moment the "
+        "wrong scheduler accepts the CREATE."),
+    "stranded_between_pools": (
+        "Fleet profile: at every drained leaf, every admitted "
+        "non-terminal store job is owned by exactly one pool's "
+        "scheduler — a routed job can never sit committed in the store "
+        "with no pool ever hearing about it."),
 }
 
 
@@ -140,6 +152,13 @@ class ModelConfig:
     restart_overhead_seconds: float = 2.0
     epoch_seconds: float = 8.0
     variant: str = "default"
+    # Fleet mode (doc/observability.md "Fleet decide"): `pools` names
+    # each host's pool ("a:host-0" in `hosts`/`churn_hosts`), submits go
+    # through the REAL AdmissionService + FleetRouter (action `route:`),
+    # and the two cross-pool invariants join the catalog. `variant`
+    # selects from ADMISSION_VARIANTS instead of VARIANTS.
+    fleet: bool = False
+    pools: Tuple[str, ...] = ()
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -151,7 +170,7 @@ class ModelConfig:
         d = dict(d)
         d["jobs"] = tuple(JobShape(**j) for j in d["jobs"])
         d["hosts"] = tuple((h, int(c)) for h, c in d["hosts"])
-        for key in ("faults", "churn_hosts", "deletable"):
+        for key in ("faults", "churn_hosts", "deletable", "pools"):
             d[key] = tuple(d.get(key, ()))
         return ModelConfig(**d)
 
@@ -207,6 +226,44 @@ VARIANTS: Dict[str, type] = {
     "default": Scheduler,
     "keep-booking-on-revert": _KeepBookingOnRevert,
     "eager-free-on-delete": _EagerFreeOnDelete,
+}
+
+
+class _MisroutingAdmission(AdmissionService):
+    """Seeded fleet bug: the admission layer commits a routed job to the
+    store under its routed pool but publishes the CREATE event to the
+    OTHER pool's queue — the router "books on pool A and starts on pool
+    B" class the fleet profile exists to catch. The wrong scheduler
+    accepts the create (it trusts its topic, like the reference trusts
+    its per-type RabbitMQ queue) and `cross_pool_booking` fires."""
+
+    def create_training_job(self, spec, on_admitted=None):
+        # Route + store normally, then misdirect the event: swap the
+        # publish topic by intercepting the bus with a one-shot shim.
+        bus = self.bus
+
+        class _SwappedBus:
+            def __getattr__(self, item):
+                return getattr(bus, item)
+
+            def publish_many_multi(self, by_pool):
+                pools = sorted(bus.topics()) or sorted(by_pool)
+                swapped = {}
+                for topic, events in by_pool.items():
+                    others = [p for p in pools if p != topic]
+                    swapped[others[0] if others else topic] = events
+                bus.publish_many_multi(swapped)
+
+        self.bus = _SwappedBus()
+        try:
+            return super().create_training_job(spec, on_admitted)
+        finally:
+            self.bus = bus
+
+
+ADMISSION_VARIANTS: Dict[str, type] = {
+    "default": AdmissionService,
+    "route-book-start-mismatch": _MisroutingAdmission,
 }
 
 
@@ -501,6 +558,195 @@ class _World:
         return problems
 
 
+class _FleetWorld(_World):
+    """Two-pool fleet world: the REAL AdmissionService + FleetRouter in
+    front of two real Schedulers sharing one store/bus/clock — fleet
+    actions (`route:` through the router, cross-pool host churn) plus
+    the two cross-pool invariants. The per-pool invariant logic is the
+    base class's, applied per pool by rebinding the (sched, backend,
+    pm) view — one implementation, N pools."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+        self.clock = VirtualClock(start=self.START)
+        self.tracer = obs_tracer.Tracer(clock=self.clock, ring_size=64)
+        self.store = JobStore()
+        self.bus = EventBus()
+        pool_names = list(config.pools) or ["a", "b"]
+        self.pools: Dict[str, Tuple[Scheduler, FakeClusterBackend,
+                                    PlacementManager]] = {}
+        self.allocator = ResourceAllocator(self.store)
+        for pool in pool_names:
+            backend = FakeClusterBackend(
+                self.clock,
+                restart_overhead_seconds=config.restart_overhead_seconds)
+            for host, chips in config.hosts:
+                p, _, h = host.partition(":")
+                if p == pool:
+                    backend.add_host(h, chips, announce=False)
+            for shape in config.jobs:
+                # Category-keyed (timestamped admission names resolve
+                # through category_of).
+                backend.register_profile(
+                    shape.name,
+                    WorkloadProfile(epoch_seconds_at_1=config.epoch_seconds))
+            pm = PlacementManager(pool)
+            sched = Scheduler(
+                pool, backend, self.store, self.allocator, self.clock,
+                bus=self.bus, placement_manager=pm,
+                algorithm=config.algorithm,
+                rate_limit_seconds=config.rate_limit_seconds,
+                profile_cpu=False, tracer=self.tracer)
+            self.pools[pool] = (sched, backend, pm)
+        schedulers = {p: s for p, (s, _, _) in self.pools.items()}
+        self.router = FleetRouter(schedulers, enabled=True,
+                                  tracer=self.tracer, bus=self.bus)
+        admission_cls = ADMISSION_VARIANTS[config.variant]
+        self.admission = admission_cls(
+            self.store, self.bus, self.clock,
+            valid_pools=set(pool_names), tracer=self.tracer,
+            router=self.router)
+        # Base-class view slots (rebound per pool by the check loops).
+        first = pool_names[0]
+        self.sched, self.backend, self.pm = self.pools[first]
+        self._specs = {
+            shape.name: JobSpec(
+                name=shape.name, pool="",  # routed, never explicit
+                config=JobConfig(min_num_chips=shape.min_chips,
+                                 max_num_chips=shape.max_chips,
+                                 epochs=shape.epochs))
+            for shape in config.jobs}
+        self.submitted: set = set()
+        self.deleted: set = set()
+        self.down_hosts: set = set()
+        self._host_chips = {h: c for h, c in config.hosts}
+        self._prev_metrics: Dict[str, Tuple[float, ...]] = {}
+        self._routed_names: Dict[str, str] = {}
+
+    # -- actions ------------------------------------------------------------
+
+    def enabled(self) -> List[str]:
+        acts = ["advance"]
+        unsubmitted = [s.name for s in self.config.jobs
+                       if s.name not in self.submitted]
+        if unsubmitted:
+            acts.append(f"route:{unsubmitted[0]}")
+        for name in self.config.deletable:
+            stored = self._routed_names.get(name)
+            if (name in self.submitted and name not in self.deleted
+                    and stored is not None
+                    and any(stored in s.ready_jobs
+                            for s, _, _ in self.pools.values())):
+                acts.append(f"delete:{name}")
+        for host in self.config.churn_hosts:
+            pool, _, bare = host.partition(":")
+            _, backend, _ = self.pools[pool]
+            if host in self.down_hosts:
+                acts.append(f"host_up:{host}")
+            elif len(backend.list_hosts()) > 0:
+                acts.append(f"host_down:{host}")
+        if self.config.storm and len(unsubmitted) > 1:
+            acts.append("storm")
+        return acts
+
+    def apply(self, action: str) -> None:
+        kind, _, arg = action.partition(":")
+        if kind == "route":
+            self._submit(arg)
+        elif kind == "delete":
+            self.deleted.add(arg)
+            self.admission.delete_training_job(self._routed_names[arg])
+        elif kind == "advance":
+            nxt = self.clock.next_timer()
+            if nxt is None:
+                self.clock.advance(self.config.rate_limit_seconds)
+            else:
+                self.clock.advance_to(max(nxt, self.clock.now()) + 1e-6)
+        elif kind == "host_down":
+            pool, _, bare = arg.partition(":")
+            self.down_hosts.add(arg)
+            self.pools[pool][1].remove_host(bare)
+        elif kind == "host_up":
+            pool, _, bare = arg.partition(":")
+            self.down_hosts.discard(arg)
+            self.pools[pool][1].add_host(bare, self._host_chips[arg])
+        elif kind == "storm":
+            for shape in self.config.jobs:
+                if shape.name not in self.submitted:
+                    self._submit(shape.name)
+        else:
+            raise ValueError(f"unknown fleet action {action!r}")
+
+    def _submit(self, name: str) -> None:
+        stored = self.admission.create_training_job(self._specs[name])
+        self.submitted.add(name)
+        self._routed_names[name] = stored
+
+    # -- fingerprint / invariants ------------------------------------------
+
+    def _pool_views(self):
+        for pool in sorted(self.pools):
+            yield pool, self.pools[pool]
+
+    def fingerprint(self) -> Tuple:
+        parts = []
+        for pool, (sched, backend, pm) in self._pool_views():
+            self.sched, self.backend, self.pm = sched, backend, pm
+            parts.append((pool,) + super().fingerprint())
+        stored = tuple(sorted(
+            (j.name, j.pool, j.status.value)
+            for j in self.store.list_jobs()))
+        return tuple(parts) + (stored,)
+
+    def check(self) -> List[str]:
+        problems: List[str] = []
+        owners: Dict[str, str] = {}
+        for pool, (sched, backend, pm) in self._pool_views():
+            self.sched, self.backend, self.pm = sched, backend, pm
+            problems.extend(super().check())
+            for job_name in list(sched.ready_jobs) + list(sched.done_jobs):
+                stored = self.store.get_job(job_name)
+                if stored is not None and stored.pool != pool:
+                    problems.append(
+                        f"cross_pool_booking: {job_name} stored in pool "
+                        f"{stored.pool!r} but owned by {pool!r}")
+                prev = owners.get(job_name)
+                if prev is not None and prev != pool:
+                    problems.append(
+                        f"cross_pool_booking: {job_name} owned by both "
+                        f"{prev!r} and {pool!r}")
+                owners[job_name] = pool
+        return problems
+
+    def drain(self, max_events: int = 400,
+              stable_needed: int = 12) -> List[str]:
+        # Same fixed-point drain as the base, but quiescence uses the
+        # fleet fingerprint/checks via the overridden methods.
+        return super().drain(max_events=max_events,
+                             stable_needed=stable_needed)
+
+    def _stable_state_problems(self) -> List[str]:
+        problems: List[str] = []
+        owned: set = set()
+        for pool, (sched, backend, pm) in self._pool_views():
+            self.sched, self.backend, self.pm = sched, backend, pm
+            problems.extend(super()._stable_state_problems())
+            owned.update(sched.ready_jobs)
+            owned.update(sched.done_jobs)
+        for job in self.store.list_jobs():
+            if job.status.is_terminal:
+                continue
+            if job.name not in owned:
+                problems.append(
+                    f"stranded_between_pools: {job.name} committed to "
+                    f"pool {job.pool!r} but no scheduler owns it")
+        return problems
+
+
+def _make_world(config: ModelConfig) -> _World:
+    return _FleetWorld(config) if config.fleet else _World(config)
+
+
 # ---- exploration -----------------------------------------------------------
 
 
@@ -521,7 +767,7 @@ def _execute(config: ModelConfig, path: Tuple[str, ...]) -> _World:
     every step (raises Violation). Reconstruction-by-replay is what
     makes every explored state reachable-by-construction and every
     counterexample a plain action list."""
-    world = _World(config)
+    world = _make_world(config)
     problems = world.check()
     if problems:
         raise Violation(problems, 0, "<init>")
@@ -683,7 +929,33 @@ def deep_config(variant: str = "default") -> ModelConfig:
     )
 
 
-PROFILES = {"bounded": bounded_config, "deep": deep_config}
+def fleet_config(variant: str = "default") -> ModelConfig:
+    """The 2-pool fleet profile (doc/observability.md "Fleet decide"):
+    the REAL AdmissionService + FleetRouter over two schedulers on a
+    shared store/bus/clock. Actions: route (fleet-scored admission),
+    cross-pool host churn (pool b's only host can leave and return —
+    capacity asymmetry steers the router), delete, storm. Invariants:
+    everything the single-pool profile checks, per pool, plus
+    cross_pool_booking and stranded_between_pools."""
+    return ModelConfig(
+        jobs=(JobShape("j0", min_chips=1, max_chips=2, epochs=1),
+              JobShape("j1", min_chips=1, max_chips=2, epochs=1),
+              JobShape("j2", min_chips=2, max_chips=2, epochs=1)),
+        hosts=(("a:host-0", 4), ("b:host-0", 4)),
+        depth=12,
+        max_states=2000,
+        faults=(),
+        churn_hosts=("a:host-0", "b:host-0"),
+        deletable=("j0",),
+        storm=True,
+        fleet=True,
+        pools=("a", "b"),
+        variant=variant,
+    )
+
+
+PROFILES = {"bounded": bounded_config, "deep": deep_config,
+            "fleet": fleet_config}
 
 # The CI gate: a bounded run exploring fewer unique states than this
 # means the scenario (or the dedup) silently collapsed — fail loudly.
@@ -700,8 +972,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "invariants (doc/static-analysis.md)")
     parser.add_argument("--profile", choices=sorted(PROFILES),
                         default="bounded")
-    parser.add_argument("--variant", choices=sorted(VARIANTS),
-                        default="default")
+    parser.add_argument("--variant",
+                        choices=sorted(set(VARIANTS)
+                                       | set(ADMISSION_VARIANTS)),
+                        default="default",
+                        help="scheduler variant (bounded/deep profiles) "
+                             "or admission variant (fleet profile)")
     parser.add_argument("--selftest", action="store_true",
                         help="run every seeded-bug variant and require "
                              "each to be CAUGHT (the checker's teeth)")
@@ -720,14 +996,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.selftest:
         ok = True
+        profile = args.profile if args.profile != "fleet" else "bounded"
         for name in sorted(VARIANTS):
             if name == "default":
                 continue
-            result = explore(PROFILES[args.profile](variant=name))
+            result = explore(PROFILES[profile](variant=name))
             caught = result.counterexample is not None
             reproduced = caught and bool(
                 replay_counterexample(result.counterexample))
             print(f"selftest {name}: "
+                  f"{'CAUGHT' if caught else 'MISSED'}"
+                  f"{' +replayed' if reproduced else ''} "
+                  f"({result.states} states)")
+            ok = ok and caught and reproduced
+        # Fleet teeth: the misrouting admission (books on pool A,
+        # starts on pool B) must be caught by the 2-pool profile's
+        # cross-pool invariants with a replayable counterexample.
+        for name in sorted(ADMISSION_VARIANTS):
+            if name == "default":
+                continue
+            result = explore(fleet_config(variant=name))
+            caught = result.counterexample is not None
+            reproduced = caught and bool(
+                replay_counterexample(result.counterexample))
+            print(f"selftest fleet/{name}: "
                   f"{'CAUGHT' if caught else 'MISSED'}"
                   f"{' +replayed' if reproduced else ''} "
                   f"({result.states} states)")

@@ -428,6 +428,7 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
     span_names = vocab["SPAN_NAMES"]
     status_reasons = vocab["STATUS_REASONS"]
     phase_names = vocab.get("PHASE_NAMES", frozenset())
+    route_reasons = vocab.get("ROUTE_REASONS", frozenset())
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -441,6 +442,16 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
                         rel, line, "vocab",
                         f"reason code {code!r} not in "
                         f"obs.audit.REASON_CODES"))
+        elif name == "_add_route_reason" and len(node.args) >= 2:
+            # FleetRouter._add_route_reason(reasons, "..."): the
+            # cross-pool router's rationale vocabulary is closed like
+            # REASON_CODES (doc/observability.md "Fleet decide").
+            for line, code in _literal_strings(node.args[1]) or []:
+                if code not in route_reasons:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"route reason {code!r} not in "
+                        f"obs.audit.ROUTE_REASONS"))
         elif name == "trigger_resched" and node.args:
             for line, code in _literal_strings(node.args[0]) or []:
                 if code not in triggers:
@@ -710,7 +721,8 @@ def _load_vocab() -> Dict[str, frozenset]:
             "TRIGGERS": audit.TRIGGERS,
             "SPAN_NAMES": audit.SPAN_NAMES,
             "STATUS_REASONS": audit.STATUS_REASONS,
-            "PHASE_NAMES": audit.PHASE_NAMES}
+            "PHASE_NAMES": audit.PHASE_NAMES,
+            "ROUTE_REASONS": audit.ROUTE_REASONS}
 
 
 def lint_source(src: str, rel: str,
@@ -819,6 +831,7 @@ def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
             ("TRIGGERS", vocab["TRIGGERS"], used_literals),
             ("SPAN_NAMES", vocab["SPAN_NAMES"], used_literals),
             ("PHASE_NAMES", vocab["PHASE_NAMES"], used_literals),
+            ("ROUTE_REASONS", vocab["ROUTE_REASONS"], used_literals),
             ("STATUS_REASONS", vocab["STATUS_REASONS"],
              used_outside_lifecycle)):
         for entry in sorted(entries):

@@ -121,6 +121,11 @@ def main(argv=None) -> int:
                        help="recent passes to aggregate")
     p_top.add_argument("-k", type=int, default=5,
                        help="slowest passes to list")
+    p_top.add_argument("--fleet", action="store_true",
+                       help="one fleet view over every pool (GET "
+                            "/debug/fleet): per-pool load + decide "
+                            "percentiles and the cross-pool router's "
+                            "decision stats")
 
     args = parser.parse_args(argv)
     from urllib.parse import quote as _q
@@ -197,6 +202,9 @@ def main(argv=None) -> int:
         out = _request(f"{args.scheduler_server}/debug/trace/"
                        f"{quote(args.name, safe='')}{pool_q}")
         _print_explain(args.name, out, limit=args.n)
+    elif args.command == "top" and args.fleet:
+        stats = _request(f"{args.scheduler_server}/debug/fleet?n={args.n}")
+        _print_fleet(stats)
     elif args.command == "top":
         q = f"?n={args.n}"
         if args.pool:
@@ -299,6 +307,45 @@ def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None
               f"{rec.get('actuate_ms', 0):.3f}) dominant: {dom_s} "
               f"triggers={'+'.join(rec.get('triggers', ()))} "
               f"jobs=[{jobs_s}]")
+
+
+def _print_fleet(stats: dict) -> None:
+    """Human rendering of GET /debug/fleet: one row per pool (load +
+    decide tails), the fleet totals, the last fan-out, and the router's
+    decision mix (doc/observability.md "Fleet decide")."""
+    totals = stats.get("totals") or {}
+    print(f"fleet: {totals.get('pools', 0)} pool(s), "
+          f"{totals.get('booked_chips', 0)}/{totals.get('total_chips', 0)} "
+          f"chips booked, {totals.get('ready_jobs', 0)} ready jobs "
+          f"(generation {stats.get('generation', 0)})")
+    pools = stats.get("pools") or {}
+    profile = stats.get("profile") or {}
+    header = (f"  {'POOL':<14}{'CHIPS':>12}{'READY':>8}{'WAIT':>7}"
+              f"{'DECIDE_P50':>12}{'DECIDE_P95':>12}{'ACTUATE_P95':>13}")
+    print(header)
+    for name in sorted(pools):
+        p = pools[name]
+        prof = profile.get(name) or {}
+        chips = f"{p.get('booked_chips', 0)}/{p.get('total_chips', 0)}"
+        print(f"  {name:<14}{chips:>12}{p.get('ready_jobs', 0):>8}"
+              f"{p.get('waiting_jobs', 0):>7}"
+              f"{prof.get('decide_ms_p50', 0.0):>12.3f}"
+              f"{prof.get('decide_ms_p95', 0.0):>12.3f}"
+              f"{prof.get('actuate_ms_p95', 0.0):>13.3f}")
+    last = stats.get("last_pass")
+    if last:
+        print(f"  last fleet pass: {len(last.get('pools', ()))} pool(s) in "
+              f"{last.get('wall_ms', 0.0):.3f}ms "
+              f"(generation {last.get('generation')})")
+    router = stats.get("router")
+    if router:
+        mix = " ".join(f"{k}={v}" for k, v in
+                       sorted((router.get("by_reason") or {}).items()))
+        ms = router.get("route_ms") or {}
+        print(f"router: enabled={router.get('enabled')} "
+              f"decisions={router.get('decisions_total', 0)} [{mix or '-'}]")
+        print(f"  route latency (last {ms.get('count', 0)}): "
+              f"p50={ms.get('p50', 0.0):.4f}ms p99={ms.get('p99', 0.0):.4f}ms")
 
 
 def _print_explain(job: str, payload: dict, limit: int = 20) -> None:

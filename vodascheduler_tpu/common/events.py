@@ -93,6 +93,12 @@ class EventBus:
         # only ever guards map/queue bookkeeping — delivery always runs
         # with it released.
         self._lock = threading.RLock()
+        # Daemon drainer threads handed the remainder of a capped drain:
+        # named (voda-event-drain-<topic>), enumerable, and joined by
+        # close() — at fleet scale (pools >> 8) leaked drainers are the
+        # teardown race the 16-pool hygiene test pins.
+        self._drainer_threads: Set[threading.Thread] = set()
+        self._closed = False
         self._registry = registry
         self._m_dropped = None
         if registry is not None:
@@ -156,6 +162,17 @@ class EventBus:
         events = list(events)
         dropped = 0
         with self._lock:
+            if self._closed:
+                # A closed bus takes no new hand-offs: the all-or-nothing
+                # path still owns its events (rollback works), the
+                # best-effort path logs instead of silently queueing into
+                # a bus nobody will ever drain again.
+                if all_or_nothing:
+                    raise EventQueueFull(topic, len(events), 0)
+                logging.getLogger(__name__).warning(
+                    "event bus closed: dropping %d event(s) for %r",
+                    len(events), topic)
+                return
             q = self._queue_locked(topic)
             if all_or_nothing:
                 free = self._queue_max - q.qsize()
@@ -192,6 +209,8 @@ class EventBus:
         if not items:
             return
         with self._lock:
+            if self._closed:
+                raise EventQueueFull(items[0][0], len(items[0][1]), 0)
             for topic, events in items:
                 q = self._queue_locked(topic)
                 free = self._queue_max - q.qsize()
@@ -255,9 +274,49 @@ class EventBus:
         # publisher — if someone else already won, it no-ops; either
         # way nothing strands.
         if self.pending(topic):
-            threading.Thread(target=self._drain, args=(topic,),
-                             name=f"voda-event-drain-{topic}",
-                             daemon=True).start()
+            thread = threading.Thread(target=self._drain_and_untrack,
+                                      args=(topic,),
+                                      name=f"voda-event-drain-{topic}",
+                                      daemon=True)
+            with self._lock:
+                if self._closed:
+                    return
+                self._drainer_threads.add(thread)
+            thread.start()
+
+    def _drain_and_untrack(self, topic: str) -> None:
+        try:
+            self._drain(topic)
+        finally:
+            with self._lock:
+                self._drainer_threads.discard(threading.current_thread())
+
+    def drainer_threads(self) -> List[threading.Thread]:
+        """Live daemon drainer threads (enumerable by name for teardown
+        hygiene checks; the transient winners draining inline on
+        publisher threads are not listed — they are the publisher)."""
+        with self._lock:
+            return [t for t in self._drainer_threads if t.is_alive()]
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting events and join every daemon drainer thread.
+        Idempotent. In-flight deliveries finish (subscriber callbacks
+        are never interrupted mid-event); events still queued after the
+        join are intentionally left undelivered — the control plane is
+        tearing down, and a late CREATE firing into a closed scheduler
+        would be the worse bug."""
+        with self._lock:
+            self._closed = True
+            threads = list(self._drainer_threads)
+        for t in threads:
+            if t is not threading.current_thread():
+                t.join(timeout=timeout)
+        with self._lock:
+            leaked = [t.name for t in self._drainer_threads if t.is_alive()]
+        if leaked:
+            logging.getLogger(__name__).warning(
+                "event-bus close: drainer thread(s) still alive after "
+                "%.1fs: %s", timeout, leaked)
 
     @staticmethod
     def _deliver(sub: Callable[[JobEvent], None], event: JobEvent) -> None:

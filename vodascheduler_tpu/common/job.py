@@ -144,6 +144,28 @@ def base_job_info(name: str, category: str, pool: str,
                    speedup=speedup, efficiency=efficiency)
 
 
+# The linear prior's curves are identical for every fresh job; these
+# shared, treat-as-immutable dicts back `shared_base_job_info` so a
+# 100k-job fleet admission seeds two dict REFERENCES per job instead of
+# two ~500-entry dicts per job (whose eventual gen-2 GC pause lands
+# inside a later decide window — the PR 8 finding, recurring at fleet
+# scale through the admission seeding path). The metrics collector — the
+# one in-place curve mutator in the tree — rebinds fresh copies before
+# its first write (copy-on-write), so sharing can never cross-contaminate
+# jobs.
+_SHARED_PRIOR = base_job_info("", "", "")
+
+
+def shared_base_job_info(name: str, category: str, pool: str) -> JobInfo:
+    """A fresh job's linear-speedup prior with SHARED curve dicts (see
+    _SHARED_PRIOR). Use for bulk seeding; callers that intend to mutate
+    curves in place must copy them first."""
+    return JobInfo(name=name, category=category, pool=pool,
+                   estimated_remaining_seconds=0.0,
+                   speedup=_SHARED_PRIOR.speedup,
+                   efficiency=_SHARED_PRIOR.efficiency)
+
+
 @dataclasses.dataclass
 class JobSpec:
     """Native job specification submitted by the user (YAML/JSON/dataclass).

@@ -275,13 +275,32 @@ def _merge_labels(const: Dict[str, str], names: Tuple[str, ...],
 class Registry:
     def __init__(self) -> None:
         self._metrics: List[object] = []
+        # (name, label_names, const_labels) of every registration: N
+        # pools legitimately repeat a family name with DIFFERENT
+        # const-labels (pool="v5p" vs pool="v5e"); two instruments with
+        # the SAME identity would expose duplicate sample lines that
+        # Prometheus rejects and that double-count silently in-process
+        # — the collision class a 16-pool app must fail loudly on.
+        self._identities: set = set()
         # Multi-pool apps register instruments while scrape threads run
         # exposition(): same locked-access contract as the instruments
         # themselves (vodalint metrics-lock).
         self._lock = threading.Lock()
 
     def register(self, metric):
+        identity = (metric.name,
+                    tuple(getattr(metric, "label_names", ()) or ()),
+                    tuple(sorted((getattr(metric, "const_labels", None)
+                                  or {}).items())))
         with self._lock:
+            if identity in self._identities:
+                const = dict(identity[2])
+                raise ValueError(
+                    f"duplicate metric registration: {metric.name!r} with "
+                    f"labels {identity[1]} const_labels {const} is already "
+                    f"registered — two pools sharing one Registry must "
+                    f"disambiguate with const-labels (e.g. pool=<name>)")
+            self._identities.add(identity)
             self._metrics.append(metric)
         return metric
 

@@ -109,6 +109,24 @@ ADMISSION_RETRY_AFTER_SECONDS = _env_float(
 # they are cached on state/store version stamps and always exact.
 METRICS_CACHE_SECONDS = _env_float("VODA_METRICS_CACHE_SECONDS", "0")
 
+# --- Fleet control plane (doc/observability.md "Fleet decide") --------------
+# Bound on the fleet coordinator's concurrent per-pool decide passes:
+# how many pools may run their decide phase at once on the shared
+# executor. Per-pool scheduler locks keep the passes independent; the
+# bound keeps an N-pool fleet from spawning N decide threads against
+# one shared store/allocator. 1 restores strictly serial per-pool
+# passes (the pre-fleet behavior).
+FLEET_WORKERS = int(_env_float("VODA_FLEET_WORKERS", "8"))
+
+# Cross-pool admission router: jobs admitted WITHOUT an explicit pool
+# (pool "" / "auto", or the unconfigured default on a multi-pool fleet)
+# are placed by fleet-wide score — free chips, queue depth, and
+# family<->topology comms affinity (doc/observability.md "Fleet
+# decide"). VODA_FLEET_ROUTER=0 restores the static-pool reference
+# path: one queue per declared pool, unrouted specs rejected at
+# admission exactly as before.
+FLEET_ROUTER = os.environ.get("VODA_FLEET_ROUTER", "1") != "0"
+
 # Migration payback window (doc/placement.md): an optimization
 # migration (pure re-binding — same size, all hosts alive) fires only
 # when its modeled step-time win, earned over this many seconds of

@@ -169,6 +169,15 @@ class MetricsCollector:
                 step = getattr(r, "step_time_sec", 0.0)
                 if step and step > 0:
                     by_workers_step.setdefault(r.workers, []).append(step)
+        # Copy-on-write before the first in-place curve mutation: fresh
+        # jobs are seeded with SHARED immutable prior dicts
+        # (shared_base_job_info — one pair of ~500-entry dicts per
+        # fleet, not per job); writing through a shared reference would
+        # contaminate every sibling's curves.
+        info.epoch_seconds = dict(info.epoch_seconds)
+        info.step_seconds = dict(info.step_seconds)
+        info.speedup = dict(info.speedup)
+        info.efficiency = dict(info.efficiency)
         for n, times in by_workers.items():
             info.epoch_seconds[n] = sum(times) / len(times)
             steps = by_workers_step.get(n)

@@ -85,6 +85,32 @@ def get_lib() -> Optional[ctypes.CDLL]:
             except AttributeError:  # pragma: no cover - stale binary
                 log.debug("stale native binary lacks the warm kernels; "
                           "rebuild with `make native`")
+            # PR 11 fleet batch kernels (greedy sweeps, the
+            # ElasticTiresias auction, fleet comms scoring) — same
+            # lenient binding: a stale prebuilt .so keeps serving the
+            # older ABI and callers fall back to the Python fastpath.
+            try:
+                i32p = ctypes.POINTER(ctypes.c_int32)
+                i64p = ctypes.POINTER(ctypes.c_int64)
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                f64p = ctypes.POINTER(ctypes.c_double)
+                lib.voda_alloc_sweep.argtypes = [
+                    ctypes.c_int32, i32p, i32p, i32p, i32p,
+                    ctypes.c_int32, ctypes.c_int32, i32p]
+                lib.voda_alloc_sweep.restype = None
+                lib.voda_et_schedule.argtypes = [
+                    ctypes.c_int32, i32p, i32p, i32p, i32p, i32p,
+                    u8p, u8p, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_double, i32p, i64p, f64p, ctypes.c_int32,
+                    i32p, i32p]
+                lib.voda_et_schedule.restype = None
+                lib.voda_comms_score.argtypes = [
+                    ctypes.c_int32, i32p, ctypes.c_int32, i64p, i32p,
+                    i32p, u8p, i64p, i64p]
+                lib.voda_comms_score.restype = None
+            except AttributeError:  # pragma: no cover - stale binary
+                log.debug("stale native binary lacks the fleet batch "
+                          "kernels; rebuild with `make native`")
             _lib = lib
         except OSError as e:
             log.debug("native load failed: %s", e)
@@ -165,6 +191,153 @@ def lexmin_pm(tight, row_to_col: List[int]):
     c_rtc = (ctypes.c_int32 * n)(*row_to_col)
     lexmin_fn(n, c_tight, c_rtc)
     return [int(c_rtc[i]) for i in range(n)]
+
+
+def _i32(values) -> "object":
+    """int32 ctypes view of a Python int sequence via numpy (a pure-
+    ctypes splat costs more than some kernels it feeds at 100k jobs).
+    Returns (array-keepalive, pointer)."""
+    import numpy as np
+    arr = np.asarray(values, dtype=np.int32)
+    if not arr.flags["C_CONTIGUOUS"]:  # pragma: no cover - asarray copies
+        arr = np.ascontiguousarray(arr)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64(values):
+    import numpy as np
+    arr = np.asarray(values, dtype=np.int64)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _u8(values):
+    import numpy as np
+    arr = np.asarray(values, dtype=np.uint8)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _f64(values):
+    import numpy as np
+    arr = np.asarray(values, dtype=np.float64)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def alloc_sweep(order: Sequence[int], mins: Sequence[int],
+                maxes: Sequence[int], nums: Sequence[int],
+                free_chips: int, mode: int) -> Optional[List[int]]:
+    """Native greedy allocation sweep (fastpath.py semantics): mode
+    0 = minimums only, 1 = minimums + water-filled leftover, 2 = fixed
+    NumProc. Returns the per-index result list, or None when the kernel
+    is unavailable (callers keep the pure-Python sweeps)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.voda_alloc_sweep
+    except AttributeError:  # pragma: no cover - stale prebuilt binary
+        return None
+    n = len(order)
+    if n == 0:
+        return []
+    try:
+        import numpy as np
+    except ImportError:  # pragma: no cover - numpy ships with jax
+        return None
+    k_order, p_order = _i32(order)
+    k_mins, p_mins = _i32(mins)
+    k_maxes, p_maxes = _i32(maxes)
+    k_nums, p_nums = _i32(nums)
+    out = np.zeros(n, dtype=np.int32)
+    fn(n, p_order, p_mins, p_maxes, p_nums, int(free_chips), int(mode),
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    del k_order, k_mins, k_maxes, k_nums
+    return out.tolist()
+
+
+def et_schedule(order: Sequence[int], mins: Sequence[int],
+                maxes: Sequence[int], nums: Sequence[int],
+                prios: Sequence[int], lease_ok: Sequence[int],
+                lift_ok: Sequence[int], free_chips: int,
+                compaction_threshold: int, floor_lift_weight: float,
+                curve_idx: Sequence[int], curve_off: Sequence[int],
+                curves: Sequence[float], run_auction: bool = True
+                ) -> Optional[Tuple[List[int], int]]:
+    """Native ElasticTiresias, bit-identical to
+    fastpath.py::elastic_tiresias: phases 0/1/compaction always, plus
+    the lazy-heap auction when `run_auction` (curves arrive
+    deduplicated — job i reads row curve_idx[i]; row c spans
+    curve_off[c]..curve_off[c+1] of the flat `curves`; with
+    run_auction=False they may be dummies and the caller finishes with
+    the retained Python auction). Returns (result, post-phase free) or
+    None when the kernel is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.voda_et_schedule
+    except AttributeError:  # pragma: no cover - stale prebuilt binary
+        return None
+    n = len(order)
+    if n == 0:
+        return [], int(free_chips)
+    try:
+        import numpy as np
+    except ImportError:  # pragma: no cover - numpy ships with jax
+        return None
+    keep = []
+    ptrs = []
+    for conv, values in ((_i32, order), (_i32, mins), (_i32, maxes),
+                         (_i32, nums), (_i32, prios), (_u8, lease_ok),
+                         (_u8, lift_ok), (_i32, curve_idx),
+                         (_i64, curve_off), (_f64, curves)):
+        arr, ptr = conv(values)
+        keep.append(arr)
+        ptrs.append(ptr)
+    out = np.zeros(n, dtype=np.int32)
+    free_out = ctypes.c_int32(0)
+    fn(n, ptrs[0], ptrs[1], ptrs[2], ptrs[3], ptrs[4], ptrs[5], ptrs[6],
+       int(free_chips), int(compaction_threshold),
+       float(floor_lift_weight), ptrs[7], ptrs[8], ptrs[9],
+       1 if run_auction else 0,
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       ctypes.byref(free_out))
+    del keep
+    return out.tolist(), int(free_out.value)
+
+
+def comms_score(grid: Sequence[int], offsets: Sequence[int],
+                coords: Sequence[int], weights: Sequence[int],
+                crossed: Sequence[int]
+                ) -> Optional[Tuple[List[int], Tuple[int, int, int]]]:
+    """Native fleet comms scoring (placement manager `_fleet_stats`
+    semantics): per-job contiguity costs plus the (cross, contiguity,
+    comms) fleet totals. `coords` is row-major (sum of per-job host
+    counts) x len(grid). None when the kernel is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.voda_comms_score
+    except AttributeError:  # pragma: no cover - stale prebuilt binary
+        return None
+    n_jobs = len(weights)
+    try:
+        import numpy as np
+    except ImportError:  # pragma: no cover - numpy ships with jax
+        return None
+    k_grid, p_grid = _i32(grid)
+    k_off, p_off = _i64(offsets)
+    k_coords, p_coords = _i32(coords)
+    k_w, p_w = _i32(weights)
+    k_x, p_x = _u8(crossed)
+    out_contig = np.zeros(max(1, n_jobs), dtype=np.int64)
+    out_totals = np.zeros(3, dtype=np.int64)
+    fn(len(grid), p_grid, n_jobs, p_off, p_coords, p_w, p_x,
+       out_contig.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+       out_totals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    del k_grid, k_off, k_coords, k_w, k_x
+    return (out_contig.tolist()[:n_jobs],
+            (int(out_totals[0]), int(out_totals[1]), int(out_totals[2])))
 
 
 def ffdl_dp(K: int, lo: Sequence[int], hi: Sequence[int],

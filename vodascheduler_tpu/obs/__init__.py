@@ -19,6 +19,7 @@ See doc/observability.md.
 from vodascheduler_tpu.obs.audit import (  # noqa: F401
     PHASE_NAMES,
     REASON_CODES,
+    ROUTE_REASONS,
     SPAN_NAMES,
     STATUS_REASONS,
     TRIGGERS,

@@ -82,6 +82,21 @@ STATUS_REASONS = frozenset({
     "user_delete",   # -> Canceled
 })
 
+# Why the cross-pool admission router placed a job where it did
+# (doc/observability.md "Fleet decide"): every `fleet_route` record
+# carries one or more of these, closed both ways like REASON_CODES —
+# vodalint's vocab rule checks `_add_route_reason` literals forward and
+# sweeps usage in reverse, so the router can never grow an untyped
+# placement rationale.
+ROUTE_REASONS = frozenset({
+    "explicit_pool",     # the spec named a configured pool; router passthrough
+    "single_pool",       # one-pool fleet: the route is trivial
+    "best_score",        # fleet-wide score winner (free chips - backlog)
+    "affinity_preferred",  # comms-weighted job steered to the densest
+                           # feasible topology (family<->topology affinity)
+    "router_disabled",   # VODA_FLEET_ROUTER=0: static default-pool path
+})
+
 # The decide/actuate sub-stages the performance observatory times
 # (obs/profile.py; doc/observability.md "Performance observatory").
 # Closed both ways like the other vocabularies: every literal
@@ -104,6 +119,10 @@ PHASE_NAMES = frozenset({
     "actuate_release",   # actuate: wave 1 — halts + scale-ins
     "actuate_claim",     # actuate: wave 2 — starts + scale-outs
     "actuate_migrate",   # actuate: trailing wave — re-bindings
+    "fleet_decide",      # fleet: the concurrent per-pool decide fan-out
+                         # (one entry per fleet pass, fleet coordinator)
+    "route",             # fleet: cross-pool admission routing (score +
+                         # pick, per routed burst)
 })
 
 # Every span name the package may emit (the trace file's third closed
@@ -114,6 +133,9 @@ PHASE_NAMES = frozenset({
 # HERE (and to doc/observability.md) before it can ship.
 SPAN_NAMES = frozenset({
     "resched",               # scheduler: one pass's root span
+    "fleet",                 # fleet coordinator: one concurrent multi-pool
+                             # decide fan-out (doc/observability.md
+                             # "Fleet decide")
     "admission.batch",       # service: one bulk-admission commit+publish
     "allocator.allocate",
     "placement.place",
@@ -136,6 +158,8 @@ _REQUIRED_COUNTEREXAMPLE_FIELDS = ("kind", "schema", "ts", "violation",
 _REQUIRED_PERF_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
                          "outcome", "duration_ms", "cpu_ms", "decide_ms",
                          "actuate_ms", "num_jobs", "phases")
+_REQUIRED_ROUTE_FIELDS = ("kind", "schema", "ts", "job", "pool", "reasons",
+                          "scores")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -157,7 +181,26 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _check_fields(rec, _REQUIRED_COUNTEREXAMPLE_FIELDS)
     if kind == "perf_report":
         return _validate_perf(rec)
+    if kind == "fleet_route":
+        return _validate_route(rec)
     return [f"unknown record kind {kind!r}"]
+
+
+def _validate_route(rec: Dict[str, Any]) -> List[str]:
+    """One cross-pool admission routing decision (doc/observability.md
+    "Fleet decide"): which pool got the job and why, with the per-pool
+    scores the router compared — the audit trail that makes a surprising
+    placement explainable after the fact."""
+    problems = _check_fields(rec, _REQUIRED_ROUTE_FIELDS)
+    reasons = rec.get("reasons", ())
+    if not reasons:
+        problems.append("fleet_route has no reasons")
+    for code in reasons:
+        if code not in ROUTE_REASONS:
+            problems.append(f"unknown route reason {code!r}")
+    if not isinstance(rec.get("scores", {}), dict):
+        problems.append("scores is not an object")
+    return problems
 
 
 def _validate_perf(rec: Dict[str, Any]) -> List[str]:

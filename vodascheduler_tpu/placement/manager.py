@@ -498,7 +498,18 @@ class PlacementManager:
     def _fleet_stats(self) -> Tuple[int, int, int]:
         """(#jobs crossing hosts, total contiguity, total comms score)
         over the whole current fleet — the post-bind re-score defragment
-        needs (the Hungarian relabel moves coords under packed jobs)."""
+        needs (the Hungarian relabel moves coords under packed jobs).
+        Batched onto the native comms kernel when available (the O(jobs
+        x hosts^2) pairwise torus sums were the 100k-fleet re-score
+        wall); `_fleet_stats_reference` is the retained Python oracle —
+        VODA_NO_NATIVE (or no topology) falls back to it, and the
+        differential suite pins native == reference."""
+        native_out = self._fleet_stats_native()
+        if native_out is not None:
+            return native_out
+        return self._fleet_stats_reference()
+
+    def _fleet_stats_reference(self) -> Tuple[int, int, int]:
         cross = 0
         contiguity = 0
         comms = 0
@@ -508,6 +519,47 @@ class PlacementManager:
             contiguity += contig
             comms += self._weight_of(job) * contig
         return cross, contiguity, comms
+
+    def _fleet_stats_native(self) -> Optional[Tuple[int, int, int]]:
+        """One `voda_comms_score` call for the whole fleet. Only the
+        pairwise torus sums move to C++; which hosts a job occupies (the
+        crossed flag) stays Python bookkeeping, so the kernel's contract
+        is pure integer geometry — bit-identical trivially (the pairwise
+        sum is permutation-invariant, so set iteration order is
+        irrelevant)."""
+        if self.topology is None or not self.job_placements:
+            return None
+        from vodascheduler_tpu import native
+
+        if native.get_lib() is None:
+            return None
+        grid = self.topology.host_grid
+        ndims = len(grid)
+        host_states = self.host_states
+        offsets: List[int] = [0]
+        coords: List[int] = []
+        weights: List[int] = []
+        crossed: List[int] = []
+        n_coords = 0
+        for job, placement in self.job_placements.items():
+            used = {hs.host for hs in placement.host_slots
+                    if hs.num_slots > 0}
+            if len(used) > 1:
+                crossed.append(1)
+                for h in used:
+                    st = host_states.get(h)
+                    if st is not None and st.coord is not None:
+                        coords.extend(st.coord)
+                        n_coords += 1
+            else:
+                crossed.append(0)
+            offsets.append(n_coords)
+            weights.append(self._weight_of(job))
+        out = native.comms_score(grid, offsets, coords, weights, crossed)
+        if out is None:
+            return None
+        _contigs, totals = out
+        return totals
 
     def _decision(self, old_worker_hosts: Dict[str, List[str]],
                   cross: int, contiguity: int,

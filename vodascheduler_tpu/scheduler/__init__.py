@@ -4,4 +4,8 @@ Reference counterpart: pkg/scheduler — the heart of the system
 (SURVEY.md §3.2).
 """
 
-from vodascheduler_tpu.scheduler.scheduler import Scheduler
+from vodascheduler_tpu.scheduler.fleet import (  # noqa: F401
+    FleetCoordinator,
+    FleetRouter,
+)
+from vodascheduler_tpu.scheduler.scheduler import Scheduler  # noqa: F401

@@ -1935,6 +1935,14 @@ class Scheduler:
         observe (status, chips, priority, time accounting)."""
         self._state_version += 1
 
+    @property
+    def state_version(self) -> int:
+        """The read-path mutation stamp, read lock-free (int loads are
+        atomic) — cache keys for fleet-wide aggregations (the router's
+        load cache); a racing bump just forces the caller's next
+        rebuild."""
+        return self._state_version
+
     def _snapshot(self) -> Tuple[List[Dict[str, object]], bytes]:
         """The (rows, json-bytes) status snapshot, version-stamped.
 

@@ -37,8 +37,8 @@ from vodascheduler_tpu.common.job import (
     JobInfo,
     JobSpec,
     TrainingJob,
-    base_job_info,
     category_of,
+    shared_base_job_info,
     timestamped_name,
 )
 from vodascheduler_tpu.common.metrics import Registry, timed
@@ -75,11 +75,18 @@ class AdmissionService:
     def __init__(self, store: JobStore, bus: EventBus, clock: Clock,
                  registry: Optional[Registry] = None,
                  valid_pools: Optional[set] = None,
-                 tracer: Optional[obs_tracer.Tracer] = None):
+                 tracer: Optional[obs_tracer.Tracer] = None,
+                 router=None):
         self.store = store
         self.bus = bus
         self.clock = clock
         self.tracer = tracer
+        # Cross-pool admission router (scheduler/fleet.py FleetRouter,
+        # doc/observability.md "Fleet decide"): specs naming no pool are
+        # placed by fleet-wide score BEFORE the shed pre-check below —
+        # the routed pool is the queue whose backpressure applies. None
+        # = the static reference path (explicit pools only).
+        self.router = router
         # When set, jobs naming a pool outside it are rejected at
         # admission: the bus queues events for unsubscribed topics
         # silently, so an unvalidated typo'd (or defaulted) pool would be
@@ -177,6 +184,39 @@ class AdmissionService:
                      on_admitted=None) -> List[Dict[str, str]]:
         if not specs:
             return []
+        # Cross-pool routing first: a spec that names no pool gets its
+        # fleet-wide placement here, so the shed pre-check and the
+        # validation below see the pool the job will actually land in.
+        # Routing is per-spec and isolated — a router error becomes that
+        # spec's admission error (the batch's all-or-nothing semantics
+        # then reject the siblings), never a 500 for the whole burst.
+        # Decisions stay PENDING until the batch's outcome is known:
+        # committed (stats + fleet_route audit records) only once the
+        # jobs are truly handed off, aborted (in-flight reservations
+        # released, audit silent) on every shed/rejection/rollback path
+        # — so the audit trail never asserts placements that didn't
+        # happen, and a retried 429 burst can't accrete phantom backlog
+        # in the router's in-flight correction.
+        route_errors: Dict[int, str] = {}
+        pending_routes: List[dict] = []
+        if self.router is not None:
+            routed: List[JobSpec] = []
+            for i, spec in enumerate(specs):
+                if self.router.needs_route(spec.pool):
+                    try:
+                        pending = self.router.route_pending(spec)
+                        pending_routes.append(pending)
+                        spec = dataclasses.replace(spec,
+                                                   pool=pending["pool"])
+                    except Exception as e:  # noqa: BLE001 - per-item outcome
+                        route_errors[i] = str(e)
+                routed.append(spec)
+            specs = routed
+        if route_errors:
+            self._abort_routes(pending_routes)
+            return [{"name": s.name,
+                     "error": route_errors.get(i, BATCH_SIBLING_REJECTED)}
+                    for i, s in enumerate(specs)]
         # Backpressure first: a backlogged pool sheds the whole burst
         # before any validation/store work is spent on it — at the
         # watermark, or when this burst cannot fit WHOLE under the queue
@@ -187,6 +227,7 @@ class AdmissionService:
             if (self.bus.saturated(pool)
                     or self.bus.free_slots(pool) < per_pool[pool]):
                 self.m_shed.inc()
+                self._abort_routes(pending_routes)
                 raise AdmissionShed(
                     pool, retry_after=config.ADMISSION_RETRY_AFTER_SECONDS)
 
@@ -198,6 +239,7 @@ class AdmissionService:
                 errors[i] = (f"unknown pool {spec.pool!r}; configured "
                              f"pools: {sorted(self.valid_pools)}")
         if errors:
+            self._abort_routes(pending_routes)
             return [{"name": s.name,
                      "error": errors.get(i, BATCH_SIBLING_REJECTED)}
                     for i, s in enumerate(specs)]
@@ -257,7 +299,12 @@ class AdmissionService:
                     info.current_epoch = -1
                     info.remaining_epochs = spec.config.epochs
                 else:
-                    info = base_job_info(name, category, spec.pool)
+                    # Shared immutable prior curves: a 100k-job fleet
+                    # admission must not mint 100k ~500-entry dicts
+                    # whose gen-2 GC pause lands inside a later decide
+                    # window (the collector copy-on-writes before its
+                    # first curve mutation, so sharing is safe).
+                    info = shared_base_job_info(name, category, spec.pool)
 
                 jobs.append(TrainingJob.from_spec(spec, submit_time=now))
                 infos.append(info)
@@ -296,6 +343,7 @@ class AdmissionService:
             # same backpressure (429 + Retry-After), just detected one
             # step later.
             self.store.delete_jobs(names, with_infos=True)
+            self._abort_routes(pending_routes)
             self.m_shed.inc()
             raise AdmissionShed(
                 e.topic,
@@ -305,10 +353,24 @@ class AdmissionService:
             # wide: jobs the scheduler never hears about must not linger
             # in the store (one compensating bulk delete).
             self.store.delete_jobs(names, with_infos=True)
+            self._abort_routes(pending_routes)
             self.m_errors.inc()
             raise
+        if self.router is not None and pending_routes:
+            self.router.commit_routes(pending_routes)
         self.m_created.inc(len(names))
         return [{"name": name} for name in names]
+
+    def _abort_routes(self, pending_routes: List[dict]) -> None:
+        """Release pending router reservations on a failed batch —
+        best-effort: the admission outcome (shed/rejection/rollback)
+        must propagate even if the router bookkeeping hiccups."""
+        if self.router is None or not pending_routes:
+            return
+        try:
+            self.router.abort_routes(pending_routes)
+        except Exception:  # noqa: BLE001 - never mask the admission outcome
+            log.exception("router abort_routes failed")
 
     def delete_training_job(self, name: str) -> None:
         with timed(self.m_delete_duration):

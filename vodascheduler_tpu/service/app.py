@@ -228,10 +228,23 @@ class VodaApp:
         self.placement = self.placements[first]
         self.scheduler = self.schedulers[first]
         self.collector = self.collectors[first]
+        # Fleet control plane (doc/observability.md "Fleet decide"):
+        # concurrent per-pool decide on one bounded executor + the
+        # cross-pool admission router for specs that name no pool.
+        from vodascheduler_tpu.scheduler.fleet import (
+            FleetCoordinator,
+            FleetRouter,
+        )
+        self.router = FleetRouter(self.schedulers, tracer=self.tracer,
+                                  bus=self.bus)
+        self.fleet = FleetCoordinator(self.schedulers, tracer=self.tracer,
+                                      registry=self.registry,
+                                      router=self.router)
         self.admission = AdmissionService(self.store, self.bus, self.clock,
                                           registry=self.registry,
                                           valid_pools=set(names),
-                                          tracer=self.tracer)
+                                          tracer=self.tracer,
+                                          router=self.router)
         # Chip telemetry on the shared /metrics endpoints (reference
         # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
         # Collected only when this process may own a jax backend: hermetic
@@ -257,7 +270,8 @@ class VodaApp:
             self.tpu_monitor = TpuMonitor(self.registry)
             periodic.append((30.0, self.tpu_monitor.collect_once))
         self.daemon = SchedulerDaemon(list(self.schedulers.values()),
-                                      periodic=periodic)
+                                      periodic=periodic,
+                                      coordinator=self.fleet)
 
         # Warm the native kernels off the resched hot path (first use would
         # otherwise block a resched on a synchronous g++ build).
@@ -269,7 +283,8 @@ class VodaApp:
         self.service_server = make_service_server(
             self.admission, self.registry, host=host, port=service_port)
         self.scheduler_server = make_scheduler_server(
-            self.schedulers, self.registry, host=host, port=scheduler_port)
+            self.schedulers, self.registry, host=host, port=scheduler_port,
+            fleet=self.fleet)
         self.allocator_server = make_allocator_server(
             self.allocator, self.registry, host=host, port=allocator_port)
 
@@ -294,8 +309,14 @@ class VodaApp:
         self.scheduler_server.stop()
         self.allocator_server.stop()
         self.daemon.stop()
+        self.fleet.close()
         for sched in self.schedulers.values():
             sched.stop()
+        # The bus joins its drainer threads before the backends close:
+        # a late drain delivering into a closed backend is the teardown
+        # race the 16-pool hygiene test pins (doc/observability.md
+        # "Fleet decide").
+        self.bus.close()
         for be in self.backends.values():
             if hasattr(be, "close"):
                 be.close()

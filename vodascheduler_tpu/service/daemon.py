@@ -20,10 +20,17 @@ class SchedulerDaemon:
 
     def __init__(self, schedulers: Sequence, poll_seconds: float = 0.5,
                  ticker_seconds: float = 5.0,
-                 periodic: Optional[List[tuple]] = None):
+                 periodic: Optional[List[tuple]] = None,
+                 coordinator=None):
         """`periodic` is a list of (interval_seconds, fn) extras — e.g. the
-        metrics collector's collect_all at its cron interval."""
+        metrics collector's collect_all at its cron interval.
+        `coordinator` (scheduler/fleet.py FleetCoordinator) makes the
+        pump phase concurrent: due pools run their passes on the
+        bounded fleet executor instead of one-after-another on this
+        thread, so a slow pool's decide never delays another pool's
+        window (doc/observability.md "Fleet decide")."""
         self.schedulers = list(schedulers)
+        self.coordinator = coordinator
         self.poll_seconds = poll_seconds
         self.ticker_seconds = ticker_seconds
         # last-fire timestamp + in-flight flag per periodic callback.
@@ -58,12 +65,21 @@ class SchedulerDaemon:
             # every pool forever (observed live in r4: an exception out
             # of pump() silently killed the daemon and stranded every
             # waiting job).
-            for sched in self.schedulers:
+            if self.coordinator is not None and len(self.schedulers) > 1:
                 try:
-                    sched.pump()
+                    # Concurrent pump: due pools fan out on the fleet
+                    # executor (per-pool failure isolation lives inside
+                    # run_pending — one pool's raise is logged there).
+                    self.coordinator.run_pending()
                 except Exception:
-                    log.exception("scheduler pump failed (pool %s)",
-                                  getattr(sched, "pool_id", "?"))
+                    log.exception("fleet pump failed")
+            else:
+                for sched in self.schedulers:
+                    try:
+                        sched.pump()
+                    except Exception:
+                        log.exception("scheduler pump failed (pool %s)",
+                                      getattr(sched, "pool_id", "?"))
             if now - self._last_tick >= self.ticker_seconds:
                 self._last_tick = now
                 for sched in self.schedulers:

@@ -371,7 +371,8 @@ def make_service_server(admission: AdmissionService, registry: Registry,
 
 def make_scheduler_server(scheduler, registry: Registry,
                           host: str = "0.0.0.0",
-                          port: int = config.SCHEDULER_PORT) -> RestServer:
+                          port: int = config.SCHEDULER_PORT,
+                          fleet=None) -> RestServer:
     """Scheduler API (reference: scheduler.go:256-261).
 
     Accepts a single Scheduler or a {pool: Scheduler} dict; with several
@@ -456,6 +457,19 @@ def make_scheduler_server(scheduler, registry: Registry,
         n = int(query.get("n", ["20"])[0])
         return 200, pick(body, query).profile_records(n)
 
+    def debug_fleet(body, query):
+        """One fleet view over every pool (doc/observability.md "Fleet
+        decide"): lock-free per-pool load snapshot, per-pool decide/
+        actuate + phase percentiles, the last fleet fan-out, and the
+        cross-pool router's decision stats. Backs `voda top --fleet`.
+        Served even without a coordinator (single-pool deployments get
+        the aggregation over their one scheduler)."""
+        n = int(query.get("n", ["50"])[0])
+        if fleet is not None:
+            return 200, fleet.fleet_stats(n)
+        from vodascheduler_tpu.scheduler.fleet import FleetCoordinator
+        return 200, FleetCoordinator(schedulers).fleet_stats(n)
+
     return RestServer({
         ("GET", "/training"): get_training,
         ("PUT", "/algorithm"): put_algorithm,
@@ -465,6 +479,7 @@ def make_scheduler_server(scheduler, registry: Registry,
         ("GET", "/debug/trace"): debug_trace,
         ("GET", "/debug/trace/*"): debug_trace,
         ("GET", "/debug/profile"): debug_profile,
+        ("GET", "/debug/fleet"): debug_fleet,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
 
