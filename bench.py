@@ -126,6 +126,21 @@ def placement_comms_detail():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def fractional_sharing_detail():
+    """The fractional-sharing A/B (doc/fractional-sharing.md "Proof"):
+    the bimodal topology mix replayed with sub-host co-tenancy on vs
+    the whole-host-minimum baseline (VODA_FRACTIONAL_SHARING=0
+    semantics), both under the interference-sensitive step-time model —
+    sharing must recover >= 3 raw-utilization points from the small-job
+    tail's stranded sub-host chips at large-job JCT no worse than 2%
+    (pinned by tests/test_replay.py)."""
+    from vodascheduler_tpu.replay.compare import fractional_sharing_ab
+    try:
+        return fractional_sharing_ab()
+    except Exception as e:  # noqa: BLE001 - a detail row, not the headline
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def decide_scaling(repo_dir: str) -> object:
     """The decide-path scaling curves (doc/perf_baseline.json, the
     performance observatory): per-N decide/actuate wall time and the
@@ -511,6 +526,7 @@ def main() -> None:
         # count-only baseline on penalty and avg JCT.
         "comms_penalty_mean": report.comms_penalty_mean,
         "placement_comms": placement_comms_detail(),
+        "fractional_sharing": fractional_sharing_detail(),
         "knobs": {"rate_limit_seconds": RATE_LIMIT_SECONDS,
                   "scale_out_hysteresis": SCALE_OUT_HYSTERESIS,
                   "resize_cooldown_seconds": RESIZE_COOLDOWN_SECONDS},

@@ -81,7 +81,7 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 5  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
+SCHEMA = 6  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # suite grew the top-level "ingestion" section (bulk/single admission,
 # storm-to-quiescent, snapshot-cache reads); v4: curves grew the
 # "placement_scoring" column (the bandwidth-aware objective's fleet
@@ -89,7 +89,11 @@ SCHEMA = 5  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # N jobs routed across >=8 heterogeneous pools, concurrent multi-pool
 # decide fan-outs on the fleet executor, per-pool decide p95, fleet
 # pass throughput, and router latency (doc/observability.md "Fleet
-# decide").
+# decide"); v6: the top-level "fractional" section — the same decide
+# curves re-measured on a TOPOLOGY-MODELED pool with a fractional-mix
+# queue (sub-host resource classes, interference weights, feasibility
+# rounding all live — doc/fractional-sharing.md), so the PR 8 <50 ms
+# pin holds with fractional jobs in the vector.
 
 # Fleet points measured by default: the gate-bounded small fleet and
 # the 100k-job headline (ROADMAP "next order of magnitude").
@@ -115,9 +119,18 @@ INGEST_PASS_BOUND = (2.0, 2)  # fresh <= base * 2 + 2
 
 
 def build_world(n_jobs: int, seed: int,
-                rate_limit_seconds: float = DEFAULT_RATE_LIMIT):
+                rate_limit_seconds: float = DEFAULT_RATE_LIMIT,
+                fractional: bool = False):
     """One pool sized to its queue: ~1 host per 8 jobs, so demand
-    saturates capacity (every pass allocates under contention)."""
+    saturates capacity (every pass allocates under contention).
+
+    `fractional` (schema 6, doc/fractional-sharing.md): model the pool
+    as a 1D host ring topology so the whole fractional plane is live —
+    resource-class resolution, within-block feasibility rounding,
+    interference weights, co-tenancy pricing, and the backend's
+    interference-sensitive physics. The default world stays un-modeled
+    (the classic decide curves measure the same code path they always
+    did)."""
     from vodascheduler_tpu.allocator import ResourceAllocator
     from vodascheduler_tpu.cluster.fake import FakeClusterBackend
     from vodascheduler_tpu.common.clock import VirtualClock
@@ -125,6 +138,7 @@ def build_world(n_jobs: int, seed: int,
     from vodascheduler_tpu.common.store import JobStore
     from vodascheduler_tpu.obs import tracer as obs_tracer
     from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.placement.topology import default_pool
     from vodascheduler_tpu.scheduler import Scheduler
     from vodascheduler_tpu.service import AdmissionService
 
@@ -134,9 +148,12 @@ def build_world(n_jobs: int, seed: int,
     bus = EventBus()
     backend = FakeClusterBackend(clock)
     hosts = max(2, n_jobs // CHIPS_PER_HOST)
+    topology = default_pool(hosts, CHIPS_PER_HOST) if fractional else None
     for i in range(hosts):
         backend.add_host(f"host-{i}", CHIPS_PER_HOST, announce=False)
-    pm = PlacementManager("perf-pool")
+    if topology is not None:
+        backend.set_topology(topology)
+    pm = PlacementManager("perf-pool", topology=topology)
     sched = Scheduler("perf-pool", backend, store, ResourceAllocator(store),
                       clock, bus=bus, placement_manager=pm,
                       algorithm="ElasticTiresias",
@@ -145,8 +162,23 @@ def build_world(n_jobs: int, seed: int,
     return clock, store, backend, sched, admission, random.Random(seed)
 
 
-def _make_spec(i: int, rng: random.Random):
+def _make_spec(i: int, rng: random.Random, fractional: bool = False):
     from vodascheduler_tpu.common.job import JobConfig, JobSpec
+    if fractional:
+        # Fractional-mix queue (doc/fractional-sharing.md): a long tail
+        # of sub-host tenants (incl. non-power-of-two partitions that
+        # only the fractional table admits, and explicit classes) next
+        # to whole-host jobs.
+        max_chips = rng.choice((1, 2, 2, 3, 4, 5, 8))
+        rc = rng.choice(("auto", "auto", "auto", "fractional",
+                         "whole_host"))
+        if rc == "fractional" and max_chips >= CHIPS_PER_HOST:
+            max_chips = CHIPS_PER_HOST - 1
+        return JobSpec(name=f"perf-{i:05d}", pool="perf-pool",
+                       resource_class=rc,
+                       config=JobConfig(min_num_chips=1,
+                                        max_num_chips=max_chips,
+                                        epochs=100000))
     # Small elastic jobs (the long-tail shape a 10k-job pool actually
     # carries); epochs huge so nothing completes mid-measurement.
     max_chips = rng.choice((1, 2, 2, 4, 4, 8))
@@ -226,15 +258,21 @@ def _probe_placement_scoring(sched) -> Dict[str, object]:
 
 def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
               seed: int = DEFAULT_SEED,
-              inject: Optional[Tuple[str, float]] = None) -> Dict[str, object]:
+              inject: Optional[Tuple[str, float]] = None,
+              fractional: bool = False) -> Dict[str, object]:
     """Measure one N: warm-up fill pass, then `passes` churn-triggered
     passes, aggregated from their perf_report records.
 
     `inject` = (phase, sleep_ms) seeds a deliberate slowdown into the
     named stage ("placement" or "allocate") — the gate's self-test
     (tests/test_perf_profile.py) proves a seeded regression is caught.
+
+    `fractional` (schema 6): the same measurement on a topology-modeled
+    pool with a fractional-mix queue — the column proving the PR 8
+    <50 ms decide pin survives with fractional jobs in the vector.
     """
-    clock, store, backend, sched, admission, rng = build_world(n_jobs, seed)
+    clock, store, backend, sched, admission, rng = build_world(
+        n_jobs, seed, fractional=fractional)
 
     if inject is not None:
         phase_name, sleep_ms = inject
@@ -261,62 +299,77 @@ def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
 
     alive: List[str] = []
     for i in range(n_jobs):
-        alive.append(admission.create_training_job(_make_spec(i, rng)))
+        alive.append(admission.create_training_job(
+            _make_spec(i, rng, fractional=fractional)))
     # Fire the coalesced fill pass (every job after the first landed in
     # one window) and let retriggers settle.
     clock.advance(2 * DEFAULT_RATE_LIMIT + 2.0)
     warmup_seq = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
 
-    next_id = n_jobs
-    for _ in range(passes):
-        # One deletion + one submission per window: both triggers
-        # coalesce into a single churn pass.
-        victim = alive.pop(rng.randrange(len(alive)))
-        admission.delete_training_job(victim)
-        alive.append(admission.create_training_job(
-            _make_spec(next_id, rng)))
-        next_id += 1
-        clock.advance(DEFAULT_RATE_LIMIT + 2.0)
+    # Freeze the boot heap (the run_fleet_point idiom): the fill minted
+    # ~100k+ long-lived objects — and in a full-suite run, earlier
+    # points' worlds are still awaiting collection — so gen-2 pauses
+    # otherwise land inside measured decide windows as pure
+    # measurement-harness artifact, not steady-state cost.
+    import gc
+    gc.collect()
+    gc.freeze()
+    try:
+        next_id = n_jobs
+        for _ in range(passes):
+            # One deletion + one submission per window: both triggers
+            # coalesce into a single churn pass.
+            victim = alive.pop(rng.randrange(len(alive)))
+            admission.delete_training_job(victim)
+            alive.append(admission.create_training_job(
+                _make_spec(next_id, rng, fractional=fractional)))
+            next_id += 1
+            clock.advance(DEFAULT_RATE_LIMIT + 2.0)
 
-    samples = [r for r in sched.profile_records(0)
-               if r["seq"] > warmup_seq]
-    if not samples:  # pragma: no cover - harness bug guard
-        raise RuntimeError(f"no measured passes at N={n_jobs}")
+        samples = [r for r in sched.profile_records(0)
+                   if r["seq"] > warmup_seq]
+        if not samples:  # pragma: no cover - harness bug guard
+            raise RuntimeError(f"no measured passes at N={n_jobs}")
 
-    phase_stats: Dict[str, Dict[str, List[float]]] = {}
-    for rec in samples:
-        for name, stats in rec["phases"].items():
-            agg = phase_stats.setdefault(name, {"wall": [], "cpu": [],
-                                                "count": []})
-            agg["wall"].append(stats["wall_ms"])
-            agg["cpu"].append(stats["cpu_ms"])
-            agg["count"].append(stats["count"])
+        phase_stats: Dict[str, Dict[str, List[float]]] = {}
+        for rec in samples:
+            for name, stats in rec["phases"].items():
+                agg = phase_stats.setdefault(name, {"wall": [], "cpu": [],
+                                                    "count": []})
+                agg["wall"].append(stats["wall_ms"])
+                agg["cpu"].append(stats["cpu_ms"])
+                agg["count"].append(stats["count"])
 
-    hosts = max(2, n_jobs // CHIPS_PER_HOST)
-    curve = {
-        "n_jobs": n_jobs,
-        "hosts": hosts,
-        "chips_per_host": CHIPS_PER_HOST,
-        "total_chips": hosts * CHIPS_PER_HOST,
-        "passes_measured": len(samples),
-        "decide_wall_ms": _agg([r["decide_ms"] for r in samples]),
-        "actuate_wall_ms": _agg([r["actuate_ms"] for r in samples]),
-        "duration_ms": _agg([r["duration_ms"] for r in samples]),
-        "cpu_ms": _agg([r["cpu_ms"] for r in samples]),
-        "phases": {
-            name: {
-                "wall_ms_mean": round(statistics.mean(agg["wall"]), 3),
-                "wall_ms_max": round(max(agg["wall"]), 3),
-                "wall_ms_p50": round(_percentile(agg["wall"], 0.50), 3),
-                "wall_ms_p95": round(_percentile(agg["wall"], 0.95), 3),
-                "cpu_ms_mean": round(statistics.mean(agg["cpu"]), 3),
-                "count_mean": round(statistics.mean(agg["count"]), 2),
-            }
-            for name, agg in sorted(phase_stats.items())
-        },
-        "defragment_probe": _probe_defragment(sched, hosts),
-        "placement_scoring": _probe_placement_scoring(sched),
-    }
+        hosts = max(2, n_jobs // CHIPS_PER_HOST)
+        curve = {
+            "n_jobs": n_jobs,
+            "hosts": hosts,
+            "chips_per_host": CHIPS_PER_HOST,
+            "total_chips": hosts * CHIPS_PER_HOST,
+            "passes_measured": len(samples),
+            "decide_wall_ms": _agg([r["decide_ms"] for r in samples]),
+            "actuate_wall_ms": _agg([r["actuate_ms"] for r in samples]),
+            "duration_ms": _agg([r["duration_ms"] for r in samples]),
+            "cpu_ms": _agg([r["cpu_ms"] for r in samples]),
+            "phases": {
+                name: {
+                    "wall_ms_mean": round(statistics.mean(agg["wall"]), 3),
+                    "wall_ms_max": round(max(agg["wall"]), 3),
+                    "wall_ms_p50": round(_percentile(agg["wall"], 0.50), 3),
+                    "wall_ms_p95": round(_percentile(agg["wall"], 0.95), 3),
+                    "cpu_ms_mean": round(statistics.mean(agg["cpu"]), 3),
+                    "count_mean": round(statistics.mean(agg["count"]), 2),
+                }
+                for name, agg in sorted(phase_stats.items())
+            },
+            "defragment_probe": _probe_defragment(sched, hosts),
+            "placement_scoring": _probe_placement_scoring(sched),
+        }
+    finally:
+        # An aborted point must not leave the heap frozen for the rest
+        # of the suite (every later point would measure against
+        # uncollectable prior worlds).
+        gc.unfreeze()
     sched.stop()
     return curve
 
@@ -355,100 +408,112 @@ def run_ingestion_point(n_jobs: int, seed: int = DEFAULT_SEED,
     # piggy-backed decide pass (those are measured by run_point).
     admission.create_training_job(_make_spec(0, rng))
 
-    # Single-request admissions: the per-request latency a lone client
-    # sees on POST /training.
-    singles = min(100, max(10, n_jobs // 10))
-    single_ms: List[float] = []
-    for i in range(singles):
-        t0 = time.monotonic()
-        admission.create_training_job(_make_spec(1 + i, rng))
-        single_ms.append((time.monotonic() - t0) * 1000.0)
+    # Freeze the pre-measurement heap (the run_point idiom): in a full
+    # suite run, the preceding decide worlds' garbage otherwise lands a
+    # gen-2 pause inside one measured burst and mints a phantom p99.
+    import gc
+    gc.collect()
+    gc.freeze()
 
-    # Bulk bursts: n_jobs more specs through POST /training/batch's
-    # engine, B at a time.
-    burst_size = max(10, min(1000, n_jobs // 5))
-    burst_ms: List[float] = []
-    item_ms: List[float] = []
-    next_id = 1 + singles
-    remaining = n_jobs
-    while remaining > 0:
-        take = min(burst_size, remaining)
-        specs = [_make_spec(next_id + k, rng) for k in range(take)]
-        next_id += take
-        remaining -= take
-        t0 = time.monotonic()
-        results = admission.create_training_jobs(specs)
-        dt = (time.monotonic() - t0) * 1000.0
-        assert all("error" not in r for r in results)
-        burst_ms.append(dt)
-        # Amortized per-item cost of the burst — items inside a burst
-        # are NOT individually timed, so the aggregate's "p99" is over
-        # per-burst means (one sample per burst), not per-item tails.
-        item_ms.append(dt / take)
+    try:
+        # Single-request admissions: the per-request latency a lone client
+        # sees on POST /training.
+        singles = min(100, max(10, n_jobs // 10))
+        single_ms: List[float] = []
+        for i in range(singles):
+            t0 = time.monotonic()
+            admission.create_training_job(_make_spec(1 + i, rng))
+            single_ms.append((time.monotonic() - t0) * 1000.0)
 
-    # Storm -> quiescent: every admission above landed in one rate-limit
-    # window; advancing the clock fires the coalesced pass(es). A scrape
-    # thread hammers the status snapshot THROUGHOUT — while passes hold
-    # the scheduler lock — so the read aggregate is "what a concurrent
-    # poller pays mid-pass", served from the version-stamped cache.
-    seq_before = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
-    reads_during: List[float] = []
-    stop_reading = threading.Event()
+        # Bulk bursts: n_jobs more specs through POST /training/batch's
+        # engine, B at a time.
+        burst_size = max(10, min(1000, n_jobs // 5))
+        burst_ms: List[float] = []
+        item_ms: List[float] = []
+        next_id = 1 + singles
+        remaining = n_jobs
+        while remaining > 0:
+            take = min(burst_size, remaining)
+            specs = [_make_spec(next_id + k, rng) for k in range(take)]
+            next_id += take
+            remaining -= take
+            t0 = time.monotonic()
+            results = admission.create_training_jobs(specs)
+            dt = (time.monotonic() - t0) * 1000.0
+            assert all("error" not in r for r in results)
+            burst_ms.append(dt)
+            # Amortized per-item cost of the burst — items inside a burst
+            # are NOT individually timed, so the aggregate's "p99" is over
+            # per-burst means (one sample per burst), not per-item tails.
+            item_ms.append(dt / take)
 
-    def scraper():
-        while not stop_reading.is_set():
+        # Storm -> quiescent: every admission above landed in one rate-limit
+        # window; advancing the clock fires the coalesced pass(es). A scrape
+        # thread hammers the status snapshot THROUGHOUT — while passes hold
+        # the scheduler lock — so the read aggregate is "what a concurrent
+        # poller pays mid-pass", served from the version-stamped cache.
+        seq_before = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
+        reads_during: List[float] = []
+        stop_reading = threading.Event()
+
+        def scraper():
+            while not stop_reading.is_set():
+                t0 = time.monotonic()
+                sched.status_table_json()
+                reads_during.append((time.monotonic() - t0) * 1000.0)
+                time.sleep(0.0005)
+
+        # Warm the snapshot cache first: the very first read after boot
+        # builds it under the lock, and with the fill pass in flight that
+        # cold sample would wait out the whole pass — a boot artifact, not
+        # the cached-read-during-pass cost this column claims to measure.
+        sched.status_table_json()
+        reader = threading.Thread(target=scraper, daemon=True)
+        t_storm = time.monotonic()
+        reader.start()
+        settle_windows = 0
+        while settle_windows < 20:
+            clock.advance(DEFAULT_RATE_LIMIT + 2.0)
+            settle_windows += 1
+            with sched._lock:
+                pending = sched._resched_pending
+            if not pending and admission.bus.pending(sched.pool_id) == 0:
+                break
+        quiescent_ms = (time.monotonic() - t_storm) * 1000.0
+        stop_reading.set()
+        reader.join(timeout=5.0)
+        passes = len([r for r in sched.profile_records(0)
+                      if r["seq"] > seq_before])
+
+        # Steady-state cached reads: the pool is quiet, the snapshot is
+        # warm — this is the ~zero a scrape costs between state changes.
+        cached_ms: List[float] = []
+        for _ in range(200):
             t0 = time.monotonic()
             sched.status_table_json()
-            reads_during.append((time.monotonic() - t0) * 1000.0)
-            time.sleep(0.0005)
+            cached_ms.append((time.monotonic() - t0) * 1000.0)
 
-    # Warm the snapshot cache first: the very first read after boot
-    # builds it under the lock, and with the fill pass in flight that
-    # cold sample would wait out the whole pass — a boot artifact, not
-    # the cached-read-during-pass cost this column claims to measure.
-    sched.status_table_json()
-    reader = threading.Thread(target=scraper, daemon=True)
-    t_storm = time.monotonic()
-    reader.start()
-    settle_windows = 0
-    while settle_windows < 20:
-        clock.advance(DEFAULT_RATE_LIMIT + 2.0)
-        settle_windows += 1
-        with sched._lock:
-            pending = sched._resched_pending
-        if not pending and admission.bus.pending(sched.pool_id) == 0:
-            break
-    quiescent_ms = (time.monotonic() - t_storm) * 1000.0
-    stop_reading.set()
-    reader.join(timeout=5.0)
-    passes = len([r for r in sched.profile_records(0)
-                  if r["seq"] > seq_before])
-
-    # Steady-state cached reads: the pool is quiet, the snapshot is
-    # warm — this is the ~zero a scrape costs between state changes.
-    cached_ms: List[float] = []
-    for _ in range(200):
-        t0 = time.monotonic()
-        sched.status_table_json()
-        cached_ms.append((time.monotonic() - t0) * 1000.0)
-
-    point = {
-        "n_jobs": n_jobs,
-        "burst_size": burst_size,
-        "bursts": len(burst_ms),
-        "singles": singles,
-        "bulk_admit_burst_ms": _agg(burst_ms),
-        "bulk_admit_per_item_ms": _agg(item_ms),
-        "single_admit_ms": _agg(single_ms),
-        "storm": {
-            "events": n_jobs + singles + 1,
-            "passes_to_quiescent": passes,
-            "to_quiescent_ms": round(quiescent_ms, 3),
-        },
-        "read_during_pass_ms": dict(_agg(reads_during),
-                                    count=len(reads_during)),
-        "read_cached_ms": _agg(cached_ms),
-    }
+        point = {
+            "n_jobs": n_jobs,
+            "burst_size": burst_size,
+            "bursts": len(burst_ms),
+            "singles": singles,
+            "bulk_admit_burst_ms": _agg(burst_ms),
+            "bulk_admit_per_item_ms": _agg(item_ms),
+            "single_admit_ms": _agg(single_ms),
+            "storm": {
+                "events": n_jobs + singles + 1,
+                "passes_to_quiescent": passes,
+                "to_quiescent_ms": round(quiescent_ms, 3),
+            },
+            "read_during_pass_ms": dict(_agg(reads_during),
+                                        count=len(reads_during)),
+            "read_cached_ms": _agg(cached_ms),
+        }
+    finally:
+        # An aborted point must not leave the heap frozen for the
+        # rest of the suite (see run_point).
+        gc.unfreeze()
     sched.stop()
     return point
 
@@ -666,6 +731,17 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
                   f"({time.monotonic() - t0:.1f}s to measure)",
                   file=sys.stderr)
         ingestion.append(point)
+    fractional = []
+    for n in ns:
+        t0 = time.monotonic()
+        curve = run_point(n, passes=passes, seed=seed, fractional=True)
+        if verbose:
+            print(f"perf_scale: N={n} (fractional mix): decide "
+                  f"{curve['decide_wall_ms']['mean']}ms mean, p95 "
+                  f"{curve['decide_wall_ms']['p95']}ms "
+                  f"({time.monotonic() - t0:.1f}s to measure)",
+                  file=sys.stderr)
+        fractional.append(curve)
     fleet = []
     for n in (fleet_ns or ()):
         t0 = time.monotonic()
@@ -698,6 +774,7 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
         "python": platform.python_version(),
         "curves": curves,
         "ingestion": ingestion,
+        "fractional": fractional,
         "fleet": fleet,
     }
 
@@ -757,6 +834,42 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
         if base_ps is not None and fresh_ps is not None:
             check("placement_scoring", fresh_ps["total_ms"],
                   base_ps["total_ms"])
+
+    # Fractional-mix columns (schema 6): the same decide bounds on the
+    # topology-modeled fractional-mix world, plus the absolute <50 ms
+    # p95 pin at the 10k headline point (the PR 8 decide target must
+    # hold WITH fractional jobs in the vector —
+    # doc/fractional-sharing.md). Pre-v6 baselines simply skip.
+    base_frac = {c["n_jobs"]: c for c in baseline.get("fractional", [])}
+    fresh_frac = {c["n_jobs"]: c for c in fresh.get("fractional", [])}
+    for n in sorted(fresh_frac):
+        fc, bc = fresh_frac[n], base_frac.get(n)
+        if bc is None:
+            problems.append(f"fractional N={n}: no baseline point "
+                            f"(regenerate with make perf-baseline)")
+            continue
+
+        def zcheck(label: str, fresh_ms: float, base_ms: float) -> None:
+            bound = base_ms * tolerance + slack_ms
+            verdict = "ok" if fresh_ms <= bound else "REGRESSED"
+            print(f"  Z={n:>6} {label:<18} base={base_ms:>10.3f}ms "
+                  f"fresh={fresh_ms:>10.3f}ms bound={bound:>10.3f}ms "
+                  f"{verdict}")
+            if fresh_ms > bound:
+                problems.append(
+                    f"fractional N={n}: {label} regressed: "
+                    f"{fresh_ms:.3f}ms vs baseline {base_ms:.3f}ms "
+                    f"(bound {bound:.3f}ms)")
+
+        zcheck("frac_decide", fc["decide_wall_ms"]["mean"],
+               bc["decide_wall_ms"]["mean"])
+        zcheck("frac_decide_p95", fc["decide_wall_ms"]["p95"],
+               bc["decide_wall_ms"]["p95"])
+        if n >= 10000 and fc["decide_wall_ms"]["p95"] >= 50.0:
+            problems.append(
+                f"fractional N={n}: decide p95 "
+                f"{fc['decide_wall_ms']['p95']:.3f}ms breaches the "
+                f"absolute 50 ms pin with fractional jobs in the mix")
 
     # Ingestion columns (schema 3): admission p99 bounds use a tighter
     # slack (sub-ms costs would vanish inside the decide slack);

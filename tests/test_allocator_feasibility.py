@@ -91,9 +91,19 @@ def test_whole_host_tiling_required_for_multi_host_counts():
 
 
 def test_sub_host_grants_round_within_host_block():
+    # A max=3 job resolves FRACTIONAL (max < chips_per_host=4,
+    # doc/fractional-sharing.md): any sub-host count is a valid static
+    # chip-partition of one host block, so the grant of 3 survives —
+    # the old whole-host shape catalog would have clipped it to 2.
     jobs = [job("a", 1, 3)]
     result = enforce_feasibility({"a": 3}, jobs, 64, TOPO)
-    assert result == {"a": 2}  # 3 doesn't tile a 2x2x1 host block
+    assert result == {"a": 3}
+    validate_result(64, result, jobs, topology=TOPO)
+    # An explicitly whole-host job of the same shape keeps the classic
+    # sub-torus rounding: 3 doesn't tile a 2x2x1 host block -> 2.
+    jobs[0].spec.resource_class = "whole_host"
+    result = enforce_feasibility({"a": 3}, jobs, 64, TOPO)
+    assert result == {"a": 2}
 
 
 def test_allocator_applies_topology_end_to_end():

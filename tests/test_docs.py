@@ -265,6 +265,63 @@ class TestPlacementDoc:
             assert "placement.md" in f.read()
 
 
+class TestFractionalSharingDoc:
+    """doc/fractional-sharing.md is pinned two ways: every load-bearing
+    symbol it names must exist in code, and the plane's code-side
+    vocabulary must be documented in it."""
+
+    def _doc(self):
+        with open(os.path.join(REPO, "doc", "fractional-sharing.md")) as f:
+            return f.read()
+
+    def test_resource_model_documented(self):
+        doc = self._doc()
+        for term in ("resource_class", "resolve_resource_class",
+                     "chips_per_host", "FeasibleTable", "frac_feasible",
+                     "enforce_feasibility", "validate_result",
+                     "feasibility_self_check",
+                     "enforce_feasibility_reference",
+                     "_feasibility_meta_cached"):
+            assert term in doc, f"resource-model term {term!r} missing"
+        # The documented classes are exactly the code's vocabulary.
+        from vodascheduler_tpu.common.job import RESOURCE_CLASSES
+        for rc in RESOURCE_CLASSES:
+            assert f"`{rc}`" in doc, f"resource class {rc!r} undocumented"
+
+    def test_baseline_and_interference_documented(self):
+        doc = self._doc()
+        for term in ("VODA_FRACTIONAL_SHARING", "_footprint_fit_pass",
+                     "host_footprint", "FAMILY_INTERFERENCE",
+                     "interference_fraction", "cotenancy",
+                     "interference_weight_for_category",
+                     "set_interference_weights", "_pick_host",
+                     "interference_penalty_chip_seconds",
+                     "interference_penalty_mean", "sanity_check_families"):
+            assert term in doc, f"interference term {term!r} missing"
+
+    def test_semantics_and_proof_documented(self):
+        doc = self._doc()
+        for term in ("hysteresis_bypassed_fractional_fit",
+                     "chip_oversubscribed", "overlapping-partition",
+                     "fractional_sharing_ab", "detail.fractional_sharing",
+                     "topology_mix_trace", "make perf-baseline",
+                     "voda explain", "voda top",
+                     "voda_scheduler_fractional_jobs",
+                     "voda_placement_cotenant_hosts", "50 ms"):
+            assert term in doc, f"semantics/proof term {term!r} missing"
+        # Reason + invariant registered in their vocabularies.
+        from vodascheduler_tpu.obs import REASON_CODES
+        assert "hysteresis_bypassed_fractional_fit" in REASON_CODES
+        from vodascheduler_tpu.analysis import modelcheck
+        assert "chip_oversubscribed" in modelcheck.INVARIANTS
+
+    def test_cross_linked(self):
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            assert "fractional-sharing.md" in f.read()
+        with open(os.path.join(REPO, "doc", "get-started.md")) as f:
+            assert "VODA_FRACTIONAL_SHARING" in f.read()
+
+
 def _modelcheck_invariants():
     from vodascheduler_tpu.analysis import modelcheck
     return modelcheck.INVARIANTS

@@ -1,0 +1,513 @@
+"""Fractional sub-host sharing (doc/fractional-sharing.md): resource
+classes, within-block feasibility, the whole-host baseline's footprint
+accounting, interference-sensitive physics and placement pricing, the
+audit/CLI surfacing, and the committed perf-baseline pin."""
+
+import json
+import os
+
+import pytest
+
+from vodascheduler_tpu.allocator import (
+    AllocationRequest,
+    ResourceAllocator,
+)
+from vodascheduler_tpu.allocator.allocator import (
+    enforce_feasibility,
+    enforce_feasibility_reference,
+    feasibility_self_check,
+)
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, WorkloadProfile
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import (
+    JobConfig,
+    JobSpec,
+    TrainingJob,
+    resolve_resource_class,
+)
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.placement import PlacementManager, PoolTopology
+from vodascheduler_tpu.placement.topology import default_pool
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOPO = PoolTopology(torus_dims=(4, 4, 4), host_block=(2, 2, 1))  # cph=4
+
+
+def job(name, lo, hi, rc="auto", submit=0.0):
+    spec = JobSpec(name=name, resource_class=rc,
+                   config=JobConfig(min_num_chips=lo, max_num_chips=hi))
+    return TrainingJob.from_spec(spec, submit_time=submit)
+
+
+class TestResourceClass:
+    def test_auto_resolves_by_host_block(self):
+        assert resolve_resource_class("auto", 2, 4) == "fractional"
+        assert resolve_resource_class("auto", 3, 4) == "fractional"
+        assert resolve_resource_class("auto", 4, 4) == "whole_host"
+        assert resolve_resource_class("auto", 16, 4) == "whole_host"
+
+    def test_explicit_class_wins(self):
+        assert resolve_resource_class("fractional", 16, 4) == "fractional"
+        assert resolve_resource_class("whole_host", 2, 4) == "whole_host"
+
+    def test_spec_roundtrip_carries_class(self):
+        s = JobSpec(name="x", resource_class="fractional")
+        assert JobSpec.from_dict(s.to_dict()).resource_class == "fractional"
+        # Old stored specs predate the field: default is auto.
+        d = s.to_dict()
+        del d["resource_class"]
+        assert JobSpec.from_dict(d).resource_class == "auto"
+
+
+class TestFractionalFeasibility:
+    def test_any_sub_host_count_is_a_partition(self):
+        from vodascheduler_tpu.placement.topology import (
+            is_feasible_count,
+            next_feasible_above,
+            round_to_feasible,
+        )
+        # Classic rules: 3 has no sub-block shape on (2,2,1).
+        assert not is_feasible_count(3, TOPO)
+        # Fractional: every 1..cph-1 count partitions a host block.
+        for n in (1, 2, 3):
+            assert is_feasible_count(n, TOPO, fractional=True)
+        assert round_to_feasible(3, TOPO, fractional=True) == 3
+        assert round_to_feasible(3, TOPO) == 2
+        assert next_feasible_above(2, TOPO, fractional=True) == 3
+        # At and above one host the whole-host table applies unchanged.
+        assert is_feasible_count(4, TOPO, fractional=True)
+        assert not is_feasible_count(5, TOPO, fractional=True)
+        assert not is_feasible_count(5, TOPO)
+
+    def test_table_matches_scan_oracles(self):
+        from vodascheduler_tpu.placement.topology import (
+            _is_feasible_scan,
+            _next_feasible_above_scan,
+            _round_to_feasible_scan,
+            is_feasible_count,
+            next_feasible_above,
+            round_to_feasible,
+        )
+        for topo in (TOPO, default_pool(4, 8),
+                     PoolTopology((8, 4, 4), (2, 2, 2))):
+            for frac in (False, True):
+                for n in range(0, topo.total_chips + 2):
+                    assert is_feasible_count(n, topo, fractional=frac) == \
+                        _is_feasible_scan(n, topo, frac), (topo, frac, n)
+                    assert round_to_feasible(n, topo, fractional=frac) == \
+                        _round_to_feasible_scan(n, topo, frac)
+                    assert next_feasible_above(n, topo, fractional=frac) \
+                        == _next_feasible_above_scan(n, topo, frac)
+
+    def test_enforce_differential_oracle_clean(self):
+        # The seeded mixed-class differential sweep (also wired into
+        # `make modelcheck-selftest`): table == scan, values AND dict
+        # order, both sharing modes.
+        assert feasibility_self_check(n_pools=40) == []
+
+
+class TestWholeHostBaseline:
+    def test_footprint_charges_whole_hosts(self):
+        # 4 fractional 2-chip jobs on a 2-host (8-chip) pool: sharing
+        # fits all 4; the whole-host baseline fits only 2 (each grant's
+        # footprint is a 4-chip host).
+        topo = PoolTopology(torus_dims=(4, 2), host_block=(2, 2))  # 2 hosts
+        jobs = [job(f"f{i}", 1, 2) for i in range(4)]
+        grants = {f"f{i}": 2 for i in range(4)}
+        shared = enforce_feasibility(dict(grants), jobs, 8, topo,
+                                     fractional_sharing=True)
+        assert shared == grants
+        exclusive = enforce_feasibility(dict(grants), jobs, 8, topo,
+                                        fractional_sharing=False)
+        assert exclusive == {"f0": 2, "f1": 2, "f2": 0, "f3": 0}
+        # The scan-based oracle agrees exactly.
+        assert exclusive == enforce_feasibility_reference(
+            dict(grants), jobs, 8, topo, fractional_sharing=False)
+
+    def test_sharing_off_gives_sub_host_jobs_exclusive_hosts(self):
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock)
+        topo = default_pool(2, 4)
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), topo.chips_per_host,
+                             announce=False)
+        backend.set_topology(topo)
+        pm = PlacementManager("pool", topology=topo)
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, placement_manager=pm,
+                          algorithm="ElasticFIFO", rate_limit_seconds=1.0,
+                          fractional_sharing=False)
+        admission = AdmissionService(store, bus, clock)
+        a = admission.create_training_job(
+            JobSpec(name="tiny-a", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=100)))
+        clock.advance(2.0)
+        b = admission.create_training_job(
+            JobSpec(name="tiny-b", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=100)))
+        clock.advance(2.0)
+        # Both run 2 chips, but each occupies a FULL exclusive host.
+        assert sched.job_num_chips[a] == 2
+        assert sched.job_num_chips[b] == 2
+        hosts_a = {hs.host for hs in pm.job_placements[a].host_slots}
+        hosts_b = {hs.host for hs in pm.job_placements[b].host_slots}
+        assert hosts_a and hosts_b and hosts_a.isdisjoint(hosts_b)
+        assert pm.job_placements[a].num_workers == 4  # footprint slots
+        assert pm.cotenant_host_count() == 0
+        assert all(h.free_slots == 0 for h in pm.host_states.values())
+
+    def test_sharing_on_cotenants_one_host(self):
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock)
+        topo = default_pool(2, 4)
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), topo.chips_per_host,
+                             announce=False)
+        backend.set_topology(topo)
+        pm = PlacementManager("pool", topology=topo)
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, placement_manager=pm,
+                          algorithm="ElasticFIFO", rate_limit_seconds=1.0,
+                          fractional_sharing=True)
+        admission = AdmissionService(store, bus, clock)
+        for n in ("co-a", "co-b"):
+            admission.create_training_job(
+                JobSpec(name=n, pool="pool",
+                        config=JobConfig(min_num_chips=2, max_num_chips=2,
+                                         epochs=100)))
+            clock.advance(2.0)
+        # Best-fit packs both 2-chip tenants onto ONE shared host.
+        assert pm.cotenant_host_count() == 1
+
+
+class TestInterferencePhysics:
+    def _backend(self):
+        clock = VirtualClock(start=0.0)
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=0.0)
+        topo = default_pool(2, 4)
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), 4, announce=False)
+        backend.set_topology(topo)
+        return clock, backend
+
+    def _spec(self, name, chips=2):
+        return JobSpec(name=name,
+                       config=JobConfig(min_num_chips=chips,
+                                        max_num_chips=chips, epochs=1000))
+
+    def test_cotenant_pays_interference(self):
+        clock, backend = self._backend()
+        profile = WorkloadProfile(epoch_seconds_at_1=100.0,
+                                  speedup={2: 2.0},
+                                  interference_fraction=0.2)
+        backend.register_profile("a", profile)
+        backend.register_profile("b", profile)
+        backend.start_job(self._spec("a"), 2, [("host-0", 2)])
+        backend.start_job(self._spec("b"), 2, [("host-0", 2)])
+        with backend._state_lock:
+            sa, sb = backend.jobs["a"], backend.jobs["b"]
+            assert sa.cotenancy == pytest.approx(0.5)
+            assert backend._effective_speedup(sa) == pytest.approx(
+                2.0 * (1 - 0.2 * 0.5))
+        clock.advance(10.0)
+        backend.sync_accounting()
+        assert backend.interference_penalty_chip_seconds > 0.0
+        # Tenant b stops: a's rate recovers and its timers re-arm.
+        backend.stop_job("b")
+        with backend._state_lock:
+            assert sa.cotenancy == 0.0
+            assert backend._effective_speedup(sa) == pytest.approx(2.0)
+
+    def test_exclusive_hosts_interfere_not(self):
+        clock, backend = self._backend()
+        profile = WorkloadProfile(epoch_seconds_at_1=100.0,
+                                  interference_fraction=0.2)
+        backend.register_profile("a", profile)
+        backend.register_profile("b", profile)
+        backend.start_job(self._spec("a"), 2, [("host-0", 2)])
+        backend.start_job(self._spec("b"), 2, [("host-1", 2)])
+        clock.advance(10.0)
+        backend.sync_accounting()
+        assert backend.interference_penalty_chip_seconds == 0.0
+
+    def test_no_topology_keeps_prefractional_physics(self):
+        clock = VirtualClock(start=0.0)
+        backend = FakeClusterBackend(clock)
+        backend.add_host("host-0", 4, announce=False)
+        backend.register_profile("a", WorkloadProfile(
+            interference_fraction=0.5))
+        backend.register_profile("b", WorkloadProfile(
+            interference_fraction=0.5))
+        backend.start_job(self._spec("a"), 2, [("host-0", 2)])
+        backend.start_job(self._spec("b"), 2, [("host-0", 2)])
+        with backend._state_lock:
+            assert backend.jobs["a"].cotenancy == 0.0
+
+
+class TestInterferencePricing:
+    def test_weighted_pick_prefers_least_cotenanted_host(self):
+        pm = PlacementManager("pool", topology=default_pool(3, 4))
+        for h in ("host-0", "host-1", "host-2"):
+            pm.add_host(h, 4)
+        # host-0 half-occupied by a stranger; host-1 empty.
+        pm.set_interference_weights({})
+        pm.place({"big": 2})
+        assert [hs.host for hs
+                in pm.job_placements["big"].host_slots] == ["host-0"]
+        # Unweighted pick: tightest fit -> co-tenant with `big`.
+        pm.place({"big": 2, "plain": 2})
+        assert [hs.host for hs
+                in pm.job_placements["plain"].host_slots] == ["host-0"]
+        # Weighted (fractional) pick: the least-co-tenanted host wins.
+        pm.set_interference_weights({"frac": 4})
+        pm.place({"big": 2, "plain": 2, "frac": 2})
+        assert [hs.host for hs
+                in pm.job_placements["frac"].host_slots] == ["host-1"]
+
+    def test_fractional_stats_surface_co_tenancy(self):
+        pm = PlacementManager("pool", topology=default_pool(2, 4))
+        pm.add_host("host-0", 4)
+        pm.add_host("host-1", 4)
+        pm.set_interference_weights({"frac": 3})
+        pm.place({"whole": 2, "frac": 2})
+        stats = pm.job_fractional_stats("frac")
+        # frac took the empty host (interference-priced pick).
+        assert stats is not None
+        assert stats["partition"] == 2
+        assert pm.job_fractional_stats("whole") is None  # no weight
+        fleet = pm.fractional_fleet_stats()
+        assert fleet["fractional_jobs"] == 1
+        # Force co-tenancy: a third job fills the remaining slots.
+        pm.place({"whole": 2, "frac": 2, "extra": 4})
+        stats = pm.job_fractional_stats("frac")
+        assert stats["co_tenants"], stats
+        assert stats["interference_price"] > 0
+
+
+class TestAuditAndCli:
+    def _world(self):
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock)
+        topo = default_pool(2, 4)
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), 4, announce=False)
+        backend.set_topology(topo)
+        pm = PlacementManager("pool", topology=topo)
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, placement_manager=pm,
+                          algorithm="ElasticFIFO", rate_limit_seconds=1.0)
+        return clock, store, backend, sched, AdmissionService(
+            store, bus, clock)
+
+    def test_fractional_delta_block_emitted_and_valid(self):
+        clock, store, backend, sched, admission = self._world()
+        # resnet50 category: a nonzero interference weight
+        # (FAMILY_INTERFERENCE) — the block only renders for weighted
+        # fractional tenants.
+        name = admission.create_training_job(
+            JobSpec(name="resnet50", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=100)))
+        clock.advance(2.0)
+        recs = sched.audit_records(5)
+        deltas = {d["job"]: d for r in recs for d in r["deltas"]}
+        frac = deltas[name].get("fractional")
+        assert frac is not None
+        assert frac["partition"] == 2
+        assert frac["hosts"]
+        assert frac["co_tenants"] == []
+        for rec in recs:
+            assert obs_audit.validate_record(rec) == []
+
+    def test_validator_rejects_malformed_fractional_block(self):
+        rec = {
+            "kind": "resched_audit", "schema": 1, "ts": 0.0,
+            "pool": "p", "seq": 1, "trace_id": "t", "triggers": ["manual"],
+            "algorithm": "ElasticFIFO", "total_chips": 8, "queue": [],
+            "duration_ms": 1.0,
+            "deltas": [{"job": "j", "before": 0, "after": 2,
+                        "reasons": ["started"],
+                        "fractional": {"partition": 2}}],
+        }
+        problems = obs_audit.validate_record(rec)
+        assert any("fractional block missing" in p for p in problems)
+        rec["deltas"][0]["fractional"] = {
+            "partition": 2, "hosts": [], "co_tenants": [],
+            "interference_price": 0, "vibes": 1}
+        problems = obs_audit.validate_record(rec)
+        assert any("unknown fractional field" in p for p in problems)
+
+    def test_explain_and_top_render_fractional(self, capsys):
+        from vodascheduler_tpu import cli
+        clock, store, backend, sched, admission = self._world()
+        name = admission.create_training_job(
+            JobSpec(name="resnet50", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=100)))
+        clock.advance(2.0)
+        cli._print_explain(name, {"records": sched.explain_job(name)})
+        out = capsys.readouterr().out
+        assert "fractional[" in out
+        cli._print_top(sched.profile_records(0))
+        out = capsys.readouterr().out
+        assert "fractional: jobs=" in out
+
+    def test_fractional_jobs_gauge(self):
+        clock, store, backend, sched, admission = self._world()
+        admission.create_training_job(
+            JobSpec(name="tiny", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=100)))
+        admission.create_training_job(
+            JobSpec(name="big", pool="pool",
+                    config=JobConfig(min_num_chips=4, max_num_chips=8,
+                                     epochs=100)))
+        clock.advance(2.0)
+        exposition = sched.registry.exposition()
+        assert 'voda_scheduler_fractional_jobs{pool="pool"} 1' in exposition
+
+
+class TestHysteresisFractionalBypass:
+    def test_sub_host_grow_within_partition_bypasses(self):
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock)
+        # No Tier-A support: the classic grow_fits_host bypass is off
+        # the table, so only the fractional gate can wave this through.
+        backend.supports_inplace_resize = False
+        topo = default_pool(1, 4)  # ONE 4-chip host: true sub-host life
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), 4, announce=False)
+        backend.set_topology(topo)
+        pm = PlacementManager("pool", topology=topo)
+        sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                          clock, bus=bus, placement_manager=pm,
+                          algorithm="ElasticFIFO", rate_limit_seconds=1.0,
+                          scale_out_hysteresis=2.0,
+                          resize_cooldown_seconds=600.0)
+        admission = AdmissionService(store, bus, clock)
+        # grower starts at 3 (leftover), shrinks to 2 when tiny arrives
+        # (scale-ins are not gated), then grows 2 -> 3 inside the
+        # cooldown window when tiny leaves: the gate fires, and the
+        # target stays a sub-host partition of its own host block.
+        a = admission.create_training_job(
+            JobSpec(name="grower", pool="pool",
+                    config=JobConfig(min_num_chips=1, max_num_chips=3,
+                                     epochs=10000)))
+        clock.advance(2.0)
+        b = admission.create_training_job(
+            JobSpec(name="tiny", pool="pool",
+                    config=JobConfig(min_num_chips=2, max_num_chips=2,
+                                     epochs=2)))
+        clock.advance(2.0)
+        assert sched.job_num_chips[a] == 2
+        assert sched.job_num_chips[b] == 2
+        admission.delete_training_job(b)
+        clock.advance(5.0)
+        # The delete's own pass still saw tiny's slots held (the
+        # documented one-pass staleness of the grow gates); the next
+        # pass — well inside the 600 s cooldown — sees the freed
+        # partition and the fractional gate waves the grow through.
+        sched.trigger_resched("manual")
+        clock.advance(5.0)
+        reasons = [code
+                   for r in sched.audit_records(0)
+                   for d in r["deltas"] if d["job"] == a
+                   for code in d["reasons"]]
+        assert "hysteresis_bypassed_fractional_fit" in reasons, reasons
+        assert sched.job_num_chips[a] == 3
+
+
+class TestModelcheckFractional:
+    def test_invariant_registered_and_documented(self):
+        from vodascheduler_tpu.analysis import modelcheck
+        assert "chip_oversubscribed" in modelcheck.INVARIANTS
+        assert "overlapping-partition" in modelcheck.PLACEMENT_VARIANTS
+
+    def test_overlapping_partition_tooth_caught_and_replayed(self):
+        from vodascheduler_tpu.analysis import modelcheck
+        result = modelcheck.explore(modelcheck.bounded_config(
+            variant="overlapping-partition"))
+        assert result.counterexample is not None
+        assert "chip_oversubscribed" in result.counterexample["violation"]
+        problems = modelcheck.replay_counterexample(result.counterexample)
+        assert any("chip_oversubscribed" in p for p in problems)
+
+    def test_bounded_profile_carries_fractional_job(self):
+        from vodascheduler_tpu.analysis import modelcheck
+        cfg = modelcheck.bounded_config()
+        assert any(s.resource_class == "fractional" for s in cfg.jobs)
+        # Round-trips through the counterexample config format.
+        assert modelcheck.ModelConfig.from_dict(cfg.to_dict()) == cfg
+
+
+class TestPerfBaselinePin:
+    def test_committed_fractional_10k_decide_under_50ms(self):
+        """The committed artifact pins the tentpole's perf acceptance:
+        the 10k-job decide p95 stays under the PR 8 50 ms gate WITH
+        fractional jobs in the vector (schema 6 `fractional` section,
+        regenerated by `make perf-baseline`)."""
+        with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
+            baseline = json.load(f)
+        assert baseline["schema"] >= 6
+        frac = {c["n_jobs"]: c for c in baseline["fractional"]}
+        assert 10000 in frac
+        assert 0 < frac[10000]["decide_wall_ms"]["p95"] < 50.0, \
+            frac[10000]["decide_wall_ms"]
+        assert 0 < frac[10000]["decide_wall_ms"]["mean"] < 50.0
+
+
+class TestAdmissionValidation:
+    def test_unknown_resource_class_rejected(self):
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        admission = AdmissionService(store, bus, clock)
+        bad = JobSpec(name="typo", resource_class="fractionnal")
+        results = admission.create_training_jobs(
+            [bad, JobSpec(name="fine")])
+        assert "unknown resource_class" in results[0]["error"]
+        # All-or-nothing: the valid sibling is rejected with it and
+        # zero residue lands in the store.
+        assert "error" in results[1]
+        assert store.list_jobs() == []
+        ok = admission.create_training_jobs(
+            [JobSpec(name="fine", resource_class="fractional")])
+        assert "error" not in ok[0]
+
+
+class TestModelcheckVariantGuard:
+    def test_mismatched_profile_variant_fails_loudly(self):
+        from vodascheduler_tpu.analysis import modelcheck
+        with pytest.raises(ValueError, match="not a scheduler or "
+                                             "placement variant"):
+            modelcheck.explore(modelcheck.bounded_config(
+                variant="route-book-start-mismatch"))
+        with pytest.raises(ValueError, match="not an admission variant"):
+            modelcheck.explore(modelcheck.fleet_config(
+                variant="overlapping-partition"))
+
+
+class TestFamilyTables:
+    def test_interference_table_synced_with_trace_families(self):
+        from vodascheduler_tpu.placement import comms
+        comms.sanity_check_families()  # raises on drift
+        assert all(0.0 <= f <= 0.5
+                   for f in comms.FAMILY_INTERFERENCE.values())
+
+    def test_interference_weights_bucketed(self):
+        from vodascheduler_tpu.placement import comms
+        assert comms.interference_weight_for_category("resnet50") > 0
+        assert comms.interference_weight_for_category("unknown") == 0
+        assert all(comms.interference_weight_for_category(c)
+                   <= comms.MAX_INTERFERENCE_WEIGHT
+                   for c in comms.FAMILY_INTERFERENCE)

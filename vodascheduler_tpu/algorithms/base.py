@@ -24,19 +24,51 @@ class InvalidAllocationError(AssertionError):
 
 def validate_result(total_chips: int, result: ScheduleResult,
                     jobs: Iterable[TrainingJob],
-                    topology: Optional["PoolTopology"] = None) -> None:
+                    topology: Optional["PoolTopology"] = None,
+                    meta: Optional[dict] = None) -> None:
     """Invariants (reference: utils.go:18-42):
       - every allocation is >= 0
       - a nonzero allocation is within [min_num_chips, max_num_chips]
       - Σ allocations <= total_chips
       - with a topology: every allocation is slice-shape feasible (the TPU
         delta SURVEY.md §7 adds to the reference's fungible-GPU checks —
-        a count with no contiguous sub-torus must never reach the backend)
+        a count with no contiguous sub-torus must never reach the backend);
+        FRACTIONAL-class jobs (doc/fractional-sharing.md) admit any
+        sub-host count (a static chip-partition of one host block).
+
+    `meta` is the allocator's cached name -> (min, max, fractional) map
+    (allocator._feasibility_meta); None derives bounds/classes here —
+    this runs inside the decide window, so the allocator passes its
+    per-pool cache instead of re-deriving a 10k-job fleet every pass.
     """
-    bounds = {j.name: (j.config.min_num_chips, j.config.max_num_chips) for j in jobs}
+    if topology is not None:
+        from vodascheduler_tpu.placement.topology import FeasibleTable
+        table = FeasibleTable.for_topology(topology)
+        feas, ffeas, total_t = (table.feasible, table.frac_feasible,
+                                table.total)
+    else:
+        feas = ffeas = None
+        total_t = 0
+    if meta is None:
+        if topology is None:
+            # The algorithm-internal validation path (no topology, no
+            # feasibility sweep): the fractional flag is provably
+            # unread, so skip the per-job class resolution entirely.
+            meta = {j.name: (j.config.min_num_chips,
+                             j.config.max_num_chips, False)
+                    for j in jobs}
+        else:
+            from vodascheduler_tpu.allocator.allocator import (
+                _feasibility_meta,
+            )
+            meta = _feasibility_meta(jobs, topology)
+    meta_get = meta.get
     allocated = 0
+    # One fused sweep, one meta probe per grant: bounds AND (with a
+    # topology) slice-shape/partition feasibility — this is the decide
+    # window's runtime safety net, so it pays one pass, not two.
     for job, n in result.items():
-        lo, hi = bounds.get(job, (0, 0))
+        lo, hi, frac = meta_get(job, (0, 0, False))
         if n < 0:
             raise InvalidAllocationError(f"{job}: negative allocation {n}")
         if 0 < n < lo:
@@ -44,19 +76,19 @@ def validate_result(total_chips: int, result: ScheduleResult,
         if n > hi:
             raise InvalidAllocationError(f"{job}: allocation {n} above max {hi}")
         allocated += n
+        if n == 0 or feas is None:
+            continue
+        if n <= total_t and (ffeas[n] if frac else feas[n]):
+            continue
+        raise InvalidAllocationError(
+            f"{job}: allocation {n} has no contiguous slice shape "
+            f"on torus {topology.torus_dims} "
+            f"(host block {topology.host_block})")
     # Capacity can transiently read negative while node deletions race a
     # resched; zero allocation is the only valid answer then, not a crash.
     if allocated > max(0, total_chips):
         raise InvalidAllocationError(
             f"total allocated {allocated} exceeds capacity {total_chips}")
-    if topology is not None:
-        from vodascheduler_tpu.placement.topology import is_feasible_count
-        for job, n in result.items():
-            if not is_feasible_count(n, topology):
-                raise InvalidAllocationError(
-                    f"{job}: allocation {n} has no contiguous slice shape "
-                    f"on torus {topology.torus_dims} "
-                    f"(host block {topology.host_block})")
 
 
 def allocate_minimums(ordered: List[TrainingJob], result: ScheduleResult,

@@ -89,6 +89,14 @@ INVARIANTS: Dict[str, str] = {
     "placement_oversubscribed": (
         "The placement manager's per-host free-slot accounting never "
         "goes negative."),
+    "chip_oversubscribed": (
+        "Co-tenant partitions on one host never sum past its chips: "
+        "for every placement-manager host, the per-job committed "
+        "workers sum to at most total_slots AND exactly to "
+        "total_slots - free_slots — an overlapping-partition commit "
+        "(two fractional tenants granted the same chips) is caught "
+        "even while free_slots still looks healthy "
+        "(doc/fractional-sharing.md)."),
     "running_zero_chips": (
         "Every RUNNING job books at least one chip in the ledger."),
     "waiting_holds_chips": (
@@ -121,12 +129,17 @@ INVARIANTS: Dict[str, str] = {
 
 @dataclasses.dataclass(frozen=True)
 class JobShape:
-    """One bounded job: elasticity bounds + length."""
+    """One bounded job: elasticity bounds + length. `resource_class`
+    ("auto"/"fractional"/"whole_host", common/job.py) lets a profile
+    pin a job to the fractional sub-host plane explicitly — the
+    fractional-job action of doc/fractional-sharing.md's bounded
+    profile."""
 
     name: str
     min_chips: int = 1
     max_chips: int = 4
     epochs: int = 2
+    resource_class: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +242,28 @@ VARIANTS: Dict[str, type] = {
 }
 
 
+class _OverlappingPartitionPM(PlacementManager):
+    """Seeded bug: a sub-host partition commit forgets the free-slot
+    decrement — the host still advertises the chips as free, so the
+    next fractional tenant (or a whole-host job) is packed onto the
+    SAME chips. free_slots never goes negative (the old invariant
+    stays silent), but per-host committed workers sum past capacity:
+    exactly what `chip_oversubscribed` exists to catch."""
+
+    def _commit_slots(self, host, job: str, take: int) -> None:
+        host.job_num_workers[job] = host.job_num_workers.get(job, 0) + take
+        if take >= host.total_slots:
+            host.free_slots -= take  # whole-host commits stay correct
+
+
+# Seeded-bug PlacementManager variants (the fractional plane's teeth),
+# selected by the same ModelConfig.variant namespace as VARIANTS — a
+# config names ONE variant, scheduler- or placement-sided.
+PLACEMENT_VARIANTS: Dict[str, type] = {
+    "overlapping-partition": _OverlappingPartitionPM,
+}
+
+
 class _MisroutingAdmission(AdmissionService):
     """Seeded fleet bug: the admission layer commits a routed job to the
     store under its routed pool but publishes the CREATE event to the
@@ -299,9 +334,29 @@ class _World:
             self.backend.register_profile(
                 shape.name,
                 WorkloadProfile(epoch_seconds_at_1=config.epoch_seconds))
-        self.pm = PlacementManager("mc-pool")
+        # A modeled topology when the fleet is uniform (the bounded
+        # profiles' hosts all are): host blocks give the fractional
+        # resource class its chips_per_host to resolve against, and the
+        # 1D default_pool host ring names hosts exactly like the
+        # configs ("host-N"). Heterogeneous host lists fall back to the
+        # un-modeled (topology-free) world.
+        from vodascheduler_tpu.placement.topology import default_pool
+        chip_counts = {c for _, c in config.hosts}
+        topology = (default_pool(len(config.hosts), chip_counts.pop())
+                    if len(chip_counts) == 1 else None)
+        # A variant this profile cannot install must fail LOUDLY: a
+        # .get() fallback would explore the default (bug-free) world
+        # and print a silently wrong "invariants hold".
+        if (config.variant not in VARIANTS
+                and config.variant not in PLACEMENT_VARIANTS):
+            raise ValueError(
+                f"variant {config.variant!r} is not a scheduler or "
+                f"placement variant (fleet-profile variants need "
+                f"fleet=True)")
+        pm_cls = PLACEMENT_VARIANTS.get(config.variant, PlacementManager)
+        self.pm = pm_cls("mc-pool", topology=topology)
         self.allocator = ResourceAllocator(self.store)
-        cls = VARIANTS[config.variant]
+        cls = VARIANTS.get(config.variant, Scheduler)
         self.sched: Scheduler = cls(
             "mc-pool", self.backend, self.store, self.allocator,
             self.clock, bus=self.bus, placement_manager=self.pm,
@@ -315,6 +370,7 @@ class _World:
         self._specs = {
             shape.name: JobSpec(
                 name=shape.name, pool="mc-pool",
+                resource_class=shape.resource_class,
                 config=JobConfig(min_num_chips=shape.min_chips,
                                  max_num_chips=shape.max_chips,
                                  epochs=shape.epochs))
@@ -452,6 +508,26 @@ class _World:
         # as placement_oversubscribed). Checked per step here AND at
         # every drain step, so a strand that outlives its recovery is
         # always caught.
+        # Fractional co-tenancy booking honesty (doc/fractional-
+        # sharing.md): the placement manager's committed per-job
+        # workers on one host must sum to at most its chips AND agree
+        # exactly with its free-slot ledger — an overlapping-partition
+        # commit keeps free_slots healthy while the sums diverge, so
+        # this check is checked FIRST (and independently of the
+        # recovery excuse below: placement intent is the scheduler's
+        # own bookkeeping, never legally divergent).
+        for name, state in sorted(self.pm.host_states.items()):
+            committed = sum(state.job_num_workers.values())
+            if committed > state.total_slots:
+                problems.append(
+                    f"chip_oversubscribed: {name} commits {committed} "
+                    f"chips of {state.total_slots}")
+            elif committed != state.total_slots - state.free_slots:
+                problems.append(
+                    f"chip_oversubscribed: {name} commits {committed} "
+                    f"chips but books "
+                    f"{state.total_slots - state.free_slots} "
+                    f"(free_slots drifted)")
         recovering = sched.recovery_pending
         for host, used in sorted(per_host.items()):
             if host not in hosts or used <= hosts[host]:
@@ -601,6 +677,12 @@ class _FleetWorld(_World):
         schedulers = {p: s for p, (s, _, _) in self.pools.items()}
         self.router = FleetRouter(schedulers, enabled=True,
                                   tracer=self.tracer, bus=self.bus)
+        if config.variant not in ADMISSION_VARIANTS:
+            raise ValueError(
+                f"variant {config.variant!r} is not an admission "
+                f"variant (the fleet profile installs bugs at the "
+                f"admission layer; scheduler/placement variants need "
+                f"the bounded/deep profiles)")
         admission_cls = ADMISSION_VARIANTS[config.variant]
         self.admission = admission_cls(
             self.store, self.bus, self.clock,
@@ -892,11 +974,15 @@ def replay_counterexample(rec: dict) -> List[str]:
 def bounded_config(variant: str = "default") -> ModelConfig:
     """The CI profile: 3 jobs, 2 hosts, start/scale/ack faults, one
     churnable host, deletable first job — a few thousand states in
-    seconds."""
+    seconds. j2 is the explicit FRACTIONAL-class job (the sub-host
+    tenant action of doc/fractional-sharing.md): its submits exercise
+    co-tenant packing, and `chip_oversubscribed` proves no
+    interleaving double-books a chip of a shared host block."""
     return ModelConfig(
         jobs=(JobShape("j0", min_chips=1, max_chips=4, epochs=2),
               JobShape("j1", min_chips=2, max_chips=4, epochs=1),
-              JobShape("j2", min_chips=1, max_chips=2, epochs=2)),
+              JobShape("j2", min_chips=1, max_chips=2, epochs=2,
+                       resource_class="fractional")),
         hosts=(("host-0", 4), ("host-1", 4)),
         depth=12,
         max_states=2600,
@@ -974,10 +1060,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default="bounded")
     parser.add_argument("--variant",
                         choices=sorted(set(VARIANTS)
-                                       | set(ADMISSION_VARIANTS)),
+                                       | set(ADMISSION_VARIANTS)
+                                       | set(PLACEMENT_VARIANTS)),
                         default="default",
-                        help="scheduler variant (bounded/deep profiles) "
-                             "or admission variant (fleet profile)")
+                        help="scheduler/placement variant (bounded/deep "
+                             "profiles) or admission variant (fleet "
+                             "profile)")
     parser.add_argument("--selftest", action="store_true",
                         help="run every seeded-bug variant and require "
                              "each to be CAUGHT (the checker's teeth)")
@@ -1005,6 +1093,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             reproduced = caught and bool(
                 replay_counterexample(result.counterexample))
             print(f"selftest {name}: "
+                  f"{'CAUGHT' if caught else 'MISSED'}"
+                  f"{' +replayed' if reproduced else ''} "
+                  f"({result.states} states)")
+            ok = ok and caught and reproduced
+        # Fractional teeth: the overlapping-partition commit (a
+        # sub-host tenant granted chips its host still advertises as
+        # free) must be caught by chip_oversubscribed with a
+        # replayable counterexample (doc/fractional-sharing.md).
+        for name in sorted(PLACEMENT_VARIANTS):
+            result = explore(PROFILES[profile](variant=name))
+            caught = result.counterexample is not None
+            reproduced = caught and bool(
+                replay_counterexample(result.counterexample))
+            print(f"selftest placement/{name}: "
                   f"{'CAUGHT' if caught else 'MISSED'}"
                   f"{' +replayed' if reproduced else ''} "
                   f"({result.states} states)")
@@ -1040,6 +1142,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         for m in mismatches[:10]:
             print(f"  {m}")
         ok = ok and not mismatches
+        # Feasibility-rounding equivalence (doc/fractional-sharing.md):
+        # the FeasibleTable-backed post-pass — including the fractional
+        # class axis and the sharing-off footprint pass — must match
+        # the scan-based oracle bit-for-bit over seeded mixed pools.
+        from vodascheduler_tpu.allocator.allocator import (
+            feasibility_self_check,
+        )
+        fz = feasibility_self_check(n_pools=100)
+        print(f"selftest feasibility-oracle: "
+              f"{'EQUIVALENT' if not fz else 'DIVERGED'} "
+              f"(100 pools x 2 sharing modes x mixed classes)")
+        for m in fz[:10]:
+            print(f"  {m}")
+        ok = ok and not fz
         return 0 if ok else 1
 
     t0 = time.monotonic()

@@ -281,6 +281,15 @@ def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None
               f"{placement.get('jobs_cross_host', 0)} "
               f"contiguity_cost={placement.get('contiguity_cost', 0)} "
               f"comms_score={placement.get('comms_score', 0)}")
+        frac = placement.get("fractional")
+        if frac:
+            # Fractional-sharing totals (doc/fractional-sharing.md):
+            # how much of the pool is co-tenant and what the tenants
+            # currently pay in interference price.
+            print(f"fractional: jobs={frac.get('fractional_jobs', 0)} "
+                  f"cotenant_hosts={frac.get('cotenant_hosts', 0)} "
+                  f"interference_price="
+                  f"{frac.get('interference_price', 0)}")
     print(f"scheduler profile over last {len(records)} pass(es):")
     per_phase = {}
     for rec in records:
@@ -374,6 +383,16 @@ def _print_explain(job: str, payload: dict, limit: int = 20) -> None:
             extra += (f" comms[w={comms.get('weight')} "
                       f"contig={comms.get('contiguity')} "
                       f"score={comms.get('score')}]")
+        frac = delta.get("fractional")
+        if frac:
+            # Fractional grant columns (doc/fractional-sharing.md):
+            # the sub-host partition, who shares its host block, and
+            # the priced interference.
+            tenants = ",".join(frac.get("co_tenants", ())) or "-"
+            extra += (f" fractional[{frac.get('partition')}chips"
+                      f"@{'+'.join(frac.get('hosts', ()))} "
+                      f"co_tenants={tenants} "
+                      f"price={frac.get('interference_price')}]")
         print(f"  [{rec.get('ts', 0):.1f}] resched#{rec.get('seq')} "
               f"({'+'.join(rec.get('triggers', ()))}, "
               f"{rec.get('algorithm')}): "

@@ -59,6 +59,16 @@ class WorkloadProfile:
     # host set — the placement-sensitive step-time model ROADMAP item 3
     # asks for. 0.0 = placement-insensitive (the pre-comms model).
     comms_fraction: float = 0.0
+    # Throughput fraction this workload loses when its hosts are FULLY
+    # co-tenant (placement/comms.py FAMILY_INTERFERENCE): with a
+    # topology installed, co-tenant step time is interference-sensitive
+    # — rate x= (1 - interference_fraction x cotenancy), where
+    # cotenancy is the chip-weighted share of the job's hosts' chips
+    # owned by OTHER jobs (doc/fractional-sharing.md). Multiplicative,
+    # not exponent-shaped like comms: a 1-chip tenant has speedup 1.0
+    # and an exponent degradation could never price its HBM/host
+    # contention. 0.0 = interference-free (the pre-fractional model).
+    interference_fraction: float = 0.0
     fail_at_epoch: Optional[int] = None  # inject a failure
     # Checkpoint-restart pause for THIS workload (overrides the backend
     # default): restore + recompile scales with model size, so a ResNet
@@ -112,6 +122,11 @@ class _SimJob:
     # recomputed whenever placements change; degrades the speedup via
     # the profile's comms_fraction (see _effective_speedup).
     comms_spread: float = 0.0
+    # Chip-weighted share of this job's hosts' chips owned by OTHER
+    # jobs, in [0, 1) — recomputed for every co-tenant whenever any
+    # placement on a shared host changes; degrades the rate via the
+    # profile's interference_fraction (doc/fractional-sharing.md).
+    cotenancy: float = 0.0
 
     @property
     def total_serial(self) -> float:
@@ -166,6 +181,17 @@ class FakeClusterBackend(ClusterBackend):
         # loss, reported by replay as comms_penalty_mean (busy-weighted
         # mean fraction of throughput lost to placement spread).
         self.comms_penalty_chip_seconds: float = 0.0
+        # ∫ chips x modeled co-tenant interference penalty dt
+        # (doc/fractional-sharing.md): the throughput share lost to
+        # sharing hosts, reported by replay as
+        # interference_penalty_mean — the honest price of the raw-
+        # utilization points fractional sharing recovers.
+        self.interference_penalty_chip_seconds: float = 0.0
+        # host -> {job: chips} live occupancy, maintained incrementally
+        # at every placement change; the cotenancy recompute reads only
+        # the touched hosts' entries, so a 10k-job pool's churn pass
+        # never pays an O(jobs) occupancy scan per backend call.
+        self._occupancy: Dict[str, Dict[str, int]] = {}
         self.jobs: Dict[str, _SimJob] = {}
         self.profiles: Dict[str, WorkloadProfile] = {}
         self.default_profile = WorkloadProfile()
@@ -268,6 +294,12 @@ class FakeClusterBackend(ClusterBackend):
             self._topology = topology
             self._host_coords = {topology.host_name(c): c
                                  for c in topology.host_coords()}
+            # Physics flipped placement-sensitive: refresh every live
+            # job's spread AND cotenancy (the occupancy map is already
+            # maintained; only the derived factors were dormant).
+            for sim in self.jobs.values():
+                sim.comms_spread = self._spread_of(sim.placements)
+                sim.cotenancy = self._cotenancy_of(sim)
 
     def _spread_of(self, placements: List[Tuple[str, int]]) -> float:
         """Normalized spread of a placement's host set; 0.0 without a
@@ -278,7 +310,7 @@ class FakeClusterBackend(ClusterBackend):
                   if n > 0 and h in self._host_coords]
         return self._topology.spread(coords)
 
-    def _effective_speedup(self, sim: _SimJob) -> float:
+    def _spread_speedup(self, sim: _SimJob) -> float:
         """The job's speedup at its current size AND placement: the
         profile curve degraded by `comms_fraction x spread` on the
         exponent — a contiguous block keeps (nearly) the ideal curve, a
@@ -290,6 +322,97 @@ class FakeClusterBackend(ClusterBackend):
         if f <= 0.0 or sim.comms_spread <= 0.0 or base <= 1.0:
             return base
         return base ** (1.0 - f * sim.comms_spread)
+
+    def _effective_speedup(self, sim: _SimJob) -> float:
+        """Spread-degraded speedup further scaled by co-tenant
+        interference (doc/fractional-sharing.md): rate x=
+        (1 - interference_fraction x cotenancy). Multiplicative — a
+        1-chip tenant's base speedup is 1.0, where an exponent
+        degradation could never price its HBM/host-resource contention
+        against co-residents."""
+        base = self._spread_speedup(sim)
+        fi = sim.profile.interference_fraction
+        if fi <= 0.0 or sim.cotenancy <= 0.0 or base <= 0.0:
+            return base
+        return base * max(0.0, 1.0 - fi * sim.cotenancy)
+
+    # ---- co-tenant interference (doc/fractional-sharing.md) --------------
+
+    def _cotenancy_of(self, sim: _SimJob) -> float:
+        """Chip-weighted share of the job's hosts' chips owned by other
+        jobs, in [0, 1). 0.0 without a topology (the pre-fractional
+        physics hermetic tests keep by never calling set_topology)."""
+        if self._topology is None:
+            return 0.0
+        total = sum(n for _, n in sim.placements if n > 0)
+        if total <= 0:
+            return 0.0
+        name = sim.spec.name
+        acc = 0.0
+        for h, n in sim.placements:
+            if n <= 0:
+                continue
+            chips = self.hosts.get(h, 0)
+            if chips <= 0:
+                continue
+            foreign = sum(c for j, c in self._occupancy.get(h, {}).items()
+                          if j != name)
+            acc += (n / total) * min(1.0, foreign / chips)
+        return acc
+
+    def _set_placements(self, sim: _SimJob,
+                        placements: List[Tuple[str, int]]) -> None:
+        """Swap a job's placements, maintain the incremental occupancy
+        map, and refresh spread + cotenancy — for the job itself AND
+        for every co-tenant on a touched host. Each affected co-tenant
+        is accrued at its OLD rate first (the rate change must not be
+        backdated over the closed window) and its epoch timer re-armed
+        at the new rate. Callers hold the state lock and re-arm SIM's
+        own timer themselves."""
+        name = sim.spec.name
+        touched = set()
+        for h, n in sim.placements:
+            if n <= 0:
+                continue
+            touched.add(h)
+            tenants = self._occupancy.get(h)
+            if tenants is not None:
+                tenants.pop(name, None)
+                if not tenants:
+                    del self._occupancy[h]
+        sim.placements = placements
+        for h, n in placements:
+            if n <= 0:
+                continue
+            touched.add(h)
+            tenants = self._occupancy.setdefault(h, {})
+            tenants[name] = tenants.get(name, 0) + n
+        sim.comms_spread = self._spread_of(placements)
+        sim.cotenancy = self._cotenancy_of(sim)
+        if self._topology is None or not touched:
+            return
+        affected = set()
+        for h in touched:
+            affected.update(self._occupancy.get(h, ()))
+        affected.discard(name)
+        for other_name in affected:
+            other = self.jobs.get(other_name)
+            if other is None:
+                continue
+            new_cot = self._cotenancy_of(other)
+            if abs(new_cot - other.cotenancy) < 1e-12:
+                continue
+            if (other.profile.interference_fraction > 0.0
+                    and other.num_workers > 0):
+                # Its modeled rate just moved: close the old window at
+                # the old rate, invalidate the old-rate epoch timer,
+                # re-arm at the new rate.
+                self._accrue(other)
+                other.cotenancy = new_cot
+                other.generation += 1
+                self._schedule_next_event(other)
+            else:
+                other.cotenancy = new_cot
 
     def list_hosts(self) -> Dict[str, int]:
         with self._state_lock:
@@ -346,14 +469,13 @@ class FakeClusterBackend(ClusterBackend):
                 # (checkpoint)
                 sim = existing
                 sim.num_workers = num_workers
-                sim.placements = placements or []
             else:
                 sim = _SimJob(spec=spec, profile=self._profile_for(spec),
                               num_workers=num_workers,
-                              placements=placements or [], last_update=now)
+                              placements=[], last_update=now)
                 self.jobs[spec.name] = sim
                 self.metrics_rows.setdefault(spec.name, [])
-            sim.comms_spread = self._spread_of(sim.placements)
+            self._set_placements(sim, placements or [])
             sim.restarts += 1
             self.restarts_total += 1
             overhead = self._overhead(sim)
@@ -431,8 +553,7 @@ class FakeClusterBackend(ClusterBackend):
                        "simulated": True}):
             sim.num_workers = num_workers
             if placements is not None:
-                sim.placements = placements
-            sim.comms_spread = self._spread_of(sim.placements)
+                self._set_placements(sim, placements)
             if inplace:
                 sim.resizes_inplace += 1
                 self.resizes_inplace_total += 1
@@ -474,8 +595,7 @@ class FakeClusterBackend(ClusterBackend):
                 return  # completed/failed during the modeled round trip
             self._accrue(sim)
             sim.num_workers = 0
-            sim.placements = []
-            sim.comms_spread = 0.0
+            self._set_placements(sim, [])
             sim.generation += 1  # cancel pending timers
             # A halt's checkpoint drain is folded into the NEXT start's
             # restart overhead (that's where the sim charges it), so the
@@ -531,10 +651,16 @@ class FakeClusterBackend(ClusterBackend):
             self.busy_chip_seconds += dt * sim.num_workers
             ideal = sim.profile.speedup_at(sim.num_workers)
             if ideal > 0.0 and rate < ideal:
-                # Busy-weighted comms loss: chips x the fraction of
-                # throughput the placement's spread cost this window.
+                # Busy-weighted loss split into its two modeled causes:
+                # spread (comms over long ICI paths) and co-tenant
+                # interference (doc/fractional-sharing.md).
+                spread_rate = self._spread_speedup(sim)
                 self.comms_penalty_chip_seconds += (
-                    dt * sim.num_workers * (1.0 - rate / ideal))
+                    dt * sim.num_workers * (1.0 - spread_rate / ideal))
+                if rate < spread_rate:
+                    self.interference_penalty_chip_seconds += (
+                        dt * sim.num_workers
+                        * (spread_rate - rate) / ideal)
         sim.last_update = now
 
     def sync_accounting(self) -> None:
@@ -610,6 +736,9 @@ class FakeClusterBackend(ClusterBackend):
         if (sim.profile.fail_at_epoch is not None
                 and sim.epochs_done >= sim.profile.fail_at_epoch):
             self.failed.append(sim.spec.name)
+            # Vacate the host share so co-tenants' interference rates
+            # recover the moment the tenancy ends.
+            self._set_placements(sim, [])
             del self.jobs[sim.spec.name]
             return ClusterEvent(
                 ClusterEventKind.JOB_FAILED, sim.spec.name,
@@ -618,6 +747,7 @@ class FakeClusterBackend(ClusterBackend):
 
         if sim.epochs_done >= sim.spec.config.epochs:
             self.completed.append(sim.spec.name)
+            self._set_placements(sim, [])
             del self.jobs[sim.spec.name]
             return ClusterEvent(ClusterEventKind.JOB_COMPLETED,
                                 sim.spec.name, timestamp=now)

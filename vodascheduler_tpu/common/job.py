@@ -28,6 +28,35 @@ MAX_NUM_CHIPS = 256
 
 _TIMESTAMP_RE = re.compile(r"-\d{8}-\d{6}$")
 
+# Resource classes (doc/fractional-sharing.md): how a job's grant maps
+# onto host hardware. A WHOLE_HOST job schedules at the pool's classic
+# slice-shape granularity; a FRACTIONAL job is a sub-host tenant — its
+# grant is a static chip-partition of ONE host block, co-resident with
+# other fractional tenants. AUTO resolves from the job's ceiling: a job
+# that can never fill a host (max_num_chips < chips_per_host) is the
+# eval/debug/fine-tune long tail fractional sharing exists for.
+RESOURCE_CLASS_AUTO = "auto"
+RESOURCE_CLASS_FRACTIONAL = "fractional"
+RESOURCE_CLASS_WHOLE_HOST = "whole_host"
+RESOURCE_CLASSES = (RESOURCE_CLASS_AUTO, RESOURCE_CLASS_FRACTIONAL,
+                    RESOURCE_CLASS_WHOLE_HOST)
+
+
+def resolve_resource_class(spec_class: str, max_chips: int,
+                           chips_per_host: int) -> str:
+    """The job's effective resource class on a pool with
+    `chips_per_host`-chip host blocks: an explicit spec class wins;
+    AUTO (or anything unknown — admission validates, but old stored
+    specs predate the field) derives from whether the job's ceiling
+    fits under one host block."""
+    if spec_class == RESOURCE_CLASS_FRACTIONAL:
+        return RESOURCE_CLASS_FRACTIONAL
+    if spec_class == RESOURCE_CLASS_WHOLE_HOST:
+        return RESOURCE_CLASS_WHOLE_HOST
+    return (RESOURCE_CLASS_FRACTIONAL
+            if 0 < max_chips < chips_per_host
+            else RESOURCE_CLASS_WHOLE_HOST)
+
 
 def category_of(job_name: str) -> str:
     """Job 'category' = name minus the submission timestamp suffix.
@@ -48,7 +77,7 @@ def timestamped_name(base: str, now: Optional[float] = None) -> str:
     return f"{base}-{_time.strftime('%Y%m%d-%H%M%S', t)}"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class JobConfig:
     """User-requested elasticity bounds. Reference: JobConfig
     (trainingjob.go:34-40); num/min/max procs become chip counts."""
@@ -71,7 +100,7 @@ class JobConfig:
             )
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class JobMetrics:
     """Cumulative + windowed time accounting driving Tiresias promote/demote
     and the status tables. Reference: JobMetrics (trainingjob.go:43-58).
@@ -102,7 +131,7 @@ class JobMetrics:
     last_update_time: float = 0.0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class JobInfo:
     """Learned performance profile consumed by info-needing algorithms
     (SRJF, ElasticSRJF, ElasticTiresias, FfDLOptimizer, AFS-L).
@@ -193,6 +222,12 @@ class JobSpec:
     # None = derive from the job's category's model family. Drives the
     # bandwidth-aware placement objective and migration pricing.
     collectives: Optional[Dict[str, float]] = None
+    # Resource class (doc/fractional-sharing.md): "auto" (default —
+    # fractional iff max_num_chips < the pool's chips_per_host),
+    # "fractional" (sub-host static chip-partition, co-tenant with
+    # other fractional jobs), or "whole_host" (classic slice-shape
+    # granularity). Resolved per pool by resolve_resource_class.
+    resource_class: str = RESOURCE_CLASS_AUTO
     extra: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:

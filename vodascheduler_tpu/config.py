@@ -138,6 +138,16 @@ FLEET_ROUTER = os.environ.get("VODA_FLEET_ROUTER", "1") != "0"
 MIGRATION_PAYBACK_SECONDS = _env_float(
     "VODA_MIGRATION_PAYBACK_SECONDS", "900")
 
+# Fractional sub-host sharing (doc/fractional-sharing.md): on (the
+# default), FRACTIONAL-class jobs — the sub-host eval/debug/fine-tune
+# long tail — share a host's chips via static chip-partition, with
+# co-tenant interference priced into placement and the step-time
+# model. VODA_FRACTIONAL_SHARING=0 restores the whole-host-minimum
+# baseline (every grant's capacity cost rounds up to whole host
+# blocks, sub-host jobs get exclusive hosts) — the A/B arm the
+# fractional_sharing_ab bench row measures stranded capacity against.
+FRACTIONAL_SHARING = os.environ.get("VODA_FRACTIONAL_SHARING", "1") != "0"
+
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the
 # checkpoint-restart path. Must cover the resharded step's XLA compile

@@ -57,6 +57,10 @@ REASON_CODES = frozenset({
     "resize_cold",               # checkpoint-restart resize
     "hysteresis_suppressed",     # small grow clipped back to the old size
     "hysteresis_bypassed_grow_fits_host",  # grow passed the gate: fits own host
+    "hysteresis_bypassed_fractional_fit",  # fractional tenant's grow stays a
+                                           # sub-host partition of its own
+                                           # host block (doc/fractional-
+                                           # sharing.md) — never adds a host
     "start_failed",              # backend raised; allocation reverted
     "scale_failed",              # backend raised; re-booked from live state
     "halt_failed",               # backend raised; halt kept booked for retry
@@ -147,6 +151,11 @@ SPAN_NAMES = frozenset({
 _REQUIRED_AUDIT_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
                           "triggers", "algorithm", "total_chips", "queue",
                           "deltas", "duration_ms")
+# The optional per-delta fractional block (doc/fractional-sharing.md):
+# closed keys, like the reason vocabulary — a delta naming a fractional
+# grant must carry exactly this shape.
+_REQUIRED_FRACTIONAL_FIELDS = ("partition", "hosts", "co_tenants",
+                               "interference_price")
 _REQUIRED_SPAN_FIELDS = ("kind", "trace_id", "span_id", "name", "component",
                          "start", "end", "duration_ms", "status")
 _REQUIRED_ACCESS_FIELDS = ("kind", "ts", "method", "path", "status",
@@ -273,6 +282,22 @@ def _validate_audit(rec: Dict[str, Any]) -> List[str]:
                                 f"(job {delta.get('job')!r})")
         if not delta.get("reasons"):
             problems.append(f"delta for {delta.get('job')!r} has no reasons")
+        frac = delta.get("fractional")
+        if frac is not None:
+            if not isinstance(frac, dict):
+                problems.append(f"delta for {delta.get('job')!r}: "
+                                f"fractional block is not an object")
+            else:
+                for f in _REQUIRED_FRACTIONAL_FIELDS:
+                    if f not in frac:
+                        problems.append(
+                            f"delta for {delta.get('job')!r}: fractional "
+                            f"block missing {f!r}")
+                for f in frac:
+                    if f not in _REQUIRED_FRACTIONAL_FIELDS:
+                        problems.append(
+                            f"delta for {delta.get('job')!r}: unknown "
+                            f"fractional field {f!r}")
     return problems
 
 

@@ -137,6 +137,46 @@ FAMILY_COLLECTIVES: Dict[str, CollectiveProfile] = {
 }
 
 
+# Assumed per-family co-tenant interference fractions
+# (doc/fractional-sharing.md): the throughput share a job loses when
+# its hosts are FULLY co-tenant (shared HBM bandwidth, host CPU input
+# pipelines, intra-host ICI hops through a partitioned block). Small
+# vision jobs — the fractional long tail — are input-pipeline- and
+# HBM-bound, so they interfere hardest per chip; the LLM families are
+# compute-dominated on their own whole hosts and barely notice a
+# neighbor. Same table-sync discipline as FAMILY_COLLECTIVES: a family
+# added to trace.MODEL_FAMILIES without an entry here fails
+# sanity_check_families().
+FAMILY_INTERFERENCE: Dict[str, float] = {
+    "resnet50": 0.08,
+    "bert":     0.06,
+    "vitl":     0.05,
+    "llama8b":  0.03,
+    "mixtral":  0.03,
+}
+
+# One integer interference-weight unit per this much interference
+# fraction, capped — the same integer-bucketing posture as the comms
+# weight (keeps the _pick_host pricing integer and bounded).
+INTERFERENCE_WEIGHT_UNIT = 0.02
+MAX_INTERFERENCE_WEIGHT = 8
+
+
+def interference_fraction_for_category(category: str) -> float:
+    """The co-tenant interference fraction of a job category; 0.0 when
+    unknown (interference-free, the pre-fractional physics)."""
+    return FAMILY_INTERFERENCE.get(category, 0.0)
+
+
+def interference_weight_for_category(category: str) -> int:
+    """Integer placement interference weight (0..MAX_INTERFERENCE_WEIGHT):
+    how much one foreign chip on a shared host costs this job in the
+    _pick_host pricing (placement/manager.py)."""
+    fraction = interference_fraction_for_category(category)
+    return min(MAX_INTERFERENCE_WEIGHT,
+               int(round(fraction / INTERFERENCE_WEIGHT_UNIT)))
+
+
 def profile_for_category(category: str) -> Optional[CollectiveProfile]:
     """The collective profile of a job category (name minus timestamp),
     or None for workloads with no declared/known shape (their placement
@@ -271,3 +311,9 @@ def sanity_check_families() -> None:
             "comms families out of sync: trace.MODEL_FAMILIES vs "
             "comms.FAMILY_COLLECTIVES — a new family needs a collective "
             "profile (placement/comms.py)")
+    if set(MODEL_FAMILIES) != set(FAMILY_INTERFERENCE):
+        raise ValueError(
+            "interference families out of sync: trace.MODEL_FAMILIES vs "
+            "comms.FAMILY_INTERFERENCE — a new family needs a co-tenant "
+            "interference fraction (placement/comms.py, "
+            "doc/fractional-sharing.md)")

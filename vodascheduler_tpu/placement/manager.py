@@ -79,6 +79,14 @@ class PlacementManager:
                               else bool(comms_enabled))
         self.comms_weights: Dict[str, int] = {}
         self._comms_total = 0
+        # --- fractional sub-host sharing (doc/fractional-sharing.md) ---
+        # Integer per-job co-tenant interference weights: set by the
+        # scheduler for FRACTIONAL-class jobs only (whole-host jobs
+        # never carry one), priced into _pick_host so a sub-host tenant
+        # prefers the least-co-tenanted host that fits. Empty map =
+        # count-only picks, bit-identical to the pre-fractional
+        # decisions.
+        self.interference_weights: Dict[str, int] = {}
         # --- decide-path fast kernels (ROADMAP item 2) ---
         # The incremental pass used to snapshot + re-diff + re-score
         # every job every pass (O(jobs) dict/list churn while the
@@ -124,6 +132,12 @@ class PlacementManager:
         self.m_jobs_cross_host = registry.gauge(
             "voda_placement_jobs_cross_host",
             "Jobs spanning more than one host after the last pass",
+            const_labels=pool_l)
+        registry.gauge(
+            "voda_placement_cotenant_hosts",
+            "Hosts currently shared by more than one job (fractional "
+            "sub-host co-tenancy, doc/fractional-sharing.md)",
+            fn=lambda: float(self.cotenant_host_count()),
             const_labels=pool_l)
 
     # ---- host membership (reference: node informer handlers :174-304) ----
@@ -195,6 +209,82 @@ class PlacementManager:
         if not self.comms_enabled:
             return 0
         return self.comms_weights.get(job, 0)
+
+    # ---- fractional co-tenancy (doc/fractional-sharing.md) ---------------
+
+    def set_interference_weights(self, weights: Dict[str, int]) -> None:
+        """Install per-job integer interference weights (the scheduler
+        derives them from job categories for fractional-class jobs each
+        pass, memoized like the comms weights)."""
+        self.interference_weights = dict(weights)
+
+    def _interference_of(self, job: str) -> int:
+        return self.interference_weights.get(job, 0)
+
+    def _foreign_chips(self, host: HostState, job: str) -> int:
+        """Chips other jobs occupy on `host` — the co-tenant load an
+        interference-priced pick minimizes."""
+        occupied = host.total_slots - host.free_slots
+        return max(0, occupied - host.job_num_workers.get(job, 0))
+
+    def cotenant_host_count(self) -> int:
+        """Hosts currently shared by more than one job — the fleet
+        co-tenancy gauge (`voda_placement_cotenant_hosts`)."""
+        return sum(1 for h in self.host_states.values()
+                   if len(h.job_num_workers) > 1)
+
+    def fractional_fleet_stats(self) -> Dict[str, int]:
+        """Fleet fractional-sharing totals for the perf record and
+        `voda top`: how many interference-weighted (fractional) jobs
+        hold placements, how many hosts are co-tenant, and the summed
+        interference price (Σ weight x foreign chips) those tenants
+        currently pay."""
+        jobs = 0
+        price = 0
+        for job, w in self.interference_weights.items():
+            if w <= 0:
+                continue
+            placement = self.job_placements.get(job)
+            if placement is None:
+                continue
+            jobs += 1
+            for hs in placement.host_slots:
+                host = self.host_states.get(hs.host)
+                if host is not None and hs.num_slots > 0:
+                    price += w * self._foreign_chips(host, job)
+        return {"fractional_jobs": jobs,
+                "cotenant_hosts": self.cotenant_host_count(),
+                "interference_price": price}
+
+    def job_fractional_stats(self, job: str) -> Optional[Dict[str, object]]:
+        """The fractional delta block `voda explain` renders
+        (doc/fractional-sharing.md): the job's partition size, the
+        host(s) it partitions, its co-tenants, and its current
+        interference price (weight x foreign chips). None for jobs with
+        no placement or no interference weight (whole-host jobs)."""
+        w = self._interference_of(job)
+        placement = self.job_placements.get(job)
+        if w <= 0 or placement is None:
+            return None
+        hosts: List[str] = []
+        co_tenants: List[str] = []
+        price = 0
+        partition = 0
+        for hs in placement.host_slots:
+            if hs.num_slots <= 0:
+                continue
+            partition += hs.num_slots
+            host = self.host_states.get(hs.host)
+            if host is None:
+                continue
+            hosts.append(hs.host)
+            price += w * self._foreign_chips(host, job)
+            for tenant in host.job_num_workers:
+                if tenant != job and tenant not in co_tenants:
+                    co_tenants.append(tenant)
+        return {"partition": partition, "hosts": hosts,
+                "co_tenants": sorted(co_tenants),
+                "interference_price": price}
 
     def job_comms_stats(self, job: str) -> Optional[Tuple[int, int, int]]:
         """(weight, contiguity cost, comms score) of one placed job —
@@ -385,14 +475,15 @@ class PlacementManager:
             my_hosts = [host_states[hs.host] for hs in placement.host_slots
                         if hs.host in host_states and hs.num_slots > 0]
             weight = self._weight_of(job)
+            interference = self._interference_of(job)
             while delta > 0:
                 best = self._pick_host(hosts, delta, my_hosts,
-                                       prefer_own=True, weight=weight)
+                                       prefer_own=True, weight=weight,
+                                       interference=interference, job=job)
                 if best is None:
                     break  # tolerated inconsistency: place what fits
                 take = min(best.free_slots, delta)
-                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
-                best.free_slots -= take
+                self._commit_slots(best, job, take)
                 delta -= take
                 placement.num_workers += take
                 if placement.host_slots and placement.host_slots[-1].host == best.name:
@@ -403,6 +494,15 @@ class PlacementManager:
                     my_hosts.append(best)
             if placement.num_workers == 0:
                 del jp[job]
+
+    def _commit_slots(self, host: HostState, job: str, take: int) -> None:
+        """Commit `take` chips of `host` to `job` — the single
+        partition-commit seam every packing loop goes through. The
+        modelcheck seeded-bug tooth subclasses exactly this to prove
+        `chip_oversubscribed` has teeth (an overlapping-partition
+        commit that forgets the free-slot decrement)."""
+        host.job_num_workers[job] = host.job_num_workers.get(job, 0) + take
+        host.free_slots -= take
 
     def _decision_fast(self) -> PlacementDecision:
         """Diff + stats + view refresh over the touched jobs only; the
@@ -608,14 +708,15 @@ class PlacementManager:
             my_hosts = [self.host_states[hs.host] for hs in placement.host_slots
                         if hs.host in self.host_states and hs.num_slots > 0]
             weight = self._weight_of(job)
+            interference = self._interference_of(job)
             while delta > 0:
                 best = self._pick_host(hosts, delta, my_hosts,
-                                       prefer_own=True, weight=weight)
+                                       prefer_own=True, weight=weight,
+                                       interference=interference, job=job)
                 if best is None:
                     break  # tolerated inconsistency: place what fits
                 take = min(best.free_slots, delta)
-                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
-                best.free_slots -= take
+                self._commit_slots(best, job, take)
                 delta -= take
                 placement.num_workers += take
                 # merge into an existing tail entry for the same host
@@ -687,6 +788,7 @@ class PlacementManager:
             remaining = requested
             my_hosts: List[HostState] = []
             weight = self._weight_of(job)
+            interference = self._interference_of(job)
             while remaining > 0:
                 if total_free == 0:
                     # Tolerated inconsistency with the scheduler's capacity
@@ -694,12 +796,12 @@ class PlacementManager:
                     # crash.
                     break
                 best = self._pick_host(hosts, remaining, my_hosts,
-                                       weight=weight)
+                                       weight=weight,
+                                       interference=interference, job=job)
                 if best is None:
                     break
                 take = min(best.free_slots, remaining)
-                best.job_num_workers[job] = best.job_num_workers.get(job, 0) + take
-                best.free_slots -= take
+                self._commit_slots(best, job, take)
                 total_free -= take
                 remaining -= take
                 my_hosts.append(best)
@@ -715,7 +817,9 @@ class PlacementManager:
     def _pick_host(self, hosts: List[HostState], requested: int,
                    my_hosts: List[HostState],
                    prefer_own: bool = False,
-                   weight: int = 0) -> Optional[HostState]:
+                   weight: int = 0,
+                   interference: int = 0,
+                   job: str = "") -> Optional[HostState]:
         """Best-fit with ICI tie-breaking — comms-weighted when the job
         carries a communication weight.
 
@@ -754,6 +858,20 @@ class PlacementManager:
                 return min(own, key=lambda h: h.free_slots)
         fitting = [h for h in hosts if h.free_slots >= requested]
         if fitting:
+            if interference > 0:
+                # Fractional co-tenancy price (doc/fractional-sharing.md):
+                # a sub-host tenant pays `interference_fraction x
+                # cotenancy` of its throughput every step, so the pick
+                # trades packing tightness for the least-co-tenanted
+                # host that fits — weight x foreign chips leads,
+                # tightness demoted to the tie-break (mirroring what
+                # the comms branch below does for contiguity). Weight 0
+                # (every whole-host job) never reaches this branch, so
+                # the count-only pick is untouched.
+                return min(fitting,
+                           key=lambda h: (interference
+                                          * self._foreign_chips(h, job),
+                                          h.free_slots))
             if (weight > 0 and self.comms_enabled
                     and self.topology is not None and my_hosts):
                 anchor = [h.coord for h in my_hosts if h.coord is not None]

@@ -97,7 +97,9 @@ class FeasibleTable:
     differential-test oracles (tests/test_fastpath_oracle.py).
     """
 
-    __slots__ = ("total", "feasible", "round_down", "next_at")
+    __slots__ = ("total", "feasible", "round_down", "next_at",
+                 "chips_per_host", "frac_feasible", "frac_round_down",
+                 "frac_next_at")
 
     def __init__(self, torus_dims: Tuple[int, ...],
                  host_block: Tuple[int, ...]) -> None:
@@ -112,24 +114,47 @@ class FeasibleTable:
             else:
                 feasible[n] = (n % cph == 0
                                and bool(_divisor_shapes(n // cph, host_grid)))
+        # Fractional twin (doc/fractional-sharing.md): a FRACTIONAL job's
+        # sub-host grant is a static chip-partition of one host block,
+        # not a contiguous sub-torus — every chip of a host block is at
+        # most one intra-host ICI hop from every other, so ANY count
+        # 1..chips_per_host-1 partitions cleanly (a 3-chip partition of a
+        # 2x2 block is fine; only multi-host slices need torus shapes).
+        # At or above one host the classic whole-host table applies
+        # unchanged.
+        frac_feasible = [n < cph or feasible[n]
+                         for n in range(total + 1)]
         round_down = [0] * (total + 1)
-        best = 0
+        frac_round_down = [0] * (total + 1)
+        best = frac_best = 0
         for n in range(1, total + 1):
             if feasible[n]:
                 best = n
+            if frac_feasible[n]:
+                frac_best = n
             round_down[n] = best
+            frac_round_down[n] = frac_best
         # next_at[k] = smallest feasible count >= k (k in 0..total);
         # None past the pool's largest feasible count.
         next_at: List[Optional[int]] = [None] * (total + 1)
+        frac_next_at: List[Optional[int]] = [None] * (total + 1)
         nxt: Optional[int] = None
+        frac_nxt: Optional[int] = None
         for n in range(total, -1, -1):
             if feasible[n]:
                 nxt = n
+            if frac_feasible[n]:
+                frac_nxt = n
             next_at[n] = nxt
+            frac_next_at[n] = frac_nxt
         self.total = total
+        self.chips_per_host = cph
         self.feasible = feasible
         self.round_down = round_down
         self.next_at = next_at
+        self.frac_feasible = frac_feasible
+        self.frac_round_down = frac_round_down
+        self.frac_next_at = frac_next_at
 
     _cache: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], "FeasibleTable"] = {}
 
@@ -142,7 +167,8 @@ class FeasibleTable:
         return table
 
 
-def round_to_feasible(n: int, topology: "PoolTopology") -> int:
+def round_to_feasible(n: int, topology: "PoolTopology",
+                      fractional: bool = False) -> int:
     """Largest feasible chip count <= n on this pool.
 
     Feasible = a contiguous sub-block of one host (sub-host jobs share a
@@ -152,23 +178,32 @@ def round_to_feasible(n: int, topology: "PoolTopology") -> int:
     shape-feasibility check SURVEY.md §7 derives from `map[job]int`
     becoming `map[job]sliceShape` (reference invariant enforcement:
     pkg/algorithm/utils.go:18-42 has no such notion — GPUs are fungible).
+
+    `fractional` (doc/fractional-sharing.md) switches to the fractional
+    resource class's table: a sub-host grant rounds WITHIN a host block
+    (every count 1..chips_per_host-1 is a valid static chip-partition)
+    instead of against the sub-torus shape catalog.
     """
     table = FeasibleTable.for_topology(topology)
     if n <= 0:
         return 0
-    return table.round_down[n if n <= table.total else table.total]
+    k = n if n <= table.total else table.total
+    return (table.frac_round_down if fractional else table.round_down)[k]
 
 
-def next_feasible_above(n: int, topology: "PoolTopology") -> Optional[int]:
+def next_feasible_above(n: int, topology: "PoolTopology",
+                        fractional: bool = False) -> Optional[int]:
     """Smallest feasible chip count > n, or None if the pool tops out."""
     table = FeasibleTable.for_topology(topology)
     k = n + 1
     if k > table.total:
         return None
-    return table.next_at[k if k > 0 else 0]
+    return (table.frac_next_at if fractional
+            else table.next_at)[k if k > 0 else 0]
 
 
-def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
+def is_feasible_count(n: int, topology: "PoolTopology",
+                      fractional: bool = False) -> bool:
     """O(1) table lookup — this sits on the allocation hot path via
     enforce_feasibility and validate_result. A count above the pool's
     total can never tile it (factors are bounded by the host grid), so
@@ -178,41 +213,45 @@ def is_feasible_count(n: int, topology: "PoolTopology") -> bool:
     table = FeasibleTable.for_topology(topology)
     if n < 0 or n > table.total:
         return False
-    return table.feasible[n]
+    return (table.frac_feasible if fractional else table.feasible)[n]
 
 
 # ---- scan-based reference primitives (differential-test oracles) -----------
 
 
-def _is_feasible_scan(n: int, topology: "PoolTopology") -> bool:
+def _is_feasible_scan(n: int, topology: "PoolTopology",
+                      fractional: bool = False) -> bool:
     """Pre-table is_feasible_count: one factorization enumeration per
     probe. Multi-host slices must be a contiguous block of *whole
     hosts*, i.e. a sub-grid of the host grid scaled by the host block —
     so the check factorizes n / chips_per_host over the host grid, not
     n over the raw torus (e.g. 36 chips on a (4,4,4)/(2,2,1) pool
     factor as 3x3x4 chips, but no union of whole 2x2x1 hosts forms
-    that box: infeasible)."""
+    that box: infeasible). `fractional` mirrors the table's fractional
+    axis: any sub-host count is a valid static chip-partition."""
     if n == 0:
         return True
     if n < 0:
         return False
     cph = topology.chips_per_host
     if n < cph:
-        return bool(_divisor_shapes(n, topology.host_block))
+        return True if fractional else bool(
+            _divisor_shapes(n, topology.host_block))
     return n % cph == 0 and bool(_divisor_shapes(n // cph, topology.host_grid))
 
 
-def _round_to_feasible_scan(n: int, topology: "PoolTopology") -> int:
+def _round_to_feasible_scan(n: int, topology: "PoolTopology",
+                            fractional: bool = False) -> int:
     for k in range(min(n, topology.total_chips), 0, -1):
-        if _is_feasible_scan(k, topology):
+        if _is_feasible_scan(k, topology, fractional):
             return k
     return 0
 
 
-def _next_feasible_above_scan(n: int,
-                              topology: "PoolTopology") -> Optional[int]:
+def _next_feasible_above_scan(n: int, topology: "PoolTopology",
+                              fractional: bool = False) -> Optional[int]:
     for k in range(n + 1, topology.total_chips + 1):
-        if _is_feasible_scan(k, topology):
+        if _is_feasible_scan(k, topology, fractional):
             return k
     return None
 
@@ -306,6 +345,16 @@ class PoolTopology:
         if diameter <= 0:
             return 0.0
         return min(1.0, self.mean_hop_distance(coords) / diameter)
+
+    def host_footprint(self, n: int) -> int:
+        """Chips a grant of n physically occupies when the minimum
+        allocation unit is a whole host (the sharing-off baseline of
+        doc/fractional-sharing.md): n rounded up to whole host blocks.
+        With fractional sharing on, a grant's footprint is itself."""
+        if n <= 0:
+            return 0
+        cph = self.chips_per_host
+        return ((n + cph - 1) // cph) * cph
 
     def slice_for(self, num_chips: int) -> Optional[SliceShape]:
         """Best contiguous shape for num_chips on this torus, if any."""

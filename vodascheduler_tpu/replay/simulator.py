@@ -74,6 +74,13 @@ class ReplayReport:
     # axis the bench's topology-sensitive mix reports.
     comms_penalty_mean: float = 0.0
     placement_comms: bool = True
+    # Fractional sub-host sharing (doc/fractional-sharing.md): whether
+    # the sharing plane was on for this run (off = the whole-host-
+    # minimum baseline arm), and the busy-weighted mean fraction of
+    # throughput lost to co-tenant interference — the honest price of
+    # the stranded capacity sharing recovers.
+    fractional_sharing: bool = True
+    interference_penalty_mean: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -145,6 +152,15 @@ class ReplayHarness:
         # consolidation migrations: the aware arm payback-gates them,
         # the count-only arm fires every re-binding.
         defrag_cross_host_threshold: int = 0,
+        # Fractional sub-host sharing (doc/fractional-sharing.md):
+        # None = the environment default (VODA_FRACTIONAL_SHARING, on
+        # unless 0); False forces the whole-host-minimum baseline —
+        # the fractional_sharing_ab A/B arm. The SIMULATOR's
+        # interference-sensitive step-time model stays on either way
+        # (physics is not a policy knob; the baseline arm's exclusive
+        # hosts simply have no co-tenants to interfere with), so both
+        # arms are judged under the same cost model.
+        fractional_sharing: Optional[bool] = None,
     ):
         self.trace = list(trace)
         self.algorithm = algorithm
@@ -200,6 +216,7 @@ class ReplayHarness:
                 if resize_cooldown_seconds is None
                 else resize_cooldown_seconds),
             defrag_cross_host_threshold=defrag_cross_host_threshold,
+            fractional_sharing=fractional_sharing,
             tracer=self.tracer,
             # A live pass occupies real time while its actuation waves
             # run; under the VirtualClock it would occupy none, letting
@@ -389,4 +406,9 @@ class ReplayHarness:
                 / self.backend.busy_chip_seconds, 4)
             if self.backend.busy_chip_seconds > 0 else 0.0,
             placement_comms=self.placement_comms,
+            fractional_sharing=self.scheduler.fractional_sharing,
+            interference_penalty_mean=round(
+                self.backend.interference_penalty_chip_seconds
+                / self.backend.busy_chip_seconds, 4)
+            if self.backend.busy_chip_seconds > 0 else 0.0,
         )

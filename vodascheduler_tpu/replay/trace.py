@@ -43,6 +43,13 @@ class TraceJob:
     # WHERE the job lands moves its modeled step time. 0.0 keeps the
     # job placement-insensitive (old traces load unchanged).
     comms_fraction: float = 0.0
+    # Throughput share lost at full co-tenancy (placement/comms.py
+    # FAMILY_INTERFERENCE): the simulator scales the rate by
+    # (1 - interference_fraction x cotenancy), so WHO shares the job's
+    # hosts moves its modeled step time (doc/fractional-sharing.md).
+    # 0.0 keeps the job interference-insensitive (old traces load
+    # unchanged).
+    interference_fraction: float = 0.0
 
     def job_spec(self, pool: str) -> JobSpec:
         return JobSpec(
@@ -57,6 +64,7 @@ class TraceJob:
             epoch_seconds_at_1=self.epoch_seconds_at_1,
             speedup_exponent=self.speedup_exponent,
             comms_fraction=self.comms_fraction,
+            interference_fraction=self.interference_fraction,
             fail_at_epoch=self.fail_at_epoch,
             restart_overhead_seconds=self.restart_overhead_seconds,
             inplace_overhead_seconds=self.inplace_overhead_seconds)
@@ -97,7 +105,10 @@ def philly_like_trace(
       range (Philly mode is small jobs; LLM families claim large slices)
     - duration: log-normal heavy tail on epoch count
     """
-    from vodascheduler_tpu.placement.comms import fraction_for_category
+    from vodascheduler_tpu.placement.comms import (
+        fraction_for_category,
+        interference_fraction_for_category,
+    )
     from vodascheduler_tpu.replay.restart_costs import family_restart_costs
 
     rng = random.Random(seed)
@@ -145,6 +156,7 @@ def philly_like_trace(
             restart_overhead_seconds=restart_costs[model].restart_s,
             inplace_overhead_seconds=restart_costs[model].inplace_s,
             comms_fraction=fraction_for_category(model),
+            interference_fraction=interference_fraction_for_category(model),
         ))
     return jobs
 
@@ -173,7 +185,10 @@ def topology_mix_trace(
     on vs off (ReplayHarness placement_comms) under the SAME
     placement-sensitive step-time model is the bench's A/B proof row.
     """
-    from vodascheduler_tpu.placement.comms import fraction_for_category
+    from vodascheduler_tpu.placement.comms import (
+        fraction_for_category,
+        interference_fraction_for_category,
+    )
     from vodascheduler_tpu.replay.restart_costs import family_restart_costs
 
     rng = random.Random(f"{seed}-topomix")
@@ -204,6 +219,7 @@ def topology_mix_trace(
             restart_overhead_seconds=restart_costs[model].restart_s,
             inplace_overhead_seconds=restart_costs[model].inplace_s,
             comms_fraction=fraction_for_category(model),
+            interference_fraction=interference_fraction_for_category(model),
         ))
     return jobs
 

@@ -62,6 +62,14 @@ log = logging.getLogger(__name__)
 # tail; this bounds the hot queryable window).
 AUDIT_RING_SIZE = 256
 
+# Bound on the per-record queue snapshot (oldest-submitted first). A
+# 10k-job pool was minting 10k row dicts per pass — 2.5M retained
+# across the ring, whose allocation churn landed gen-2 GC pauses
+# inside later decide windows (the fractional 10k p95 spike) — for a
+# debugging surface nobody reads past the first page. Never a silent
+# cap: a truncated record carries the full count in `queue_total`.
+AUDIT_QUEUE_MAX = 512
+
 # Reference default is 30 s (scheduler.go:212); under two-tier resize
 # pricing the r6 sweep pick is 15 s (cheap in-place resizes reward a
 # scheduler that acts more often — config.py), so the shipped value
@@ -136,6 +144,7 @@ class Scheduler:
         scale_out_hysteresis: float = DEFAULT_SCALE_OUT_HYSTERESIS,
         resize_cooldown_seconds: float = DEFAULT_RESIZE_COOLDOWN_SECONDS,
         defrag_cross_host_threshold: int = 0,
+        fractional_sharing: Optional[bool] = None,
         tracer: Optional[obs_tracer.Tracer] = None,
         actuation_workers: Optional[int] = None,
         actuation_parallel: Optional[bool] = None,
@@ -175,6 +184,21 @@ class Scheduler:
         # re-binding against the family's resharding cost over this
         # window; per-category cost memo below.
         self.migration_payback_seconds = config.MIGRATION_PAYBACK_SECONDS
+        # Fractional sub-host sharing (doc/fractional-sharing.md): on,
+        # FRACTIONAL-class jobs share host blocks (co-tenancy priced by
+        # interference weights below); off — the whole-host-minimum A/B
+        # baseline — every grant's capacity cost AND placement request
+        # round up to whole host blocks (_footprint), so sub-host jobs
+        # occupy exclusive hosts and the stranded chips are measurable.
+        self.fractional_sharing = (config.FRACTIONAL_SHARING
+                                   if fractional_sharing is None
+                                   else bool(fractional_sharing))
+        # name -> resolved-fractional memo (class is spec-static) and
+        # the last placed pass's fleet fractional stats (perf record /
+        # `voda top`).
+        self._fractional_class: Dict[str, bool] = {}
+        self._interference_weight: Dict[str, int] = {}
+        self._last_fractional_stats: Dict[str, int] = {}
         self._comms_weight: Dict[str, int] = {}
         self._last_contiguity_cost = 0
         self._last_comms_score = 0
@@ -439,6 +463,16 @@ class Scheduler:
         registry.gauge("voda_scheduler_allocated_chips", "Chips allocated",
                        fn=lambda: float(sum(self.job_num_chips.values())),
                        const_labels=pool_l)
+        # Fractional sub-host sharing (doc/fractional-sharing.md): how
+        # many ready jobs resolve to the fractional resource class on
+        # this pool's topology — the long tail sharing exists for.
+        registry.gauge("voda_scheduler_fractional_jobs",
+                       "Ready jobs whose resolved resource class is "
+                       "fractional (sub-host static chip-partition)",
+                       fn=lambda: float(sum(
+                           1 for name in list(self.ready_jobs)
+                           if self._is_fractional(name))),
+                       const_labels=pool_l)
 
     def _start_ticker(self) -> None:
         def tick() -> None:
@@ -588,6 +622,12 @@ class Scheduler:
             # lock release and the drain would see the chips as free.
             self._pending_stops.append((name, chips))
             self._stops_in_flight[name] = chips
+            # The next pass must release the dead slots in the
+            # placement manager even if the freed chips change no
+            # allocation — otherwise the grow gates (and co-tenancy
+            # stats) read the stale occupancy until something else
+            # moves (doc/fractional-sharing.md).
+            self._placement_dirty = True
         self._bump_state_version()
         return ["job_deleted"]
 
@@ -660,7 +700,11 @@ class Scheduler:
         self.store.update_job(job)
         self.done_jobs[job.name] = job
         self.ready_jobs.pop(job.name, None)
-        self.job_num_chips.release(job.name)
+        if self.job_num_chips.release(job.name) > 0:
+            # Even when the freed chips change no allocation, the next
+            # pass must release the dead slots in the placement manager
+            # (see _delete_job_locked).
+            self._placement_dirty = True
 
     # ---- host churn (reference: addNode/updateNode/deleteNode :689-747) --
 
@@ -940,16 +984,23 @@ class Scheduler:
                 with prof.phase("allocate"):
                     new = self.allocator.allocate(AllocationRequest(
                         scheduler_id=self.pool_id,
+                        # Reserved (draining) chips come off the budget
+                        # at their physical FOOTPRINT — whole hosts
+                        # under the sharing-off baseline.
                         num_chips=max(0, self.total_chips
-                                      - sum(reserved.values())),
+                                      - sum(self._footprint(v)
+                                            for v in reserved.values())),
                         algorithm=self.algorithm,
                         ready_jobs=jobs,
                         # Slice-shape feasibility: with a modeled torus,
                         # grants are rounded to counts that admit a
-                        # contiguous sub-slice (SURVEY.md §7).
+                        # contiguous sub-slice (SURVEY.md §7); the
+                        # fractional resource class rounds within a
+                        # host block (doc/fractional-sharing.md).
                         topology=(self.placement_manager.topology
                                   if self.placement_manager is not None
                                   else None),
+                        fractional_sharing=self.fractional_sharing,
                     ))
             except Exception:
                 log.exception("allocation failed; retrying after rate limit")
@@ -994,12 +1045,19 @@ class Scheduler:
             placed = False
             if ((changed or self._placement_dirty)
                     and self.placement_manager is not None):
-                requests = {j: n for j, n in self.job_num_chips.items()
+                # Placement requests are physical FOOTPRINTS: the grant
+                # itself under fractional sharing, whole host blocks
+                # under the sharing-off baseline — which is what makes
+                # a 2-chip job's exclusive host real in the slot
+                # accounting (and its stranded chips measurable).
+                requests = {j: self._footprint(n)
+                            for j, n in self.job_num_chips.items()
                             if n > 0}
                 # Draining deletions keep their host slots until the
                 # backend released them (phantom same-size requests:
                 # _release_slots leaves an unchanged request alone).
-                requests.update(reserved)
+                requests.update({j: self._footprint(n)
+                                 for j, n in reserved.items()})
                 with prof.phase("comms"):
                     # Per-job comms weights for the bandwidth-aware
                     # objective (memoized; a steady-state pass costs
@@ -1017,6 +1075,8 @@ class Scheduler:
                     self._last_contiguity_cost = \
                         decision.total_contiguity_cost
                     self._last_comms_score = decision.total_comms_score
+                    self._last_fractional_stats = \
+                        self.placement_manager.fractional_fleet_stats()
                     placements = decision.placements
                     placed = True
                     self._placement_dirty = False
@@ -1194,18 +1254,86 @@ class Scheduler:
             bins[index] += cost
         return max(bins)
 
+    def _is_fractional(self, name: str) -> bool:
+        """Whether `name`'s resolved resource class is fractional on
+        this pool (common/job.py resolve_resource_class). Memoized —
+        the class is spec-static. False without a modeled topology (no
+        host-block notion to be fractional against)."""
+        pm = self.placement_manager
+        if pm is None or pm.topology is None:
+            return False
+        got = self._fractional_class.get(name)
+        if got is None:
+            from vodascheduler_tpu.common.job import (
+                RESOURCE_CLASS_FRACTIONAL,
+                resolve_resource_class,
+            )
+            job = self.ready_jobs.get(name)
+            if job is None:
+                return False  # unknown here; don't cache a guess
+            got = self._fractional_class[name] = (
+                resolve_resource_class(
+                    getattr(job.spec, "resource_class", "auto"),
+                    job.config.max_num_chips,
+                    pm.topology.chips_per_host)
+                == RESOURCE_CLASS_FRACTIONAL)
+        return got
+
+    def _footprint(self, n: int) -> int:
+        """Chips a grant of n occupies physically: n itself under
+        fractional sharing; whole host blocks under the sharing-off
+        baseline (doc/fractional-sharing.md "The whole-host
+        baseline")."""
+        pm = self.placement_manager
+        if (self.fractional_sharing or n <= 0 or pm is None
+                or pm.topology is None):
+            return max(0, n)
+        return pm.topology.host_footprint(n)
+
     def _refresh_comms_weights(self, requests: ScheduleResult) -> None:
         """Install this pass's per-job comms weights on the placement
         manager (placement/comms.py): category-derived, memoized by job
         name so a steady-state pass pays one dict probe per job and a
         lookup only for jobs it has never seen. No-op when placement is
         absent or the comms objective is disabled
-        (VODA_PLACEMENT_COMMS=0 — the count-only reference path)."""
+        (VODA_PLACEMENT_COMMS=0 — the count-only reference path).
+
+        Also installs the fractional plane's interference weights
+        (doc/fractional-sharing.md): FRACTIONAL-class jobs get their
+        category's co-tenant interference weight so _pick_host prices
+        co-tenancy; whole-host jobs never carry one. Skipped entirely
+        with sharing off — exclusive hosts have no co-tenants to
+        price."""
         pm = self.placement_manager
-        if pm is None or not pm.comms_enabled:
+        if pm is None:
             return
         from vodascheduler_tpu.placement import comms as comms_mod
 
+        if self.fractional_sharing and pm.topology is not None:
+            icache = self._interference_weight
+            iweights: Dict[str, int] = {}
+            for job in requests:
+                w = icache.get(job)
+                if w is None:
+                    if not self._is_fractional(job):
+                        w = 0
+                    else:
+                        from vodascheduler_tpu.common.job import category_of
+                        w = comms_mod.interference_weight_for_category(
+                            category_of(job))
+                    icache[job] = w
+                if w:
+                    iweights[job] = w
+            if len(icache) > 2 * len(requests) + 64:
+                keep = set(requests)
+                self._interference_weight = {
+                    k: v for k, v in icache.items() if k in keep}
+                self._fractional_class = {
+                    k: v for k, v in self._fractional_class.items()
+                    if k in keep}
+            pm.set_interference_weights(iweights)
+        if not pm.comms_enabled:
+            return
         cache = self._comms_weight
         weights: Dict[str, int] = {}
         ready = self.ready_jobs
@@ -1414,6 +1542,15 @@ class Scheduler:
             # which way it goes is an audited decision either way.
             if self._grow_fits_current_hosts(job, n_new):
                 self._add_reason(job, "hysteresis_bypassed_grow_fits_host")
+            elif self._fractional_grow_fits(job, n_new):
+                # The PR 2 prefer_own idiom at chip granularity
+                # (doc/fractional-sharing.md): a sub-host tenant growing
+                # WITHIN its current partition's host block never adds a
+                # host — the resize is a cheap intra-host repartition,
+                # so the restart-amortization premise behind hysteresis
+                # doesn't hold even on backends without a Tier-A
+                # in-place path.
+                self._add_reason(job, "hysteresis_bypassed_fractional_fit")
             else:
                 new[job] = n_old
                 self._add_reason(job, "hysteresis_suppressed")
@@ -1450,6 +1587,32 @@ class Scheduler:
                   if hs.num_slots > 0 and hs.host in hosts)
         free = max(0, hosts[next(iter(occupied))].free_slots)
         return 0 < n_new <= own + free
+
+    def _fractional_grow_fits(self, job: str, n_new: int) -> bool:
+        """Whether a FRACTIONAL-class job's grow to n_new stays a
+        sub-host partition of the ONE host it already occupies — own
+        slots + that host's free chips cover the target. Unlike
+        _grow_fits_current_hosts this needs no backend in-place
+        support: the grow never changes the host set, so it can't be
+        the foreign-host cold restart hysteresis exists to suppress.
+        Sharing-off mode never takes it (exclusive hosts make
+        _grow_fits_current_hosts the honest gate)."""
+        if (not self.fractional_sharing or self.placement_manager is None
+                or not self._is_fractional(job)):
+            return False
+        placement = self.placement_manager.job_placements.get(job)
+        if placement is None:
+            return False
+        hosts = self.placement_manager.host_states
+        occupied = {hs.host for hs in placement.host_slots
+                    if hs.num_slots > 0 and hs.host in hosts}
+        if len(occupied) != 1:
+            return False
+        host = hosts[next(iter(occupied))]
+        own = sum(hs.num_slots for hs in placement.host_slots
+                  if hs.num_slots > 0 and hs.host in hosts)
+        return 0 < n_new <= min(host.total_slots,
+                                own + max(0, host.free_slots))
 
     def _schedule_retry(self) -> None:
         """Reference: TriggerReschedAtTime after allocator failure
@@ -1687,11 +1850,12 @@ class Scheduler:
         chip count changed or about which a decision was recorded."""
         with self._lock:
             self._audit_seq += 1
+            ready = sorted(self.ready_jobs.values(),
+                           key=lambda j: j.submit_time)
             queue = [{"name": j.name, "status": j.status.value,
                       "priority": j.priority,
                       "chips_before": old.get(j.name, 0)}
-                     for j in sorted(self.ready_jobs.values(),
-                                     key=lambda j: j.submit_time)]
+                     for j in ready[:AUDIT_QUEUE_MAX]]
             deltas = []
             for job in sorted(set(old) | set(self.job_num_chips)
                               | set(self._pass_reasons)):
@@ -1722,6 +1886,15 @@ class Scheduler:
                         delta["comms"] = {"weight": stats[0],
                                           "contiguity": stats[1],
                                           "score": stats[2]}
+                    # Fractional delta block (doc/fractional-sharing.md,
+                    # closed keys validated by obs/audit.py): partition
+                    # size, the host(s) it partitions, co-tenants, and
+                    # the current interference price. Only for placed
+                    # fractional tenants — whole-host jobs emit the
+                    # classic record shape.
+                    frac = self.placement_manager.job_fractional_stats(job)
+                    if frac is not None:
+                        delta["fractional"] = frac
                 deltas.append(delta)
             rec = {
                 "kind": "resched_audit",
@@ -1734,6 +1907,7 @@ class Scheduler:
                 "algorithm": self.algorithm,
                 "total_chips": self.total_chips,
                 "queue": queue,
+                "queue_total": len(ready),
                 "deltas": deltas,
                 "duration_ms": round(duration_s * 1000.0, 3),
                 "outcome": outcome,
@@ -1794,6 +1968,11 @@ class Scheduler:
                     "contiguity_cost": self._last_contiguity_cost,
                     "comms_score": self._last_comms_score,
                 }
+                if self._last_fractional_stats:
+                    # Fractional-sharing totals (doc/fractional-
+                    # sharing.md; `voda top` renders the line).
+                    rec["placement"]["fractional"] = dict(
+                        self._last_fractional_stats)
             self.profile_ring.append(rec)
         for name, stats in phases.items():
             self.h_phase_seconds.observe(stats["wall_ms"] / 1000.0,

@@ -233,11 +233,19 @@ class AdmissionService:
 
         # Validate every spec before touching the store (atomicity: one
         # bad spec must leave zero residue).
+        from vodascheduler_tpu.common.job import RESOURCE_CLASSES
         errors: Dict[int, str] = {}
         for i, spec in enumerate(specs):
             if self.valid_pools is not None and spec.pool not in self.valid_pools:
                 errors[i] = (f"unknown pool {spec.pool!r}; configured "
                              f"pools: {sorted(self.valid_pools)}")
+            elif spec.resource_class not in RESOURCE_CLASSES:
+                # A typo'd class would silently resolve as AUTO
+                # downstream (doc/fractional-sharing.md) — reject it
+                # here where the submitter can see it.
+                errors[i] = (f"unknown resource_class "
+                             f"{spec.resource_class!r}; valid: "
+                             f"{list(RESOURCE_CLASSES)}")
         if errors:
             self._abort_routes(pending_routes)
             return [{"name": s.name,
