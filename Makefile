@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-selftest lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-crash modelcheck-selftest journal-fsck lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -64,9 +64,28 @@ modelcheck-fleet:
 	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
 		--profile fleet
 
+# Durability (crash) profile: the bounded world journaling to an
+# in-memory WAL, with crash-at-any-action-prefix, torn mid-append
+# kills (crash:K), and a standby fence takeover — every recovery
+# re-checked against the full invariant catalog plus the three
+# durability invariants (crash_recovery_divergence,
+# recovery_unjournaled_grant, stale_epoch_write). Fails under 2,000
+# states like the bounded profile (doc/durability.md).
+modelcheck-crash:
+	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
+		--profile crash
+
+# Offline write-ahead-journal fsck selftest: build a synthetic journal,
+# prove a torn tail is dropped and mid-file corruption fails loudly
+# (doc/durability.md). `voda fsck <path>` runs the same check on a
+# real journal file.
+journal-fsck:
+	$(PY) -m vodascheduler_tpu.durability.journal --selftest
+
 # Prove the checker has teeth: every seeded-bug scheduler variant must
 # be caught AND its counterexample must replay deterministically
-# (including the fleet router's books-on-A-starts-on-B bug).
+# (including the fleet router's books-on-A-starts-on-B bug and the
+# three seeded durability/journaling bugs).
 modelcheck-selftest:
 	JAX_PLATFORMS=cpu $(PY) -m vodascheduler_tpu.analysis.modelcheck \
 		--selftest

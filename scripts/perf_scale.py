@@ -81,7 +81,7 @@ GATE_PHASE_FLOOR_MS = 1.0
 # silent) above this host count.
 DEFRAG_PYTHON_HOST_LIMIT = 300
 
-SCHEMA = 6  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
+SCHEMA = 7  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # suite grew the top-level "ingestion" section (bulk/single admission,
 # storm-to-quiescent, snapshot-cache reads); v4: curves grew the
 # "placement_scoring" column (the bandwidth-aware objective's fleet
@@ -93,7 +93,13 @@ SCHEMA = 6  # v2: mean/max grew p50/p95; v3: aggregates grew p99 and the
 # curves re-measured on a TOPOLOGY-MODELED pool with a fractional-mix
 # queue (sub-host resource classes, interference weights, feasibility
 # rounding all live — doc/fractional-sharing.md), so the PR 8 <50 ms
-# pin holds with fractional jobs in the vector.
+# pin holds with fractional jobs in the vector; v7: the top-level
+# "recovery" section (doc/durability.md) — the same decide curves
+# re-measured with the write-ahead journal ON (a real file journal:
+# every transition/booking/placement append on the decide path is
+# paid), journal growth per pass, and the cold crash-recovery time
+# (journal replay + backend reconcile) at each N, so journaling can
+# never quietly eat the decide budget and recovery stays O(live jobs).
 
 # Fleet points measured by default: the gate-bounded small fleet and
 # the 100k-job headline (ROADMAP "next order of magnitude").
@@ -372,6 +378,92 @@ def run_point(n_jobs: int, passes: int = DEFAULT_PASSES,
         gc.unfreeze()
     sched.stop()
     return curve
+
+
+def run_recovery_point(n_jobs: int, passes: int = DEFAULT_PASSES,
+                       seed: int = DEFAULT_SEED) -> Dict[str, object]:
+    """Measure the durability plane at one N (schema 7,
+    doc/durability.md): the decide curve with a REAL file journal wired
+    (every write-ahead append on the decide path is paid — the overhead
+    the <50 ms pin must absorb), journal growth per churn pass, and the
+    cold recovery: drop the scheduler, reopen the journal at the next
+    fencing epoch, rebuild + reconcile, and time it."""
+    import tempfile
+
+    from vodascheduler_tpu.durability.journal import Journal
+    from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.scheduler import Scheduler
+
+    clock, store, backend, sched, admission, rng = build_world(
+        n_jobs, seed)
+    tmp = tempfile.TemporaryDirectory(prefix="voda-perf-journal-")
+    journal = Journal(path=os.path.join(tmp.name, "perf-pool.wal"))
+    # Attach post-construction: the fill below journals every
+    # accept/booking exactly like a journaled-from-birth scheduler.
+    sched.journal = journal
+    sched.job_num_chips.journal = journal
+
+    alive: List[str] = []
+    for i in range(n_jobs):
+        alive.append(admission.create_training_job(_make_spec(i, rng)))
+    clock.advance(2 * DEFAULT_RATE_LIMIT + 2.0)
+    warmup_seq = (sched.profile_records(1) or [{}])[-1].get("seq", 0)
+    bytes_after_fill = journal.size_bytes()
+
+    import gc
+    gc.collect()
+    gc.freeze()
+    try:
+        next_id = n_jobs
+        appends_before = journal._appends
+        for _ in range(passes):
+            victim = alive.pop(rng.randrange(len(alive)))
+            admission.delete_training_job(victim)
+            alive.append(admission.create_training_job(
+                _make_spec(next_id, rng)))
+            next_id += 1
+            clock.advance(DEFAULT_RATE_LIMIT + 2.0)
+        samples = [r for r in sched.profile_records(0)
+                   if r["seq"] > warmup_seq]
+        if not samples:  # pragma: no cover - harness bug guard
+            raise RuntimeError(f"no journaled passes at N={n_jobs}")
+        appends_per_pass = (journal._appends - appends_before) / max(
+            1, len(samples))
+
+        # The crash: drop the scheduler, reopen the journal at the next
+        # epoch, recover on the same store/backend, time it.
+        sched.stop()
+        journal.close()
+        t0 = time.monotonic()
+        journal2 = Journal(path=os.path.join(tmp.name, "perf-pool.wal"),
+                           epoch=journal.epoch + 1)
+        pm2 = PlacementManager("perf-pool")
+        sched2 = Scheduler("perf-pool", backend, store, sched.allocator,
+                           clock, bus=sched.bus, placement_manager=pm2,
+                           algorithm="ElasticTiresias",
+                           rate_limit_seconds=DEFAULT_RATE_LIMIT,
+                           journal=journal2, resume=True,
+                           tracer=sched.tracer)
+        recovery_seconds = time.monotonic() - t0
+        report = sched2._last_recovery_report or {}
+        point = {
+            "n_jobs": n_jobs,
+            "passes_measured": len(samples),
+            "decide_wall_ms": _agg([r["decide_ms"] for r in samples]),
+            "journal_bytes_after_fill": bytes_after_fill,
+            "journal_bytes": journal.size_bytes(),
+            "journal_appends_per_pass": round(appends_per_pass, 1),
+            "recovery_seconds": round(recovery_seconds, 3),
+            "recovery_records_replayed": report.get("records", 0),
+            "recovery_divergences": len(report.get("divergences", ())),
+            "recovered_jobs": report.get("jobs", 0),
+        }
+        sched2.stop()
+        journal2.close()
+    finally:
+        gc.unfreeze()
+        tmp.cleanup()
+    return point
 
 
 def run_ingestion_point(n_jobs: int, seed: int = DEFAULT_SEED,
@@ -742,6 +834,19 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
                   f"({time.monotonic() - t0:.1f}s to measure)",
                   file=sys.stderr)
         fractional.append(curve)
+    recovery = []
+    for n in ns:
+        t0 = time.monotonic()
+        point = run_recovery_point(n, passes=passes, seed=seed)
+        if verbose:
+            print(f"perf_scale: N={n} (journaled): decide "
+                  f"{point['decide_wall_ms']['mean']}ms mean, p95 "
+                  f"{point['decide_wall_ms']['p95']}ms; cold recovery "
+                  f"{point['recovery_seconds']}s over "
+                  f"{point['recovery_records_replayed']} record(s) "
+                  f"({time.monotonic() - t0:.1f}s to measure)",
+                  file=sys.stderr)
+        recovery.append(point)
     fleet = []
     for n in (fleet_ns or ()):
         t0 = time.monotonic()
@@ -775,6 +880,7 @@ def run_suite(ns=DEFAULT_NS, passes: int = DEFAULT_PASSES,
         "curves": curves,
         "ingestion": ingestion,
         "fractional": fractional,
+        "recovery": recovery,
         "fleet": fleet,
     }
 
@@ -870,6 +976,55 @@ def compare(baseline: dict, fresh: dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"fractional N={n}: decide p95 "
                 f"{fc['decide_wall_ms']['p95']:.3f}ms breaches the "
                 f"absolute 50 ms pin with fractional jobs in the mix")
+
+    # Recovery columns (schema 7, doc/durability.md): the journaled
+    # decide curve carries the same relative bounds as the classic one
+    # PLUS the absolute <50 ms p95 pin at the 10k point (journaling on
+    # must not breach the PR 8 decide target); cold recovery time is
+    # bounded relatively with a seconds-scale slack (it is an O(live
+    # jobs) replay, not a per-pass latency). Pre-v7 baselines skip.
+    base_rec = {c["n_jobs"]: c for c in baseline.get("recovery", [])}
+    fresh_rec = {c["n_jobs"]: c for c in fresh.get("recovery", [])}
+    for n in sorted(fresh_rec):
+        fc, bc = fresh_rec[n], base_rec.get(n)
+        if bc is None:
+            problems.append(f"recovery N={n}: no baseline point "
+                            f"(regenerate with make perf-baseline)")
+            continue
+
+        def rcheck(label: str, fresh_ms: float, base_ms: float) -> None:
+            bound = base_ms * tolerance + slack_ms
+            verdict = "ok" if fresh_ms <= bound else "REGRESSED"
+            print(f"  R={n:>6} {label:<18} base={base_ms:>10.3f}ms "
+                  f"fresh={fresh_ms:>10.3f}ms bound={bound:>10.3f}ms "
+                  f"{verdict}")
+            if fresh_ms > bound:
+                problems.append(
+                    f"recovery N={n}: {label} regressed: "
+                    f"{fresh_ms:.3f}ms vs baseline {base_ms:.3f}ms "
+                    f"(bound {bound:.3f}ms)")
+
+        rcheck("journaled_decide", fc["decide_wall_ms"]["mean"],
+               bc["decide_wall_ms"]["mean"])
+        rcheck("journaled_decide_p95", fc["decide_wall_ms"]["p95"],
+               bc["decide_wall_ms"]["p95"])
+        if n >= 10000 and fc["decide_wall_ms"]["p95"] >= 50.0:
+            problems.append(
+                f"recovery N={n}: decide p95 "
+                f"{fc['decide_wall_ms']['p95']:.3f}ms breaches the "
+                f"absolute 50 ms pin with journaling on")
+        rec_slack_s = max(1.0, slack_ms / 25.0)
+        base_s = bc["recovery_seconds"]
+        fresh_s = fc["recovery_seconds"]
+        bound_s = base_s * tolerance + rec_slack_s
+        verdict = "ok" if fresh_s <= bound_s else "REGRESSED"
+        print(f"  R={n:>6} {'cold_recovery':<18} base={base_s:>9.3f}s "
+              f"fresh={fresh_s:>9.3f}s bound={bound_s:>9.3f}s  {verdict}")
+        if fresh_s > bound_s:
+            problems.append(
+                f"recovery N={n}: cold recovery regressed: "
+                f"{fresh_s:.3f}s vs baseline {base_s:.3f}s "
+                f"(bound {bound_s:.3f}s)")
 
     # Ingestion columns (schema 3): admission p99 bounds use a tighter
     # slack (sub-ms costs would vanish inside the decide slack);

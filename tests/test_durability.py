@@ -1,0 +1,698 @@
+"""The durability plane (doc/durability.md): journal framing and
+byte-level fault injection, snapshot + compaction (tombstones survive),
+scheduler crash-recovery round trips, lease-based leader handover with
+fencing epochs, and the kill -9 e2e."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import FakeClusterBackend, WorkloadProfile
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
+from vodascheduler_tpu.common.lifecycle import BookingLedger
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.durability.journal import (
+    FencedOut,
+    FileStorage,
+    Journal,
+    JournalCorrupt,
+    MemoryStorage,
+    fsck,
+    frame,
+    parse_frames,
+)
+from vodascheduler_tpu.durability.leader import (
+    FileLease,
+    LeaseHeld,
+    MemoryLease,
+)
+from vodascheduler_tpu.durability.recover import read_state
+from vodascheduler_tpu.obs import audit as obs_audit
+from vodascheduler_tpu.obs import tracer as obs_tracer
+from vodascheduler_tpu.placement import PlacementManager
+from vodascheduler_tpu.scheduler import Scheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- world helpers ---------------------------------------------------------
+
+
+def make_world(journal=None, hosts=2, chips=4, resume=False,
+               clock=None, store=None, backend=None, bus=None,
+               tracer=None):
+    clock = clock or VirtualClock(start=1000.0)
+    tracer = tracer or obs_tracer.Tracer(clock=clock, ring_size=256)
+    store = store if store is not None else JobStore()
+    bus = bus or EventBus()
+    if backend is None:
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=2.0)
+        for i in range(hosts):
+            backend.add_host(f"host-{i}", chips, announce=False)
+    pm = PlacementManager("p")
+    sched = Scheduler("p", backend, store, ResourceAllocator(store),
+                      clock, bus=bus, placement_manager=pm,
+                      rate_limit_seconds=1.0, profile_cpu=False,
+                      tracer=tracer, journal=journal, resume=resume)
+    return clock, store, backend, bus, tracer, sched
+
+
+def submit(sched, store, backend, clock, name, min_chips=1, max_chips=4,
+           epochs=2):
+    spec = JobSpec(name=name, pool="p",
+                   config=JobConfig(min_num_chips=min_chips,
+                                    max_num_chips=max_chips,
+                                    epochs=epochs))
+    backend.register_profile(name,
+                             WorkloadProfile(epoch_seconds_at_1=8.0))
+    store.insert_job(TrainingJob.from_spec(spec, submit_time=clock.now()))
+    sched.create_training_job(name)
+
+
+# ---- framing + byte-level fault injection ----------------------------------
+
+
+class TestFraming:
+    def test_round_trip(self):
+        j = Journal(storage=MemoryStorage())
+        for i in range(5):
+            j.append("jbook", {"op": "commit", "job": f"j{i}", "chips": i})
+        recs = j.records()
+        assert [r["job"] for r in recs] == [f"j{i}" for i in range(5)]
+        assert [r["seq"] for r in recs] == [1, 2, 3, 4, 5]
+        assert all(r["epoch"] == 1 for r in recs)
+
+    def test_unknown_kind_rejected_at_write(self):
+        j = Journal(storage=MemoryStorage())
+        with pytest.raises(ValueError, match="JOURNAL_KINDS"):
+            j.append("not_a_kind", {})
+
+    def test_torn_tail_dropped(self):
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        for i in range(3):
+            j.append("jbook", {"op": "commit", "job": f"j{i}", "chips": 1})
+        # Truncate mid-final-record — the crash artifact.
+        s.data = s.data[: len(s.data) - 9]
+        records, torn, corrupt = parse_frames(bytes(s.data))
+        assert len(records) == 2 and torn == 1 and corrupt is None
+
+    def test_duplicated_tail_record_deduplicated(self):
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        j.append("jbook", {"op": "commit", "job": "a", "chips": 2})
+        j.append("jbook", {"op": "commit", "job": "b", "chips": 3})
+        # Duplicate the last frame wholesale (a retried write).
+        lines = bytes(s.data).split(b"\n")
+        s.data.extend(lines[-2] + b"\n")
+        state = read_state(Journal(storage=s))
+        assert state.duplicate_records == 1
+        assert state.booked == {"a": 2, "b": 3}
+
+    def test_checksum_flip_on_tail_is_torn(self):
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        j.append("jbook", {"op": "commit", "job": "a", "chips": 2})
+        j.append("jbook", {"op": "commit", "job": "b", "chips": 3})
+        # Flip a payload byte of the FINAL record: checksum mismatch on
+        # the tail == torn tail, dropped — a consistent prefix remains.
+        s.data[-5] ^= 0x01
+        state = read_state(Journal(storage=s))
+        assert state.booked == {"a": 2}
+        assert state.torn_tail >= 1
+
+    def test_checksum_flip_mid_file_fails_loudly(self):
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        for i in range(4):
+            j.append("jbook", {"op": "commit", "job": f"j{i}", "chips": 1})
+        # Corrupt a payload byte of the FIRST record (valid records
+        # follow): never silently resynchronized.
+        first_nl = s.data.index(b"\n")
+        s.data[first_nl - 3] ^= 0x01
+        with pytest.raises(JournalCorrupt):
+            Journal(storage=s).records()
+
+    def test_reopen_trims_torn_tail_before_appending(self):
+        """A restarted writer must truncate the crash's half-written
+        frame, or its first append turns the torn tail into mid-file
+        corruption."""
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        j.append("jbook", {"op": "commit", "job": "a", "chips": 2})
+        j.append("jbook", {"op": "commit", "job": "b", "chips": 3})
+        s.data = s.data[: len(s.data) - 7]  # torn tail
+        j2 = Journal(storage=s, epoch=2)
+        assert j2.torn_trimmed == 1
+        j2.append("jbook", {"op": "commit", "job": "c", "chips": 1})
+        state = read_state(j2)
+        assert state.booked == {"a": 2, "c": 1}
+        assert state.torn_tail == 1  # surfaced, never silent
+
+    def test_file_fault_injection(self, tmp_path):
+        """The same byte-level faults on a REAL file journal, through
+        fsck (the `voda fsck` surface)."""
+        path = str(tmp_path / "pool.wal")
+        j = Journal(path=path)
+        for i in range(5):
+            j.append("jclock", {"job": f"j{i}", "at": float(i)})
+        j.close()
+        clean = fsck(path)
+        assert clean["records"] == 5 and not clean["problems"]
+        # Truncate mid-record.
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 6)
+        report = fsck(path)
+        assert report["records"] == 4
+        assert report["torn_tail_count"] == 1
+        assert not report["problems"]
+        # Flip a checksum hex digit mid-file: loud.
+        data = bytearray(open(path, "rb").read())
+        second_sp = data.index(b" ", data.index(b" ") + 1)
+        data[second_sp - 1] = ord("f") if data[second_sp - 1] != ord("f") \
+            else ord("e")
+        open(path, "wb").write(bytes(data))
+        bad = fsck(path)
+        assert any("corrupt" in p for p in bad["problems"])
+
+
+# ---- the write-ahead seam --------------------------------------------------
+
+
+class TestJournalingSeam:
+    def test_ledger_mutations_replay(self):
+        j = Journal(storage=MemoryStorage())
+        ledger = BookingLedger(journal=j)
+        ledger.commit("a", 4)
+        ledger.commit_pass({"a": 2, "b": 3})
+        ledger.release("b")
+        state = read_state(j)
+        assert state.booked == {"a": 2}
+        assert state.granted == {"a", "b"}
+
+    def test_commit_pass_is_delta_encoded(self):
+        j = Journal(storage=MemoryStorage())
+        ledger = BookingLedger(journal=j)
+        ledger.commit_pass({f"j{i}": 1 for i in range(100)})
+        before = j._appends
+        ledger.commit_pass({**{f"j{i}": 1 for i in range(99)}, "j99": 2})
+        assert j._appends == before + 1
+        rec = j.records()[-1]
+        assert rec["k"] == "jpass"
+        assert rec["set"] == {"j99": 2} and rec["del"] == []
+
+    def test_fenced_append_applies_nothing(self):
+        """Append-before-apply: a deposed writer's mutation must not
+        land in memory when its journal append is rejected."""
+        lease = MemoryLease()
+        j = Journal(storage=MemoryStorage(), epoch=lease.epoch,
+                    fence=lease.current_epoch)
+        ledger = BookingLedger(journal=j)
+        ledger.commit("a", 4)
+        lease.advance_epoch()
+        with pytest.raises(FencedOut):
+            ledger.commit("a", 2)
+        assert ledger.get("a") == 4  # unchanged
+        assert j.fenced
+        # transition() likewise: status survives the fenced append.
+        job = TrainingJob.from_spec(
+            JobSpec(name="t", pool="p",
+                    config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                     epochs=1)), submit_time=0.0)
+        from vodascheduler_tpu.common import lifecycle
+        with pytest.raises(FencedOut):
+            lifecycle.transition(job, JobStatus.WAITING, reason="accepted",
+                                 chips=0, journal=j)
+        assert job.status == JobStatus.SUBMITTED
+
+
+# ---- snapshot + compaction -------------------------------------------------
+
+
+class TestCompaction:
+    def test_compaction_preserves_state_and_bounds_replay(self):
+        j = Journal(storage=MemoryStorage())
+        for i in range(50):
+            j.append("jbook", {"op": "commit", "job": "a", "chips": i + 1})
+        before = read_state(j)
+        assert j.maybe_compact(force=True)
+        after = read_state(j)
+        assert after.booked == before.booked == {"a": 50}
+        assert after.granted == before.granted
+        # Replay is now O(live): one jsnap marker in the segment.
+        assert len(j.records()) == 1
+
+    def test_seq_resumes_from_snapshot_after_lost_jsnap(self):
+        """Crash in compaction's truncate window: snapshot written,
+        segment emptied, the jsnap marker lost. The reopened journal
+        must resume numbering PAST the snapshot's last_seq — restarting
+        at 1 would make replay's seq dedup silently drop every
+        post-restart record as a duplicate of the snapshot's range."""
+        s = MemoryStorage()
+        j = Journal(storage=s)
+        for i in range(10):
+            j.append("jbook", {"op": "commit", "job": "a", "chips": i + 1})
+        j.maybe_compact(force=True)
+        # Simulate the crash: drop the post-compaction segment (the
+        # jsnap append never made it) — the snapshot alone survives.
+        s.replace(b"")
+        j2 = Journal(storage=s, epoch=2)
+        assert j2._seq >= 10
+        j2.append("jbook", {"op": "commit", "job": "NEW", "chips": 3})
+        state = read_state(j2)
+        assert state.booked == {"a": 10, "NEW": 3}
+        assert state.duplicate_records == 0
+
+    def test_delete_survives_crash_recover_compact_crash_recover(self):
+        """The tombstone regression (doc/durability.md "Tombstones"):
+        a deleted job must stay retired across crash -> recover ->
+        compact -> crash -> recover — never resurrected."""
+        storage = MemoryStorage()
+        lease = MemoryLease()
+        jnl = Journal(storage=storage, epoch=lease.epoch,
+                      fence=lease.current_epoch)
+        clock, store, backend, bus, tracer, sched = make_world(journal=jnl)
+        submit(sched, store, backend, clock, "keep", epochs=1000)
+        submit(sched, store, backend, clock, "victim", epochs=1000)
+        clock.advance(5)
+        sched.delete_training_job("victim")
+        clock.advance(5)
+        assert sched.done_jobs["victim"].status == JobStatus.CANCELED
+
+        def crash_recover():
+            sched_prev = crash_recover.sched
+            sched_prev.stop()
+            epoch = lease.advance_epoch()
+            j2 = Journal(storage=storage, epoch=epoch,
+                         fence=lease.current_epoch, clock=clock)
+            _, _, _, _, _, s2 = make_world(
+                journal=j2, resume=True, clock=clock, store=store,
+                backend=backend, bus=bus, tracer=tracer)
+            crash_recover.sched = s2
+            return j2, s2
+
+        crash_recover.sched = sched
+        j2, s2 = crash_recover()
+        assert "victim" not in s2.ready_jobs
+        assert s2.done_jobs["victim"].status == JobStatus.CANCELED
+        assert j2.maybe_compact(force=True)
+        snap = j2.load_snapshot()
+        assert snap["retired"].get("victim") == "Canceled"
+        _, s3 = crash_recover()
+        assert "victim" not in s3.ready_jobs
+        assert s3.done_jobs["victim"].status == JobStatus.CANCELED
+        assert "keep" in s3.ready_jobs
+        assert s3.job_num_chips.get("victim", 0) == 0
+
+
+# ---- scheduler crash recovery ----------------------------------------------
+
+
+class TestCrashRecovery:
+    def _crashed_world(self):
+        storage = MemoryStorage()
+        lease = MemoryLease()
+        jnl = Journal(storage=storage, epoch=lease.epoch,
+                      fence=lease.current_epoch)
+        clock, store, backend, bus, tracer, sched = make_world(journal=jnl)
+        for name in ("j0", "j1"):
+            submit(sched, store, backend, clock, name)
+        clock.advance(5)
+        return storage, lease, clock, store, backend, bus, tracer, sched
+
+    def _recover(self, storage, lease, clock, store, backend, bus, tracer):
+        epoch = lease.advance_epoch()
+        j2 = Journal(storage=storage, epoch=epoch,
+                     fence=lease.current_epoch, clock=clock)
+        return make_world(journal=j2, resume=True, clock=clock,
+                          store=store, backend=backend, bus=bus,
+                          tracer=tracer)[-1]
+
+    def test_quiescent_recovery_is_exact(self):
+        (storage, lease, clock, store, backend, bus, tracer,
+         sched) = self._crashed_world()
+        from vodascheduler_tpu.durability.recover import logical_tables
+        pre = logical_tables(sched)
+        sched.stop()
+        s2 = self._recover(storage, lease, clock, store, backend, bus,
+                           tracer)
+        assert s2._recovered_tables == pre
+        report = s2._last_recovery_report
+        assert report["divergences"] == []
+        assert not obs_audit.validate_record(report)
+        assert s2.m_recovery_seconds.value() >= 0.0
+        # And the recovered world still finishes its jobs.
+        clock.advance(60)
+        assert all(j.status == JobStatus.COMPLETED
+                   for j in s2.done_jobs.values())
+
+    def test_deposed_leader_writes_rejected(self):
+        (storage, lease, clock, store, backend, bus, tracer,
+         sched) = self._crashed_world()
+        s2 = self._recover(storage, lease, clock, store, backend, bus,
+                           tracer)
+        with pytest.raises(FencedOut):
+            sched.job_num_chips.commit("j0", 1)
+        assert sched.journal.fenced
+        # User-facing mutations on the deposed scheduler fail LOUDLY
+        # (never ack-and-drop), and it stops itself.
+        with pytest.raises(FencedOut, match="deposed"):
+            sched.create_training_job("j0")
+        with pytest.raises(FencedOut, match="deposed"):
+            sched.delete_training_job("j0")
+        assert sched._stopped
+        # And replay never interleaves whatever a buggy writer landed.
+        state = read_state(s2.journal)
+        assert state.stale_records == 0
+
+    def test_backend_lost_job_reconciled_and_audited(self):
+        (storage, lease, clock, store, backend, bus, tracer,
+         sched) = self._crashed_world()
+        sched.stop()
+        # The backend lost j0 behind the crashed scheduler's back.
+        backend.stop_job("j0")
+        s2 = self._recover(storage, lease, clock, store, backend, bus,
+                           tracer)
+        report = s2._last_recovery_report
+        reasons = {(d["job"], d["reason"])
+                   for d in report["divergences"]}
+        assert ("j0", "backend_lost_job") in reasons
+        # The AS-REBUILT tables (before the inline corrective pass):
+        # j0 reconciled to WAITING with zero chips.
+        booked, ready, _, _ = s2._recovered_tables
+        assert dict(ready)["j0"] == "Waiting"
+        assert dict(booked)["j0"] == 0
+        # The corrective pass re-runs it to completion.
+        clock.advance(80)
+        assert s2.done_jobs["j0"].status == JobStatus.COMPLETED
+
+    def test_admitted_but_unaccepted_job_never_lost(self):
+        (storage, lease, clock, store, backend, bus, tracer,
+         sched) = self._crashed_world()
+        sched.stop()
+        # Admitted to the durable store, but the CREATE event died with
+        # the process: no journal trace.
+        spec = JobSpec(name="late", pool="p",
+                       config=JobConfig(min_num_chips=1, max_num_chips=2,
+                                        epochs=1))
+        backend.register_profile(
+            "late", WorkloadProfile(epoch_seconds_at_1=8.0))
+        store.insert_job(TrainingJob.from_spec(spec,
+                                               submit_time=clock.now()))
+        s2 = self._recover(storage, lease, clock, store, backend, bus,
+                           tracer)
+        assert "late" in s2.ready_jobs
+        reasons = {(d["job"], d["reason"])
+                   for d in s2._last_recovery_report["divergences"]}
+        assert ("late", "unjournaled_job") in reasons
+        clock.advance(60)
+        assert s2.done_jobs["late"].status == JobStatus.COMPLETED
+
+    def test_journal_stats_surface(self):
+        (storage, lease, clock, store, backend, bus, tracer,
+         sched) = self._crashed_world()
+        stats = sched.journal_stats()
+        assert stats["enabled"] and stats["records"] > 0
+        assert stats["epoch"] == 1 and stats["torn_tail_count"] == 0
+        sched.stop()
+        s2 = self._recover(storage, lease, clock, store, backend, bus,
+                           tracer)
+        stats2 = s2.journal_stats()
+        assert stats2["epoch"] == 2
+        assert stats2["last_recovery"]["divergences"] == []
+        # Journal-less schedulers answer honestly.
+        _, _, _, _, _, bare = make_world()
+        assert bare.journal_stats() == {"enabled": False}
+
+
+# ---- leadership ------------------------------------------------------------
+
+
+class TestLeadership:
+    def test_file_lease_protocol(self, tmp_path):
+        clock = VirtualClock(start=100.0)
+        a = FileLease(str(tmp_path / "l"), holder="a", ttl_seconds=10.0,
+                      clock=clock)
+        b = FileLease(str(tmp_path / "l"), holder="b", ttl_seconds=10.0,
+                      clock=clock)
+        assert a.try_acquire() == 1
+        with pytest.raises(LeaseHeld):
+            b.try_acquire()
+        assert a.renew()
+        # a stops renewing; the lease expires; b takes over at epoch 2.
+        clock.advance(11.0)
+        assert b.try_acquire() == 2
+        assert b.current_epoch() == 2
+        assert not a.renew()  # deposed — and the file is NOT rewritten
+        assert b.current_epoch() == 2
+        # Clean release expires immediately: no TTL wait for the next.
+        b.release()
+        assert a.try_acquire() == 3
+
+    def test_racing_takeovers_get_distinct_epochs(self, tmp_path):
+        """Two standbys racing an expired lease must never both win
+        with the SAME fencing epoch (the flock'd read-modify-write):
+        the loser either sees LeaseHeld or lands a HIGHER epoch — a
+        duplicate epoch would make both leaders pass every fence
+        check."""
+        import threading
+
+        clock = VirtualClock(start=100.0)
+        results = []
+        barrier = threading.Barrier(4)
+
+        def contender(name):
+            lease = FileLease(str(tmp_path / "l"), holder=name,
+                              ttl_seconds=10.0, clock=clock)
+            barrier.wait()
+            try:
+                results.append((name, lease.try_acquire()))
+            except LeaseHeld:
+                results.append((name, None))
+
+        threads = [threading.Thread(target=contender, args=(f"s{i}",),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        epochs = [e for _, e in results if e is not None]
+        assert epochs, results
+        assert len(set(epochs)) == len(epochs), \
+            f"duplicate fencing epochs handed out: {results}"
+
+    def test_leader_handover_e2e(self, tmp_path):
+        """The acceptance e2e: standby takes over within one lease TTL
+        of the leader going silent, recovers the journal, and the
+        deposed leader's post-fencing appends are rejected."""
+        clock = VirtualClock(start=1000.0)
+        ttl = 10.0
+        lease_a = FileLease(str(tmp_path / "lease"), holder="A",
+                            ttl_seconds=ttl, clock=clock)
+        lease_a.try_acquire()
+        path = str(tmp_path / "pool.wal")
+        jnl = Journal(path=path, epoch=lease_a.epoch,
+                      fence=lease_a.current_epoch, clock=clock)
+        lease_a.announce(jnl, op="acquire")
+        _, store, backend, bus, tracer, sched_a = make_world(
+            journal=jnl, clock=clock)
+        submit(sched_a, store, backend, clock, "j0", epochs=1000)
+        clock.advance(2)
+        assert sched_a.ready_jobs["j0"].status == JobStatus.RUNNING
+        died_at = clock.now()  # A goes silent (stops renewing)
+
+        lease_b = FileLease(str(tmp_path / "lease"), holder="B",
+                            ttl_seconds=ttl, clock=clock)
+        with pytest.raises(LeaseHeld):
+            lease_b.try_acquire()  # not expired yet
+        clock.advance(ttl + 0.5)
+        epoch = lease_b.try_acquire()
+        assert epoch == 2
+        assert clock.now() - died_at <= 2 * ttl  # within one TTL of expiry
+        jnl_b = Journal(path=path, epoch=epoch,
+                        fence=lease_b.current_epoch, clock=clock)
+        lease_b.announce(jnl_b, op="acquire")
+        _, _, _, _, _, sched_b = make_world(
+            journal=jnl_b, resume=True, clock=clock, store=store,
+            backend=backend, bus=bus, tracer=tracer)
+        assert sched_b.ready_jobs["j0"].status == JobStatus.RUNNING
+        assert sched_b._last_recovery_report["divergences"] == []
+        # The deposed leader's append is rejected at the write.
+        with pytest.raises(FencedOut):
+            sched_a.journal.append("jclock", {"job": "j0", "at": 0.0})
+        assert sched_a.journal.fenced
+        # ...and the journal's epochs never regress.
+        jnl_b.close()
+        report = fsck(path)
+        assert report["stale_epoch_count"] == 0
+        assert not report["problems"]
+        # Scheduling proceeds under B: the job keeps making progress.
+        before = backend.job_progress("j0")
+        clock.advance(60)
+        assert backend.job_progress("j0") > before
+        assert sched_b.ready_jobs["j0"].status == JobStatus.RUNNING
+
+
+# ---- perf artifact pins ----------------------------------------------------
+
+
+class TestPerfArtifactPins:
+    def _baseline(self):
+        with open(os.path.join(REPO, "doc", "perf_baseline.json")) as f:
+            return json.load(f)
+
+    def test_recovery_section_pinned(self):
+        base = self._baseline()
+        assert base["schema"] >= 7
+        points = {p["n_jobs"]: p for p in base["recovery"]}
+        assert 10000 in points
+        p10k = points[10000]
+        # The PR 8 decide target holds WITH journaling on.
+        assert p10k["decide_wall_ms"]["p95"] < 50.0
+        # Cold 10k recovery is pinned, sane, and divergence-free.
+        assert 0.0 < p10k["recovery_seconds"] < 30.0
+        assert p10k["recovery_divergences"] == 0
+        assert p10k["recovered_jobs"] == 10000
+        # Delta encoding holds: a steady-state churn pass appends a
+        # bounded handful of records, not O(fleet).
+        assert p10k["journal_appends_per_pass"] < 200
+
+
+# ---- kill -9 e2e -----------------------------------------------------------
+
+
+_CHILD = textwrap.dedent("""
+    import os, sys, random
+    sys.path.insert(0, {repo!r})
+    from vodascheduler_tpu.allocator import ResourceAllocator
+    from vodascheduler_tpu.cluster.fake import (FakeClusterBackend,
+                                                WorkloadProfile)
+    from vodascheduler_tpu.common.clock import VirtualClock
+    from vodascheduler_tpu.common.events import EventBus
+    from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
+    from vodascheduler_tpu.common.store import FileJobStore
+    from vodascheduler_tpu.durability.journal import Journal
+    from vodascheduler_tpu.obs import tracer as obs_tracer
+    from vodascheduler_tpu.placement import PlacementManager
+    from vodascheduler_tpu.scheduler import Scheduler
+
+    workdir = {workdir!r}
+    clock = VirtualClock(start=1000.0)
+    tracer = obs_tracer.Tracer(clock=clock, ring_size=64)
+    store = FileJobStore(os.path.join(workdir, "state.json"))
+    bus = EventBus()
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=2.0)
+    for i in range(4):
+        backend.add_host(f"host-{{i}}", 4, announce=False)
+    jnl = Journal(path=os.path.join(workdir, "pool.wal"), clock=clock)
+    sched = Scheduler("p", backend, store, ResourceAllocator(store),
+                      clock, bus=bus,
+                      placement_manager=PlacementManager("p"),
+                      rate_limit_seconds=1.0, profile_cpu=False,
+                      tracer=tracer, journal=jnl)
+    rng = random.Random(7)
+    i = 0
+    while True:  # event storm until killed
+        name = f"storm-{{i:04d}}"
+        spec = JobSpec(name=name, pool="p",
+                       config=JobConfig(min_num_chips=1,
+                                        max_num_chips=rng.choice((1, 2, 4)),
+                                        epochs=3))
+        backend.register_profile(
+            name, WorkloadProfile(epoch_seconds_at_1=8.0))
+        store.insert_job(TrainingJob.from_spec(spec,
+                                               submit_time=clock.now()))
+        sched.create_training_job(name)
+        if rng.random() < 0.3 and sched.ready_jobs:
+            sched.delete_training_job(
+                rng.choice(sorted(sched.ready_jobs)))
+        clock.advance(rng.choice((0.2, 1.5, 3.0)))
+        i += 1
+        if i == 5:
+            print("STORMING", flush=True)
+""")
+
+
+@pytest.mark.slow
+class TestKillNineE2E:
+    def test_kill9_mid_storm_recovers_committed_prefix(self, tmp_path):
+        """kill -9 an in-flight scheduler under an event storm; restart;
+        the recovered state must be exactly what the journal's committed
+        prefix + the (dead) backend's view dictate: every admitted
+        non-retired job present, nothing double-booked, nothing lost."""
+        workdir = str(tmp_path)
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             _CHILD.format(repo=REPO, workdir=workdir)],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert child.stdout.readline().strip() == "STORMING"
+        time.sleep(0.7)  # mid-flight, whatever it is doing
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+
+        from vodascheduler_tpu.common.store import FileJobStore
+        clock = VirtualClock(start=2000.0)
+        store = FileJobStore(os.path.join(workdir, "state.json"))
+        # The committed prefix, parsed INDEPENDENTLY of recovery.
+        expected = read_state(Journal(path=os.path.join(workdir,
+                                                        "pool.wal"),
+                                      clock=clock))
+        assert expected.records > 0
+        # Determinism: a second independent replay of the same bytes
+        # reads byte-identical state (before recovery appends anything).
+        again = read_state(Journal(path=os.path.join(workdir, "pool.wal"),
+                                   clock=clock))
+        assert (again.statuses, again.booked, again.retired,
+                again.last_seq) == (expected.statuses, expected.booked,
+                                    expected.retired, expected.last_seq)
+        jnl = Journal(path=os.path.join(workdir, "pool.wal"),
+                      epoch=expected.epoch + 1, clock=clock)
+        # A fresh backend: the fake cluster died with the process, so
+        # every journal-RUNNING job must reconcile to backend_lost.
+        _, _, backend, bus, tracer, sched = make_world(
+            journal=jnl, clock=clock, store=store, hosts=4)
+        from vodascheduler_tpu.durability.recover import recover_scheduler
+        report = recover_scheduler(sched)
+
+        # Byte-identical to the committed prefix (the AS-REBUILT tables,
+        # before the inline corrective pass re-grants anything): every
+        # journal-known, non-retired job is back, reconciled against the
+        # dead backend to WAITING/0; every retired job stays retired;
+        # every store-admitted job the journal never saw is re-accepted.
+        booked_t, ready_t, done_t, _ = sched._recovered_tables
+        booked, ready = dict(booked_t), dict(ready_t)
+        done = dict(done_t)
+        for name, status in expected.statuses.items():
+            assert name in ready, f"lost journaled job {name}"
+            assert ready[name] == "Waiting"
+            assert booked.get(name, 0) == 0
+        for name in expected.retired:
+            assert name not in ready
+            assert name in done
+        for job in store.list_jobs(pool="p"):
+            if job.name in expected.retired:
+                continue
+            assert job.name in ready, f"lost admitted job {job.name}"
+        # No double booking, trivially: the dead backend freed all.
+        assert sum(booked.values()) == 0
+        lost = {d["job"] for d in report["divergences"]
+                if d["reason"] == "backend_lost_job"}
+        # Every job the journal had RUNNING — or booked > 0 (the kill
+        # can land mid-pass, between the booking commit and the start
+        # transition) — reconciles as backend_lost against the dead
+        # backend; nothing else does.
+        expected_lost = {n for n, s in expected.statuses.items()
+                         if s == "Running"}
+        expected_lost |= {n for n, b in expected.booked.items() if b > 0}
+        assert lost == expected_lost - set(expected.retired)

@@ -168,6 +168,74 @@ class TestFaultInjection:
             backend.inject_fault("gremlins")
 
 
+def small_crash_config(**overrides):
+    """A reduced durability world for the fast tier (the full crash
+    profile runs in CI via `make modelcheck-crash` and in the slow
+    tier below): 2 jobs, 1 quiescent + 1 torn crash point, fence on."""
+    from vodascheduler_tpu.analysis.modelcheck import crash_config
+    import dataclasses
+
+    base = dataclasses.replace(
+        crash_config(),
+        jobs=(JobShape("j0", min_chips=1, max_chips=4, epochs=2),
+              JobShape("j1", min_chips=2, max_chips=4, epochs=1)),
+        depth=7, max_states=250, faults=("start",), churn_hosts=(),
+        crash_points=(2,))
+    return dataclasses.replace(base, **overrides)
+
+
+class TestCrashProfile:
+    """The durability plane's proof layer (doc/durability.md): crash at
+    any action prefix + recover satisfies every invariant, and the
+    three seeded journaling bugs are each caught with a replayable
+    counterexample."""
+
+    def test_small_crash_world_holds_invariants(self):
+        result = explore(small_crash_config())
+        assert result.counterexample is None, json.dumps(
+            result.counterexample, indent=1)
+        assert result.states > 50
+
+    def test_crash_exploration_is_deterministic(self):
+        r1 = explore(small_crash_config())
+        r2 = explore(small_crash_config())
+        assert (r1.states, r1.transitions) == (r2.states, r2.transitions)
+
+    def test_crash_config_round_trips(self):
+        from vodascheduler_tpu.analysis.modelcheck import crash_config
+        cfg = crash_config()
+        assert ModelConfig.from_dict(
+            json.loads(json.dumps(cfg.to_dict()))) == cfg
+
+    def test_unknown_variant_fails_loudly(self):
+        with pytest.raises(ValueError, match="durability variant"):
+            explore(small_crash_config(variant="keep-booking-on-revert"))
+
+    @pytest.mark.parametrize("variant,invariant", [
+        ("skip-journal-on-commit", "crash_recovery_divergence"),
+        ("apply-before-append", "recovery_unjournaled_grant"),
+        ("stale-epoch-accepted", "stale_epoch_write"),
+    ])
+    def test_durability_teeth_caught_and_replayable(self, variant,
+                                                    invariant):
+        from vodascheduler_tpu.analysis.modelcheck import crash_config
+        result = explore(crash_config(variant=variant))
+        assert result.counterexample is not None, \
+            f"seeded durability bug {variant} was MISSED"
+        assert result.counterexample["violation"].startswith(invariant), \
+            result.counterexample["violation"]
+        problems = replay_counterexample(json.loads(
+            json.dumps(result.counterexample)))
+        assert problems, "counterexample did not reproduce on replay"
+        assert any(p.startswith(invariant) for p in problems)
+        assert not obs_audit.validate_record(result.counterexample)
+
+    def test_crash_invariants_documented_in_catalog(self):
+        for inv in ("crash_recovery_divergence",
+                    "recovery_unjournaled_grant", "stale_epoch_write"):
+            assert inv in modelcheck.INVARIANTS
+
+
 @pytest.mark.slow
 class TestDeepProfile:
     def test_deep_profile_holds_invariants(self):
@@ -175,3 +243,12 @@ class TestDeepProfile:
         assert result.counterexample is None, json.dumps(
             result.counterexample, indent=1)
         assert result.states >= 4 * modelcheck.MIN_BOUNDED_STATES
+
+    def test_crash_profile_holds_invariants_at_scale(self):
+        """The CI acceptance: crash-at-any-prefix + recover satisfies
+        all invariants over >= 2,000 unique states."""
+        from vodascheduler_tpu.analysis.modelcheck import crash_config
+        result = explore(crash_config())
+        assert result.counterexample is None, json.dumps(
+            result.counterexample, indent=1)
+        assert result.states >= modelcheck.MIN_BOUNDED_STATES

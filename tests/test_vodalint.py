@@ -332,6 +332,101 @@ class TestThreadHygiene:
             """, "scheduler/x.py") == []
 
 
+class TestJournalSeam:
+    """The durability plane's seam rule + closed vocabularies
+    (doc/durability.md)."""
+
+    def test_transition_without_journal_flagged(self):
+        fs = findings("""
+            def f(self, job):
+                lifecycle.transition(job, X, reason="accepted", chips=0)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["journal-seam"]
+
+    def test_ledger_without_journal_flagged(self):
+        fs = findings("""
+            def f(self):
+                self.job_num_chips = BookingLedger()
+            """, "durability/x.py")
+        assert rules_of(fs) == ["journal-seam"]
+
+    def test_seamed_calls_clean(self):
+        fs = findings("""
+            def f(self, job):
+                lifecycle.transition(job, X, reason="accepted", chips=0,
+                                     journal=self.journal)
+                self.job_num_chips = BookingLedger(journal=None)
+            """, "scheduler/x.py")
+        assert fs == []
+
+    def test_rule_scoped_to_seam_prefixes(self):
+        fs = findings("""
+            def f(self, job):
+                lifecycle.transition(job, X, reason="accepted", chips=0)
+            """, "analysis/x.py")
+        assert fs == []
+
+    def test_unknown_journal_kind_flagged(self):
+        fs = findings("""
+            def f(self):
+                self.journal.append("jbogus", {"x": 1})
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["vocab"]
+        assert "JOURNAL_KINDS" in fs[0].message
+
+    def test_plain_list_append_not_confused_for_journal(self):
+        fs = findings("""
+            def f(self):
+                out.append("definitely not a kind")
+                self.journal.append("jbook", {"op": "commit"})
+            """, "scheduler/x.py")
+        assert fs == []
+
+    def test_unknown_recovery_reason_flagged(self):
+        fs = findings("""
+            def f(divs):
+                _add_divergence(divs, "vibes_diverged", "j0")
+            """, "durability/x.py")
+        assert rules_of(fs) == ["vocab"]
+        assert "RECOVERY_REASONS" in fs[0].message
+
+    def test_known_recovery_reason_clean(self):
+        fs = findings("""
+            def f(divs):
+                _add_divergence(divs, "backend_lost_job", "j0")
+            """, "durability/x.py")
+        assert fs == []
+
+    def test_unjournaling_a_scheduler_transition_fails(self):
+        """Re-introduction: stripping the journal= seam from a live
+        scheduler transition call must fail the lint again."""
+        with open(os.path.join(PKG, "scheduler", "scheduler.py")) as f:
+            src = f.read()
+        assert "journal=self.journal" in src
+        broken = src.replace(
+            'reason="accepted",\n                             chips=0, '
+            'tracer=self.tracer,\n                             '
+            'pool=self.pool_id, journal=self.journal',
+            'reason="accepted",\n                             chips=0, '
+            'tracer=self.tracer,\n                             '
+            'pool=self.pool_id')
+        assert broken != src
+        fs = vodalint.lint_source(broken, "scheduler/scheduler.py")
+        assert any(f.rule == "journal-seam" for f in fs)
+
+    def test_dead_journal_kind_flagged(self, tmp_path):
+        """Reverse sweep: a JOURNAL_KINDS entry used nowhere in the
+        tree is dead vocabulary (the two-sided contract)."""
+        pkg = tmp_path / "pkg"
+        (pkg / "obs").mkdir(parents=True)
+        (pkg / "obs" / "audit.py").write_text("# vocab module\n")
+        (pkg / "x.py").write_text("KINDS = ()\n")
+        fs = vodalint.lint_package(str(pkg))
+        dead = [f for f in fs if f.rule == "vocab"
+                and "JOURNAL_KINDS" in f.message]
+        assert dead, "journal kinds absent from a tree must be flagged"
+
+
 class TestLiveTree:
     def test_package_lints_clean(self):
         fs = vodalint.lint_package(PKG)

@@ -72,6 +72,17 @@ from vodascheduler_tpu.common.events import EventBus
 from vodascheduler_tpu.common.job import JobConfig, JobSpec, TrainingJob
 from vodascheduler_tpu.common.store import JobStore
 from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.durability.journal import (
+    Journal,
+    JournalCorrupt,
+    MemoryStorage,
+    SimulatedCrash,
+)
+from vodascheduler_tpu.durability.leader import MemoryLease
+from vodascheduler_tpu.durability.recover import (
+    QUIESCENT_CLEAN_REASONS,
+    read_state,
+)
 from vodascheduler_tpu.obs import audit as obs_audit
 from vodascheduler_tpu.obs import tracer as obs_tracer
 from vodascheduler_tpu.placement import PlacementManager
@@ -124,6 +135,25 @@ INVARIANTS: Dict[str, str] = {
         "non-terminal store job is owned by exactly one pool's "
         "scheduler — a routed job can never sit committed in the store "
         "with no pool ever hearing about it."),
+    "crash_recovery_divergence": (
+        "Crash profile: recovering from a QUIESCENT crash point "
+        "(between actions, nothing in flight) must reproduce the "
+        "pre-crash logical state exactly — statuses, bookings, done "
+        "set, resize clocks — with ZERO booking/status reconcile "
+        "divergences. Any divergence there is a journaling gap "
+        "(doc/durability.md)."),
+    "recovery_unjournaled_grant": (
+        "Crash profile, the write-ahead property: at EVERY crash point "
+        "(including mid-pass, at any journal append), every job the "
+        "backend is running must have a journaled grant — bookings are "
+        "journaled at the decide commit, BEFORE any backend claim, so "
+        "a live job the journal never booked means state was applied "
+        "ahead of its append."),
+    "stale_epoch_write": (
+        "Crash profile, fencing: after a standby takeover the journal "
+        "may never gain a record whose epoch regressed — a deposed "
+        "leader's appends are rejected at the write (FencedOut) and "
+        "dropped at replay, never interleaved."),
 }
 
 
@@ -172,6 +202,17 @@ class ModelConfig:
     # selects from ADMISSION_VARIANTS instead of VARIANTS.
     fleet: bool = False
     pools: Tuple[str, ...] = ()
+    # Durability mode (doc/durability.md): the scheduler journals to an
+    # in-memory WAL, and the search gains crash actions — `crash`
+    # (quiescent kill + journal recovery), `crash:K` (arm a torn death
+    # at the K-th journal append of the next timer advance — the
+    # mid-pass crash points), and `fence` (standby takeover while the
+    # deposed leader still runs). `variant` selects from
+    # DURABILITY_VARIANTS.
+    durability: bool = False
+    max_crashes: int = 0
+    crash_points: Tuple[int, ...] = ()
+    fence: bool = False
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -185,6 +226,7 @@ class ModelConfig:
         d["hosts"] = tuple((h, int(c)) for h, c in d["hosts"])
         for key in ("faults", "churn_hosts", "deletable", "pools"):
             d[key] = tuple(d.get(key, ()))
+        d["crash_points"] = tuple(int(k) for k in d.get("crash_points", ()))
         return ModelConfig(**d)
 
 
@@ -261,6 +303,64 @@ class _OverlappingPartitionPM(PlacementManager):
 # config names ONE variant, scheduler- or placement-sided.
 PLACEMENT_VARIANTS: Dict[str, type] = {
     "overlapping-partition": _OverlappingPartitionPM,
+}
+
+
+# ---- durability teeth (doc/durability.md "Proved, not just tested") --------
+
+
+class _SkipJournalOnCommit(Scheduler):
+    """Seeded durability bug: the booking ledger never journals — the
+    classic 'we persist statuses, bookings are derivable' shortcut. A
+    quiescent crash then recovers a journal whose statuses say RUNNING
+    while its bookings say nothing; reconcile must invent the grants
+    from backend truth, and `crash_recovery_divergence` (zero
+    divergences at a quiescent crash) catches it."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.job_num_chips.journal = None  # seeded bug
+
+
+class _ApplyBeforeAppend(Scheduler):
+    """Seeded durability bug: the decide-phase booking commit applies
+    (and the waves ACTUATE) before the journal append — 'journal it
+    when the pass is done'. A torn crash landing inside the pass then
+    leaves the backend running a job the journal never granted:
+    `recovery_unjournaled_grant`, the write-ahead property, catches it.
+    """
+
+    def _resched_pass(self, t_start, old, prof):
+        ledger = self.job_num_chips
+        jnl, ledger.journal = ledger.journal, None  # seeded bug
+        try:
+            return super()._resched_pass(t_start, old, prof)
+        finally:
+            ledger.journal = jnl
+            if jnl is not None:
+                # The too-late wholesale append (post-actuation).
+                jnl.append("jpass", {"set": ledger.snapshot(),
+                                     "del": []})
+
+
+class _StaleEpochJournal(Journal):
+    """Seeded durability bug: the journal skips the fencing check — a
+    deposed leader's appends are accepted with their stale epoch. After
+    a `fence` takeover the old scheduler keeps journaling (and
+    actuating); the epoch-regression scan (`stale_epoch_write`) catches
+    the interleaved stale records."""
+
+    def _check_fence(self) -> None:
+        pass  # seeded bug: no fence — stale writers welcome
+
+
+# name -> (Scheduler class, Journal class); the crash profile's
+# variant namespace, loud-mismatch like the others.
+DURABILITY_VARIANTS: Dict[str, Tuple[type, type]] = {
+    "default": (Scheduler, Journal),
+    "skip-journal-on-commit": (_SkipJournalOnCommit, Journal),
+    "apply-before-append": (_ApplyBeforeAppend, Journal),
+    "stale-epoch-accepted": (Scheduler, _StaleEpochJournal),
 }
 
 
@@ -347,16 +447,48 @@ class _World:
         # A variant this profile cannot install must fail LOUDLY: a
         # .get() fallback would explore the default (bug-free) world
         # and print a silently wrong "invariants hold".
-        if (config.variant not in VARIANTS
-                and config.variant not in PLACEMENT_VARIANTS):
-            raise ValueError(
-                f"variant {config.variant!r} is not a scheduler or "
-                f"placement variant (fleet-profile variants need "
-                f"fleet=True)")
-        pm_cls = PLACEMENT_VARIANTS.get(config.variant, PlacementManager)
+        self._topology = topology
+        journal_cls = Journal
+        if config.durability:
+            if config.variant not in DURABILITY_VARIANTS:
+                raise ValueError(
+                    f"variant {config.variant!r} is not a durability "
+                    f"variant (the crash profile seeds journaling bugs; "
+                    f"scheduler/placement variants need the bounded/deep "
+                    f"profiles)")
+            cls, journal_cls = DURABILITY_VARIANTS[config.variant]
+            pm_cls = PlacementManager
+        else:
+            if (config.variant not in VARIANTS
+                    and config.variant not in PLACEMENT_VARIANTS):
+                raise ValueError(
+                    f"variant {config.variant!r} is not a scheduler or "
+                    f"placement variant (fleet-profile variants need "
+                    f"fleet=True; durability variants need "
+                    f"durability=True)")
+            pm_cls = PLACEMENT_VARIANTS.get(config.variant,
+                                            PlacementManager)
+            cls = VARIANTS.get(config.variant, Scheduler)
         self.pm = pm_cls("mc-pool", topology=topology)
         self.allocator = ResourceAllocator(self.store)
-        cls = VARIANTS.get(config.variant, Scheduler)
+        # Durability plane (doc/durability.md): in-memory WAL + lease,
+        # same framing/fencing/recovery code as production, no
+        # filesystem — prefix replays stay fast and hermetic.
+        self._sched_cls = cls
+        self._journal_cls = journal_cls
+        self.lease: Optional[MemoryLease] = None
+        self.storage: Optional[MemoryStorage] = None
+        self.journal: Optional[Journal] = None
+        self.crashes_done = 0
+        self.fence_done = False
+        self.old_scheds: List[Scheduler] = []
+        self._crash_problems: List[str] = []
+        if config.durability:
+            self.lease = MemoryLease(holder="leader-1")
+            self.storage = MemoryStorage()
+            self.journal = journal_cls(
+                storage=self.storage, epoch=self.lease.epoch,
+                fence=self.lease.current_epoch, clock=self.clock)
         self.sched: Scheduler = cls(
             "mc-pool", self.backend, self.store, self.allocator,
             self.clock, bus=self.bus, placement_manager=self.pm,
@@ -366,6 +498,7 @@ class _World:
             # micro-passes through prefix replay, and per-phase CPU
             # sampling is a syscall per phase boundary (obs/profile.py).
             profile_cpu=False,
+            journal=self.journal,
             tracer=self.tracer)
         self._specs = {
             shape.name: JobSpec(
@@ -408,6 +541,14 @@ class _World:
                 acts.append(f"host_down:{host}")
         if self.config.storm and len(unsubmitted) > 1:
             acts.append("storm")
+        if self.config.durability and self.submitted:
+            if self.crashes_done < self.config.max_crashes:
+                # Quiescent kill + the armed mid-append (torn) kills.
+                acts.append("crash")
+                for k in self.config.crash_points:
+                    acts.append(f"crash:{k}")
+            if self.config.fence and not self.fence_done:
+                acts.append("fence")
         return acts
 
     def apply(self, action: str) -> None:
@@ -418,11 +559,11 @@ class _World:
             self.deleted.add(arg)
             self.sched.delete_training_job(arg)
         elif kind == "advance":
-            nxt = self.clock.next_timer()
-            if nxt is None:
-                self.clock.advance(self.config.rate_limit_seconds)
-            else:
-                self.clock.advance_to(max(nxt, self.clock.now()) + 1e-6)
+            self._advance()
+        elif kind == "crash":
+            self._apply_crash(arg)
+        elif kind == "fence":
+            self._apply_fence()
         elif kind == "fault":
             self.backend.inject_fault(arg)
         elif kind == "host_down":
@@ -446,6 +587,139 @@ class _World:
         self.store.insert_job(job)
         self.submitted.add(name)
         self.sched.create_training_job(name)
+
+    def _advance(self) -> None:
+        nxt = self.clock.next_timer()
+        if nxt is None:
+            self.clock.advance(self.config.rate_limit_seconds)
+        else:
+            self.clock.advance_to(max(nxt, self.clock.now()) + 1e-6)
+
+    # -- crash plane (doc/durability.md "Proved, not just tested") ----------
+
+    def _logical_snapshot(self) -> Tuple:
+        """The state crash recovery promises to reproduce exactly at a
+        quiescent crash point (recover.logical_tables shape: statuses,
+        bookings, done set, live jobs' resize clocks). Placement intent
+        is excluded on purpose — payback-deferred migrations legally
+        leave it diverging from the backend, and recovery rebuilds
+        occupancy from the live view."""
+        from vodascheduler_tpu.durability.recover import logical_tables
+        return logical_tables(self.sched)
+
+    def _apply_crash(self, arg: str) -> None:
+        """Kill the scheduler — at a quiescent point (`crash`), or at
+        the K-th journal append of the next timer advance (`crash:K`,
+        a torn mid-pass death) — then recover from the journal and
+        assert the durability invariants."""
+        self.crashes_done += 1
+        quiescent = True
+        if arg:
+            self.storage.crash_after(int(arg))
+            try:
+                self._advance()
+            except SimulatedCrash:
+                quiescent = False
+            else:
+                # Fewer appends than the trigger: the kill lands after
+                # the advance completed — a quiescent death after all.
+                self.storage.disarm()
+        self._crash_and_recover(quiescent=quiescent)
+
+    def _apply_fence(self) -> None:
+        """Standby takeover while the deposed leader still RUNS (the
+        split-brain window): the lease epoch bumps, a new scheduler
+        recovers from the journal, and the old one is left alive — its
+        next journal append must fence (FencedOut) and stop it; a
+        journal that accepts the stale write is caught by the
+        epoch-regression scan."""
+        self.fence_done = True
+        self.old_scheds.append(self.sched)  # left running, deposed
+        self._crash_and_recover(quiescent=True, stop_old=False)
+
+    def _crash_and_recover(self, quiescent: bool,
+                           stop_old: bool = True) -> None:
+        pre = self._logical_snapshot() if quiescent else None
+        old = self.sched
+        if stop_old:
+            old.stop()
+        self.storage.revive()
+        epoch = self.lease.advance_epoch(
+            holder=f"leader-{self.lease.epoch + 1}")
+        self.journal = self._journal_cls(
+            storage=self.storage, epoch=epoch,
+            fence=self.lease.current_epoch, clock=self.clock)
+        problems: List[str] = []
+        # The write-ahead property, checked on the PRE-recovery journal
+        # (recovery itself appends re-assertions): every live backend
+        # job must have a journaled grant in the committed prefix.
+        try:
+            state = read_state(self.journal)
+        except JournalCorrupt as e:
+            self._crash_problems.append(
+                f"crash_recovery_divergence: journal corrupt at "
+                f"recovery: {e}")
+            state = None
+        if state is not None:
+            with self.backend._state_lock:
+                live = {n: sim.num_workers
+                        for n, sim in self.backend.jobs.items()
+                        if sim.num_workers > 0}
+            for name in sorted(live):
+                if name not in state.granted:
+                    problems.append(
+                        f"recovery_unjournaled_grant: backend runs "
+                        f"{name} x{live[name]} but the journal never "
+                        f"granted it chips (state applied ahead of its "
+                        f"append)")
+            if state.stale_records:
+                problems.append(
+                    f"stale_epoch_write: {state.stale_records} "
+                    f"stale-epoch record(s) found in the journal at "
+                    f"recovery")
+        self.pm = PlacementManager("mc-pool", topology=self._topology)
+        self.sched = self._sched_cls(
+            "mc-pool", self.backend, self.store, self.allocator,
+            self.clock, bus=self.bus, placement_manager=self.pm,
+            algorithm=self.config.algorithm,
+            rate_limit_seconds=self.config.rate_limit_seconds,
+            profile_cpu=False, journal=self.journal,
+            tracer=self.tracer, resume=True)
+        report = self.sched._last_recovery_report or {}
+        if quiescent:
+            bad = [d for d in report.get("divergences", ())
+                   if d["reason"] in QUIESCENT_CLEAN_REASONS]
+            if bad:
+                problems.append(
+                    f"crash_recovery_divergence: quiescent crash "
+                    f"recovered with corrective steps {bad}")
+            # Compare the AS-REBUILT tables (snapshotted by recovery
+            # before its resume pass rebalances) against pre-crash.
+            post = self.sched._recovered_tables
+            if pre is not None and post is not None and post != pre:
+                problems.append(
+                    f"crash_recovery_divergence: recovered state != "
+                    f"pre-crash state ({pre} -> {post})")
+        self._crash_problems.extend(problems)
+
+    def _durability_problems(self) -> List[str]:
+        """Per-step durability checks: crash findings (sticky — a
+        deterministic replay must re-find them) plus, once a fence has
+        opened the split-brain window, the journal epoch-regression
+        scan that catches a deposed leader's accepted stale writes."""
+        problems = list(self._crash_problems)
+        if self.fence_done and self.journal is not None:
+            try:
+                state = read_state(self.journal)
+                if state.stale_records:
+                    problems.append(
+                        f"stale_epoch_write: {state.stale_records} "
+                        f"stale-epoch record(s) interleaved after the "
+                        f"takeover (deposed leader not fenced)")
+            except JournalCorrupt as e:
+                problems.append(f"stale_epoch_write: journal corrupt "
+                                f"after takeover: {e}")
+        return problems
 
     # -- fingerprint --------------------------------------------------------
 
@@ -478,12 +752,23 @@ class _World:
                  tuple(sorted(self.deleted)),
                  tuple(sorted(backend.completed)),
                  tuple(sorted(backend.failed)))
+        if self.config.durability:
+            # Crash bookkeeping is logical state: a path that crashed
+            # must never merge with one that didn't (its remaining
+            # crash budget, epoch, and split-brain window all differ).
+            flags = flags + (self.crashes_done, self.fence_done,
+                             self.journal.epoch,
+                             tuple(s._stopped for s in self.old_scheds))
         return (booked, ready, done, bjobs, hosts, faults, flags)
 
     # -- invariants ---------------------------------------------------------
 
     def check(self) -> List[str]:
         problems: List[str] = []
+        if self.config.durability:
+            problems.extend(self._durability_problems())
+            if problems:
+                return problems
         sched, backend = self.sched, self.backend
         booked = sched.job_num_chips.snapshot()
         hosts = backend.list_hosts()
@@ -1040,11 +1325,41 @@ def fleet_config(variant: str = "default") -> ModelConfig:
     )
 
 
+def crash_config(variant: str = "default") -> ModelConfig:
+    """The durability profile (doc/durability.md "Proved, not just
+    tested"): the bounded world journaling to an in-memory WAL, plus
+    crash actions — `crash` (quiescent kill + recover), `crash:K`
+    (torn death at the K-th journal append of the next timer advance —
+    the mid-pass crash points), and `fence` (standby takeover with the
+    deposed leader left running). Every recovery re-checks the full
+    invariant catalog over the RECOVERED state, and three durability
+    invariants join it: crash_recovery_divergence,
+    recovery_unjournaled_grant, stale_epoch_write."""
+    return ModelConfig(
+        jobs=(JobShape("j0", min_chips=1, max_chips=4, epochs=2),
+              JobShape("j1", min_chips=2, max_chips=4, epochs=1),
+              JobShape("j2", min_chips=1, max_chips=2, epochs=1)),
+        hosts=(("host-0", 4), ("host-1", 4)),
+        depth=11,
+        max_states=2100,
+        faults=("start", "scale"),
+        churn_hosts=("host-1",),
+        deletable=("j0",),
+        storm=True,
+        durability=True,
+        max_crashes=1,
+        crash_points=(1, 3),
+        fence=True,
+        variant=variant,
+    )
+
+
 PROFILES = {"bounded": bounded_config, "deep": deep_config,
-            "fleet": fleet_config}
+            "fleet": fleet_config, "crash": crash_config}
 
 # The CI gate: a bounded run exploring fewer unique states than this
 # means the scenario (or the dedup) silently collapsed — fail loudly.
+# Applies to the `bounded` AND `crash` profiles (both run in CI).
 MIN_BOUNDED_STATES = 2000
 
 
@@ -1061,10 +1376,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--variant",
                         choices=sorted(set(VARIANTS)
                                        | set(ADMISSION_VARIANTS)
-                                       | set(PLACEMENT_VARIANTS)),
+                                       | set(PLACEMENT_VARIANTS)
+                                       | set(DURABILITY_VARIANTS)),
                         default="default",
                         help="scheduler/placement variant (bounded/deep "
-                             "profiles) or admission variant (fleet "
+                             "profiles), admission variant (fleet "
+                             "profile), or durability variant (crash "
                              "profile)")
     parser.add_argument("--selftest", action="store_true",
                         help="run every seeded-bug variant and require "
@@ -1107,6 +1424,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             reproduced = caught and bool(
                 replay_counterexample(result.counterexample))
             print(f"selftest placement/{name}: "
+                  f"{'CAUGHT' if caught else 'MISSED'}"
+                  f"{' +replayed' if reproduced else ''} "
+                  f"({result.states} states)")
+            ok = ok and caught and reproduced
+        # Durability teeth (doc/durability.md): each seeded journaling
+        # bug — unjournaled bookings, apply-and-actuate-before-append,
+        # a fence-less journal accepting a deposed leader's stale
+        # writes — must be caught by the crash profile with a
+        # replayable counterexample.
+        for name in sorted(DURABILITY_VARIANTS):
+            if name == "default":
+                continue
+            result = explore(crash_config(variant=name))
+            caught = result.counterexample is not None
+            reproduced = caught and bool(
+                replay_counterexample(result.counterexample))
+            print(f"selftest durability/{name}: "
                   f"{'CAUGHT' if caught else 'MISSED'}"
                   f"{' +replayed' if reproduced else ''} "
                   f"({result.states} states)")
@@ -1167,7 +1501,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if result.counterexample is not None:
         print(json.dumps(result.counterexample, indent=1))
         return 1
-    if args.profile == "bounded" and result.states < MIN_BOUNDED_STATES:
+    if args.profile in ("bounded", "crash") \
+            and result.states < MIN_BOUNDED_STATES:
         print(f"modelcheck: bound collapsed — only {result.states} "
               f"states explored (< {MIN_BOUNDED_STATES})")
         return 2

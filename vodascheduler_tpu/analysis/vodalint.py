@@ -85,6 +85,12 @@ RULES: Dict[str, str] = {
         "current_context in the enclosing function) — the ambient trace "
         "context is thread-local, and an unpropagated worker orphans "
         "every downstream span."),
+    "journal-seam": (
+        "Every lifecycle.transition() call site and every "
+        "BookingLedger() construction in scheduler/ and durability/ "
+        "must pass the `journal=` seam — an unjournaled status store "
+        "or booking table is state a crash loses and recovery can "
+        "never rebuild (doc/durability.md)."),
     "suppression-empty-reason": (
         "A `# vodalint: ignore[...]` comment must carry a non-empty "
         "reason after the bracket — accepted exceptions document why."),
@@ -96,7 +102,14 @@ RULES: Dict[str, str] = {
 # Modules whose code runs under an injected Clock (relative to the
 # package root). common/clock.py itself is the Clock implementation and
 # is outside these prefixes by construction.
-CLOCKED_PREFIXES = ("scheduler/", "cluster/", "obs/", "replay/")
+CLOCKED_PREFIXES = ("scheduler/", "cluster/", "obs/", "replay/",
+                    "durability/")
+
+# Where the durability plane's journaling seam is mandatory: every
+# transition() call and BookingLedger() construction here must name
+# the `journal=` kwarg (None is a caller's explicit choice; omitting
+# it is the silent-unjournaled-write bug class).
+JOURNAL_SEAM_PREFIXES = ("scheduler/", "durability/")
 
 # Where the lock-discipline rule applies.
 LOCKED_PREFIXES = ("scheduler/", "cluster/")
@@ -429,13 +442,37 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
     status_reasons = vocab["STATUS_REASONS"]
     phase_names = vocab.get("PHASE_NAMES", frozenset())
     route_reasons = vocab.get("ROUTE_REASONS", frozenset())
+    journal_kinds = vocab.get("JOURNAL_KINDS", frozenset())
+    recovery_reasons = vocab.get("RECOVERY_REASONS", frozenset())
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
         name = func.attr if isinstance(func, ast.Attribute) else (
             func.id if isinstance(func, ast.Name) else None)
-        if name == "_add_reason" and len(node.args) >= 2:
+        if (name == "append" and journal_kinds and node.args
+                and isinstance(func, ast.Attribute)
+                and _receiver_is_journal(func.value)):
+            # <anything named *journal*>.append("<kind>", ...): the
+            # write-ahead journal's record kinds are closed
+            # (doc/durability.md "Record catalog").
+            for line, code in _literal_strings(node.args[0]) or []:
+                if code not in journal_kinds:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"journal record kind {code!r} not in "
+                        f"obs.audit.JOURNAL_KINDS"))
+        elif name == "_add_divergence" and len(node.args) >= 2:
+            # recover._add_divergence(divs, "<reason>", job): the
+            # audited corrective-step vocabulary is closed like
+            # REASON_CODES (doc/durability.md "Recovery").
+            for line, code in _literal_strings(node.args[1]) or []:
+                if code not in recovery_reasons:
+                    out.append(Finding(
+                        rel, line, "vocab",
+                        f"recovery reason {code!r} not in "
+                        f"obs.audit.RECOVERY_REASONS"))
+        elif name == "_add_reason" and len(node.args) >= 2:
             for line, code in _literal_strings(node.args[1]) or []:
                 if code not in reason_codes:
                     out.append(Finding(
@@ -487,6 +524,43 @@ def _check_vocab(tree: ast.AST, rel: str, vocab: Dict[str, frozenset],
                             rel, line, "vocab",
                             f"status reason {code!r} not in "
                             f"obs.audit.STATUS_REASONS"))
+
+
+def _receiver_is_journal(node: ast.AST) -> bool:
+    """Whether a call receiver is a journal handle by name:
+    `journal`, `jnl`, `self.journal`, `self._journal`, `j.journal` —
+    the naming convention the journal-seam contract rides on."""
+    if isinstance(node, ast.Name):
+        return "journal" in node.id.lower() or node.id in ("jnl", "j")
+    if isinstance(node, ast.Attribute):
+        return "journal" in node.attr.lower()
+    return False
+
+
+def _check_journal_seam(tree: ast.AST, rel: str,
+                        out: List[Finding]) -> None:
+    """transition() calls and BookingLedger() constructions in the
+    seam-mandatory prefixes must name the `journal=` kwarg."""
+    if not rel.startswith(JOURNAL_SEAM_PREFIXES):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name not in ("transition", "BookingLedger"):
+            continue
+        if name == "transition" and len(node.args) < 2:
+            continue  # not the lifecycle API shape
+        if not any(kw.arg == "journal" for kw in node.keywords):
+            out.append(Finding(
+                rel, node.lineno, "journal-seam",
+                f"{name}() without the journal= seam — an unjournaled "
+                f"{'status store' if name == 'transition' else 'booking table'} "
+                f"is state a crash loses (pass journal=self.journal, "
+                f"or journal=None where the caller owns an ephemeral "
+                f"scheduler)"))
 
 
 def _check_metrics_lock(tree: ast.AST, rel: str,
@@ -722,7 +796,9 @@ def _load_vocab() -> Dict[str, frozenset]:
             "SPAN_NAMES": audit.SPAN_NAMES,
             "STATUS_REASONS": audit.STATUS_REASONS,
             "PHASE_NAMES": audit.PHASE_NAMES,
-            "ROUTE_REASONS": audit.ROUTE_REASONS}
+            "ROUTE_REASONS": audit.ROUTE_REASONS,
+            "JOURNAL_KINDS": audit.JOURNAL_KINDS,
+            "RECOVERY_REASONS": audit.RECOVERY_REASONS}
 
 
 def lint_source(src: str, rel: str,
@@ -744,6 +820,7 @@ def lint_source(src: str, rel: str,
     _check_lock_discipline(tree, rel, findings)
     _check_status_store(tree, rel, findings)
     _check_vocab(tree, rel, vocab, findings)
+    _check_journal_seam(tree, rel, findings)
     _check_metrics_lock(tree, rel, findings)
     _check_thread_daemon(tree, imports, rel, findings)
     _check_executor_context(tree, rel, findings)
@@ -832,6 +909,9 @@ def lint_package(pkg_dir: Optional[str] = None) -> List[Finding]:
             ("SPAN_NAMES", vocab["SPAN_NAMES"], used_literals),
             ("PHASE_NAMES", vocab["PHASE_NAMES"], used_literals),
             ("ROUTE_REASONS", vocab["ROUTE_REASONS"], used_literals),
+            ("JOURNAL_KINDS", vocab["JOURNAL_KINDS"], used_literals),
+            ("RECOVERY_REASONS", vocab["RECOVERY_REASONS"],
+             used_literals),
             ("STATUS_REASONS", vocab["STATUS_REASONS"],
              used_outside_lifecycle)):
         for entry in sorted(entries):

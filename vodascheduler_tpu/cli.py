@@ -112,6 +112,18 @@ def main(argv=None) -> int:
     p_explain.add_argument("-n", type=int, default=20,
                            help="max decisions to show (newest last)")
 
+    p_fsck = sub.add_parser(
+        "fsck",
+        help="check a write-ahead journal offline (framing, checksums, "
+             "seq/epoch monotonicity; doc/durability.md) — or, with "
+             "--live, the running scheduler's GET /debug/journal")
+    p_fsck.add_argument("path", nargs="?", default=None,
+                        help="journal file, e.g. "
+                             "~/.voda/journal/default.wal")
+    p_fsck.add_argument("--live", action="store_true",
+                        help="query the running scheduler instead of "
+                             "reading a file")
+
     p_top = sub.add_parser(
         "top",
         help="where the scheduler's milliseconds go: per-phase p50/p95 "
@@ -197,6 +209,19 @@ def main(argv=None) -> int:
         out = _request(f"{args.scheduler_server}/ratelimit{pool_q}", "PUT",
                        json.dumps({"seconds": args.seconds}).encode())
         print(f"rate limit set: {out['seconds']}s")
+    elif args.command == "fsck" and args.live:
+        stats = _request(f"{args.scheduler_server}/debug/journal{pool_q}")
+        _print_journal(stats)
+        if stats.get("corrupt"):
+            return 1
+    elif args.command == "fsck":
+        if not args.path:
+            raise SystemExit("error: fsck needs a journal path "
+                             "(or --live)")
+        from vodascheduler_tpu.durability.journal import fsck as _fsck
+        report = _fsck(args.path)
+        print(json.dumps(report, indent=1, default=str))
+        return 1 if report["problems"] else 0
     elif args.command == "explain":
         from urllib.parse import quote
         out = _request(f"{args.scheduler_server}/debug/trace/"
@@ -217,7 +242,14 @@ def main(argv=None) -> int:
             ingest = _request(f"{args.server}/debug/ingest")
         except SystemExit:
             ingest = None
-        _print_top(records, k=args.k, ingest=ingest)
+        # Durability line (doc/durability.md): best-effort for
+        # pre-journal servers.
+        try:
+            journal = _request(
+                f"{args.scheduler_server}/debug/journal{pool_q}")
+        except SystemExit:
+            journal = None
+        _print_top(records, k=args.k, ingest=ingest, journal=journal)
     return 0
 
 
@@ -261,13 +293,41 @@ def _print_ingest(ingest: dict) -> None:
               f"({burst.get('per_item_ms', 0.0):.4f}ms/job)")
 
 
-def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None
-               ) -> None:
+def _print_journal(stats: dict) -> None:
+    """Durability line(s) for `voda top` / `voda fsck --live`
+    (GET /debug/journal): how an operator sees the journal grow, the
+    snapshot age, a torn tail survived, or — the loud one — mid-file
+    corruption."""
+    if not stats.get("enabled"):
+        print("durability: journal disabled (VODA_JOURNAL=0)")
+        return
+    age = stats.get("snapshot_age_seconds")
+    print(f"durability: journal {stats.get('size_bytes', 0)}B "
+          f"seq={stats.get('last_seq', 0)} "
+          f"epoch={stats.get('epoch', 0)} "
+          f"records={stats.get('records', 0)} "
+          f"torn_tail={stats.get('torn_tail_count', 0)} "
+          f"snapshot_age={'-' if age is None else f'{age:.0f}s'}"
+          + (" FENCED" if stats.get("fenced") else ""))
+    if stats.get("corrupt"):
+        print(f"  CORRUPT: {stats['corrupt']}")
+    last = stats.get("last_recovery")
+    if last:
+        print(f"  last recovery: {last.get('records', 0)} record(s) "
+              f"replayed, {len(last.get('divergences', []))} "
+              f"divergence(s), {last.get('duration_ms', 0.0):.1f}ms "
+              f"(epoch {last.get('epoch')})")
+
+
+def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None,
+               journal: Optional[dict] = None) -> None:
     """Human rendering of /debug/profile: per-phase p50/p95 over the
     window, then the slowest passes with their dominant phase and the
     jobs whose deltas triggered them."""
     if ingest:
         _print_ingest(ingest)
+    if journal:
+        _print_journal(journal)
     if not records:
         print("no profiled passes yet (ring empty; run or trigger a "
               "resched first)")

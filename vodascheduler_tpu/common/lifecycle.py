@@ -149,16 +149,24 @@ if _undeclared:  # pragma: no cover - import-time guard
 def transition(job, to: JobStatus, *, reason: str,
                chips: Optional[int] = None,
                tracer: Optional["obs_tracer.Tracer"] = None,
-               pool: str = "") -> bool:
-    """Take one edge of the state machine: validate it, store
-    `job.status` (the single blessed store in the tree), and emit the
-    `status_transition` audit record.
+               pool: str = "",
+               journal=None) -> bool:
+    """Take one edge of the state machine: validate it, journal it,
+    store `job.status` (the single blessed store in the tree), and emit
+    the `status_transition` audit record.
 
     `chips` is the job's currently booked chip count when the caller
     knows it — the edge's booking contract is enforced against it
     (RUNNING requires nonzero, WAITING requires zero); omit it on paths
     where the booking is not yet settled (terminal edges, where the
     ledger release rides the same lock hold).
+
+    `journal` is the durability plane's write-ahead seam
+    (doc/durability.md): when given, a `jstatus` record is appended
+    AFTER validation but BEFORE the status store — write-ahead, so a
+    crash (or a fenced deposed leader, whose append raises) can never
+    leave an applied-but-unjournaled edge. Scheduler call sites must
+    pass it (vodalint's `journal-seam` rule).
 
     Returns True when the status actually changed, False for an allowed
     (and emitted) self-loop. Raises `InvalidTransition` for an
@@ -185,6 +193,12 @@ def transition(job, to: JobStatus, *, reason: str,
             raise BookingContractViolation(
                 f"job {job.name!r}: {frm.value} -> {to.value} requires "
                 f"a nonzero booking, has {chips}")
+    if journal is not None:
+        payload = {"job": job.name, "from": frm.value, "to": to.value,
+                   "reason": reason}
+        if chips is not None:
+            payload["chips"] = int(chips)
+        journal.append("jstatus", payload)
     job.status = to
     tracer = tracer or obs_tracer.active_tracer()
     rec = {
@@ -224,11 +238,20 @@ class BookingLedger:
     Thread-safety: mutators and snapshot reads take an internal lock;
     the scheduler additionally serializes mutation under its own lock
     (wave workers re-book concurrently with reader threads).
+
+    Durability seam (doc/durability.md): with a `journal` attached,
+    every mutator appends its write-ahead record (`jbook` /
+    delta-encoded `jpass`) BEFORE touching the table — a crash between
+    append and apply loses only the in-memory half, which recovery
+    rebuilds from the journal anyway, and a fenced append (deposed
+    leader) raises before any state moves.
     """
 
-    def __init__(self, initial: Optional[Dict[str, int]] = None) -> None:
+    def __init__(self, initial: Optional[Dict[str, int]] = None,
+                 journal=None) -> None:
         self._lock = threading.RLock()
         self._booked: Dict[str, int] = dict(initial or {})
+        self.journal = journal
 
     # -- mapping reads ------------------------------------------------------
 
@@ -285,19 +308,44 @@ class BookingLedger:
         if chips < 0:
             raise ValueError(f"negative booking for {job!r}: {chips}")
         with self._lock:
+            if self.journal is not None \
+                    and self._booked.get(job) != int(chips):
+                self.journal.append("jbook", {"op": "commit", "job": job,
+                                              "chips": int(chips)})
             self._booked[job] = int(chips)
 
     def release(self, job: str) -> int:
         """Drop `job`'s booking entirely; returns the chips it held
         (0 if it held none) so failure paths can re-book or reserve."""
         with self._lock:
+            if self.journal is not None and job in self._booked:
+                self.journal.append("jbook", {"op": "release", "job": job})
             return self._booked.pop(job, 0)
 
     def commit_pass(self, result: Dict[str, int]) -> None:
         """Wholesale replace with one pass's decided allocation — the
         decide-phase booking commit (jobs absent from `result` are
-        released implicitly; the pass's diff emits their deltas)."""
+        released implicitly; the pass's diff emits their deltas).
+
+        Journaled as a DELTA (`jpass` set/del vs the previous table):
+        a steady-state 10k-job pass that changes a handful of bookings
+        appends a handful of entries, not the whole fleet — the
+        journal-append overhead perf_scale's recovery column bounds."""
         if any(n < 0 for n in result.values()):
             raise ValueError(f"negative booking in pass result: {result}")
         with self._lock:
+            if self.journal is not None:
+                old = self._booked
+                old_get = old.get
+                changed = {j: int(n) for j, n in result.items()
+                           if old_get(j) != n}
+                # A removal implies a size divergence or a net-zero
+                # swap (which surfaces in `changed` as a new key) —
+                # only then pay the O(n) membership sweep.
+                removed: list = []
+                if changed or len(old) > len(result):
+                    removed = [j for j in old if j not in result]
+                if changed or removed:
+                    self.journal.append(
+                        "jpass", {"set": changed, "del": removed})
             self._booked = {j: int(n) for j, n in result.items()}

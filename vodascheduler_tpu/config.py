@@ -148,6 +148,27 @@ MIGRATION_PAYBACK_SECONDS = _env_float(
 # fractional_sharing_ab bench row measures stranded capacity against.
 FRACTIONAL_SHARING = os.environ.get("VODA_FRACTIONAL_SHARING", "1") != "0"
 
+# Durability plane (doc/durability.md). VODA_JOURNAL=0 disables the
+# write-ahead journal entirely (ephemeral control plane — the pre-PR-13
+# behavior); on, every transition/booking/placement mutation appends a
+# crash-safe record under <workdir>/journal/ and a restart replays it.
+JOURNAL = os.environ.get("VODA_JOURNAL", "1") != "0"
+
+# fsync per journal append: off (default), an O_APPEND write survives
+# PROCESS death (kill -9) via the page cache; on, each record also
+# survives host/power death at the price of a disk flush per append.
+JOURNAL_FSYNC = os.environ.get("VODA_JOURNAL_FSYNC", "0") == "1"
+
+# Compaction bound: once the active journal segment outgrows this, the
+# pass commit point folds it into a snapshot so recovery stays
+# O(live jobs), not O(history).
+JOURNAL_COMPACT_BYTES = int(_env_float("VODA_JOURNAL_COMPACT_BYTES",
+                                       str(8 * 1024 * 1024)))
+
+# Leadership lease TTL: the leader renews at TTL/3; a standby takes
+# over (bumping the fencing epoch) once the lease sits expired.
+LEASE_TTL_SECONDS = _env_float("VODA_LEASE_TTL_SECONDS", "15")
+
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the
 # checkpoint-restart path. Must cover the resharded step's XLA compile

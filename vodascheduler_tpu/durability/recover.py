@@ -1,0 +1,330 @@
+"""Crash recovery: journal replay + backend reconciliation
+(doc/durability.md "Recovery").
+
+Two phases, deliberately separated:
+
+1. **Replay** (`read_state`): fold the snapshot + the journal's intact
+   record suffix into a `JournalState` — the exact committed prefix of
+   the pre-crash scheduler: per-job status, ledger bookings, placement
+   intent, resize (hysteresis/cooldown) clocks, retirement tombstones,
+   and the `granted` history the write-ahead invariant needs. Replay is
+   pure (no scheduler, no backend): duplicates are dropped by seq,
+   records whose epoch regressed are DROPPED and counted (a deposed
+   leader's stale writes are rejected, never interleaved), and a torn
+   tail is dropped while mid-file corruption raises (journal.py).
+
+2. **Reconcile** (`recover_scheduler`): rebuild the scheduler's tables
+   from the store + the replayed state, then compare against the
+   backend's live view. Every divergence becomes an AUDITED corrective
+   step — a `recovery_report` record (closed RECOVERY_REASONS
+   vocabulary, obs/audit.py) naming the job and why — and the
+   scheduler arms a `resume` resched so the PR 6 `recovery_pending`
+   contract owns the repair. At a quiescent crash point (nothing in
+   flight) the correct implementation produces ZERO booking/status
+   divergences — the exact property the model checker's crash profile
+   asserts exhaustively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _walltime
+from typing import Dict, List, Set, Tuple
+
+from vodascheduler_tpu.common import lifecycle
+from vodascheduler_tpu.common.types import JobStatus
+from vodascheduler_tpu.obs import audit as obs_audit
+
+# Divergence codes that can NEVER legitimately appear when recovering
+# from a quiescent crash point (nothing in flight): the journal fully
+# covers bookings and statuses there, so any of these means a
+# journaling gap. placement_diverged is excluded on purpose —
+# payback-deferred migrations legally leave placement intent diverging
+# from the backend's live binding even at quiescence (doc/placement.md).
+QUIESCENT_CLEAN_REASONS = frozenset({
+    "backend_lost_job",
+    "backend_running_unbooked",
+    "chips_diverged",
+    "unjournaled_job",
+})
+
+
+@dataclasses.dataclass
+class JournalState:
+    """The journal's committed prefix, replayed to a logical state."""
+
+    statuses: Dict[str, str] = dataclasses.field(default_factory=dict)
+    booked: Dict[str, int] = dataclasses.field(default_factory=dict)
+    placements: Dict[str, List[Tuple[str, int]]] = \
+        dataclasses.field(default_factory=dict)
+    resize_at: Dict[str, float] = dataclasses.field(default_factory=dict)
+    retired: Dict[str, str] = dataclasses.field(default_factory=dict)
+    granted: Set[str] = dataclasses.field(default_factory=set)
+    routes: Dict[str, str] = dataclasses.field(default_factory=dict)
+    last_seq: int = 0
+    epoch: int = 0
+    records: int = 0
+    torn_tail: int = 0
+    stale_records: int = 0
+    duplicate_records: int = 0
+
+
+def read_state(journal) -> JournalState:
+    """Snapshot + journal suffix -> JournalState (see module doc)."""
+    from vodascheduler_tpu.durability import snapshot as snap_mod
+
+    state = JournalState()
+    snap = snap_mod.load_snapshot(journal)
+    if snap is not None:
+        state.statuses = dict(snap.get("statuses", {}))
+        state.booked = {j: int(n) for j, n in snap.get("booked", {}).items()}
+        state.placements = {
+            j: [(h, int(n)) for h, n in pairs]
+            for j, pairs in snap.get("placements", {}).items()}
+        state.resize_at = {j: float(t)
+                          for j, t in snap.get("resize_at", {}).items()}
+        state.retired = dict(snap.get("retired", {}))
+        state.granted = set(snap.get("granted", ()))
+        state.routes = dict(snap.get("routes", {}))
+        state.last_seq = int(snap.get("last_seq", 0))
+        state.epoch = int(snap.get("epoch", 0))
+    for rec in journal.records():
+        state.records += 1
+        seq = int(rec.get("seq", 0))
+        epoch = int(rec.get("epoch", 0))
+        if epoch < state.epoch:
+            # Fencing on replay: a stale-epoch record after a newer
+            # leader's writes is a deposed leader's interleaved append;
+            # it is rejected, counted, and surfaced — never applied.
+            # Checked BEFORE seq dedup: a deposed leader continues its
+            # own seq counter, so its stale appends usually alias old
+            # seqs — they are stale writes, not duplicates.
+            state.stale_records += 1
+            continue
+        if seq <= state.last_seq:
+            state.duplicate_records += 1
+            continue
+        state.last_seq = seq
+        state.epoch = max(state.epoch, epoch)
+        _apply_record(state, rec)
+    state.torn_tail = journal._torn_tail_count + journal.torn_trimmed
+    return state
+
+
+def _apply_record(state: JournalState, rec: dict) -> None:
+    kind = rec.get("k")
+    if kind == "jstatus":
+        job = rec["job"]
+        state.statuses[job] = rec["to"]
+        if int(rec.get("chips") or 0) > 0:
+            state.granted.add(job)
+    elif kind == "jbook":
+        job = rec["job"]
+        if rec.get("op") == "release":
+            state.booked.pop(job, None)
+        else:
+            chips = int(rec.get("chips", 0))
+            state.booked[job] = chips
+            if chips > 0:
+                state.granted.add(job)
+    elif kind == "jpass":
+        for job, chips in (rec.get("set") or {}).items():
+            state.booked[job] = int(chips)
+            if int(chips) > 0:
+                state.granted.add(job)
+        for job in rec.get("del") or ():
+            state.booked.pop(job, None)
+    elif kind == "jplace":
+        for job, pairs in (rec.get("set") or {}).items():
+            state.placements[job] = [(h, int(n)) for h, n in pairs]
+        for job in rec.get("del") or ():
+            state.placements.pop(job, None)
+    elif kind == "jclock":
+        state.resize_at[rec["job"]] = float(rec["at"])
+    elif kind == "jretire":
+        job = rec["job"]
+        state.retired[job] = rec.get("status", "")
+        state.statuses.pop(job, None)
+        state.booked.pop(job, None)
+        state.placements.pop(job, None)
+        state.resize_at.pop(job, None)
+    elif kind == "jroute":
+        state.routes[rec["job"]] = rec.get("pool", "")
+    # jlease / jsnap / jrecover carry no replayable scheduler state.
+
+
+def _add_divergence(divergences: List[dict], reason: str,
+                    job: str) -> None:
+    """One audited corrective step (RECOVERY_REASONS, closed — the
+    vodalint vocab rule checks these literals forward)."""
+    divergences.append({"job": job, "reason": reason})
+
+
+def _finish_retirement(sched, job, target: JobStatus, journal) -> None:
+    """Complete an interrupted retirement with the journal's terminal
+    verdict. Explicit literal edges (not a dict lookup) so vodacheck's
+    transition-literal audit can verify each against TRANSITIONS."""
+    if target == JobStatus.COMPLETED:
+        lifecycle.transition(job, JobStatus.COMPLETED, reason="completed",
+                             tracer=sched.tracer, pool=sched.pool_id,
+                             journal=journal)
+    elif target == JobStatus.FAILED:
+        lifecycle.transition(job, JobStatus.FAILED, reason="failed",
+                             tracer=sched.tracer, pool=sched.pool_id,
+                             journal=journal)
+    else:
+        lifecycle.transition(job, JobStatus.CANCELED, reason="user_delete",
+                             tracer=sched.tracer, pool=sched.pool_id,
+                             journal=journal)
+    job.finish_time = sched.clock.now()
+    sched.store.update_job(job)
+
+
+def recover_scheduler(sched) -> dict:
+    """Rebuild a crashed scheduler from its journal and reconcile
+    against the backend's live view (see module doc). Called by the
+    Scheduler constructor on `resume=True` when the journal has state.
+    Returns (and retains on the scheduler) the recovery_report record.
+    """
+    t0 = _walltime.monotonic()
+    journal = sched.journal
+    state = read_state(journal)
+    divergences: List[dict] = []
+    if state.torn_tail:
+        _add_divergence(divergences, "journal_torn_tail", "")
+    if state.stale_records:
+        _add_divergence(divergences, "stale_epoch_dropped", "")
+    running = sched.backend.running_jobs()
+    for job in sched.store.list_jobs(pool=sched.pool_id):
+        name = job.name
+        jstat = state.statuses.get(name)
+        retired = state.retired.get(name)
+        if retired or job.status.is_terminal or (
+                jstat is not None and JobStatus(jstat).is_terminal):
+            # Finish an interrupted retirement: the journal's terminal
+            # verdict wins over a store record the crash beat to disk.
+            if not job.status.is_terminal:
+                _finish_retirement(sched, job, JobStatus(retired or jstat),
+                                   journal)
+            sched.done_jobs[name] = job
+            continue
+        handle = running.get(name)
+        live = handle.num_workers if handle else 0
+        known = jstat is not None or name in state.booked
+        booked = state.booked.get(name, 0)
+        if not known:
+            # Admitted to the store, never accepted pre-crash (the
+            # CREATE event died with the process): re-accept — an
+            # admitted job is never lost.
+            _add_divergence(divergences, "unjournaled_job", name)
+            n = live
+            if live:
+                _add_divergence(divergences, "backend_running_unbooked",
+                                name)
+        elif live > 0 and booked == 0:
+            _add_divergence(divergences, "backend_running_unbooked", name)
+            n = live
+        elif live > 0 and booked != live:
+            _add_divergence(divergences, "chips_diverged", name)
+            n = live
+        elif live == 0 and (booked > 0 or jstat == JobStatus.RUNNING.value):
+            _add_divergence(divergences, "backend_lost_job", name)
+            n = 0
+        else:
+            n = booked
+        if job.status == JobStatus.SUBMITTED and n > 0:
+            # Two declared edges: accept, then adopt the live run.
+            lifecycle.transition(job, JobStatus.WAITING, reason="resume",
+                                 chips=0, tracer=sched.tracer,
+                                 pool=sched.pool_id, journal=journal)
+        lifecycle.transition(
+            job, JobStatus.RUNNING if n > 0 else JobStatus.WAITING,
+            reason="resume", chips=n, tracer=sched.tracer,
+            pool=sched.pool_id, journal=journal)
+        job.metrics.last_update_time = sched.clock.now()
+        sched.ready_jobs[name] = job
+        sched.job_num_chips.commit(name, n)
+    # Hysteresis/cooldown clocks: exactly the pre-crash values.
+    sched._last_resize_at.update(
+        {j: t for j, t in state.resize_at.items()
+         if j in sched.ready_jobs})
+    # Placement occupancy: the backend's live bindings are ground truth
+    # (they're what physically occupies chips); journal intent that
+    # differs is audited — the resume pass re-places from scratch.
+    # Restores are capacity-checked: a crash mid-fault can leave the
+    # backend itself briefly overlapped (the recovery_pending window),
+    # and the recovered manager must never mirror an oversubscription —
+    # the overflowing job's binding is left unrestored (audited), and
+    # the armed resume pass re-places it.
+    if sched.placement_manager is not None:
+        pm = sched.placement_manager
+        free = {h: hs.total_slots for h, hs in pm.host_states.items()}
+        restore_map = {}
+        for name in sorted(running):
+            handle = running[name]
+            if name not in sched.ready_jobs or not handle.placements:
+                continue
+            pairs = [(h, int(n)) for h, n in handle.placements]
+            if all(free.get(h, 0) >= n for h, n in pairs):
+                for h, n in pairs:
+                    free[h] -= n
+                restore_map[name] = pairs
+                intent = state.placements.get(name)
+                if intent is not None and sorted(intent) != sorted(pairs):
+                    _add_divergence(divergences, "placement_diverged",
+                                    name)
+            else:
+                _add_divergence(divergences, "placement_diverged", name)
+        pm.restore(restore_map)
+    sched._placement_dirty = True
+    sched._bump_state_version()
+    # A retired (deleted/completed) job the backend still runs: the
+    # crash beat the backend stop. Reap it best-effort — leaving it
+    # would strand its chips outside every table (the tombstone keeps
+    # it out of the ready queue, so nothing else will ever stop it).
+    for name in sorted(running):
+        if name in state.retired or name in sched.done_jobs:
+            try:
+                sched.backend.stop_job(name)
+            except Exception:  # noqa: BLE001 - reap is best-effort; the
+                pass           # backend's own monitor collects stragglers
+    duration = _walltime.monotonic() - t0
+    journal.append("jrecover", {"divergences": len(divergences),
+                                "torn_tail": state.torn_tail})
+    rec = {
+        "kind": "recovery_report",
+        "schema": obs_audit.SCHEMA_VERSION,
+        "ts": sched.clock.now(),
+        "pool": sched.pool_id,
+        "epoch": journal.epoch,
+        "last_seq": state.last_seq,
+        "records": state.records,
+        "torn_tail": state.torn_tail,
+        "stale_records": state.stale_records,
+        "jobs": len(sched.ready_jobs),
+        "divergences": divergences,
+        "duration_ms": round(duration * 1000.0, 3),
+    }
+    sched.tracer.emit(dict(rec))
+    sched._last_recovery_report = rec
+    # The recovered tables AS REBUILT, before the resume pass below
+    # rebalances anything — what the model checker compares against the
+    # pre-crash state at a quiescent crash point.
+    sched._recovered_tables = logical_tables(sched)
+    if sched.m_recovery_seconds is not None:
+        sched.m_recovery_seconds.set(duration)
+    sched.trigger_resched("resume")
+    return rec
+
+
+def logical_tables(sched) -> Tuple:
+    """The scheduler state recovery promises to reproduce at a
+    quiescent crash point: statuses, bookings, done set, and live
+    jobs' resize clocks — hashable, order-canonical."""
+    ready = {n: j.status.value for n, j in sched.ready_jobs.items()}
+    return (tuple(sorted(sched.job_num_chips.snapshot().items())),
+            tuple(sorted(ready.items())),
+            tuple(sorted((n, j.status.value)
+                         for n, j in sched.done_jobs.items())),
+            tuple(sorted((n, round(sched._last_resize_at.get(n, 0.0), 9))
+                         for n in ready)))

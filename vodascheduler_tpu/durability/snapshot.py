@@ -1,0 +1,95 @@
+"""Journal snapshot + compaction (doc/durability.md "Compaction").
+
+A snapshot is the journal's replayed `JournalState` serialized to one
+JSON file beside the active segment (`<path>.snap`), written atomically
+(tmp + fsync + rename). Compaction folds the journal into a fresh
+snapshot and truncates the active segment to records AFTER the
+snapshot's `last_seq`, so recovery replays O(live jobs) instead of
+O(history).
+
+Crash windows are all safe, by construction:
+
+- crash before the snapshot rename: old snapshot + full journal —
+  recovery replays more, loses nothing;
+- crash after the rename, before the segment truncate: new snapshot +
+  full journal — replay skips records with seq <= last_seq (seq-based
+  dedup), loses nothing;
+- crash mid-truncate: the rewrite is itself tmp + rename.
+
+Tombstones survive compaction (the PR's regression class): a retired
+job (`jretire` — delete/complete) is carried in the snapshot's
+`retired` map, never silently dropped, so a crash-recover-compact-
+crash-recover cycle cannot resurrect a deleted job. The `granted` set
+(every job the journal EVER booked chips for) is carried too — the
+model checker's write-ahead invariant (`recovery_unjournaled_grant`)
+needs grant history across compactions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+SNAPSHOT_SCHEMA = 1
+
+
+def load_snapshot(journal) -> Optional[dict]:
+    """The journal's latest snapshot dict, or None. Memory journals
+    keep theirs on the storage object (the model checker's world)."""
+    path = journal.snapshot_path()
+    if path is None:
+        return getattr(journal.storage, "snapshot", None)
+    try:
+        with open(path, encoding="utf-8") as f:
+            snap = json.load(f)
+    except FileNotFoundError:
+        return None
+    if snap.get("schema") != SNAPSHOT_SCHEMA:
+        raise ValueError(
+            f"snapshot schema {snap.get('schema')!r} != {SNAPSHOT_SCHEMA} "
+            f"({path}): refusing to guess (recovery fails loudly)")
+    return snap
+
+
+def write_snapshot(journal, state) -> dict:
+    """Serialize a JournalState atomically as the journal's snapshot."""
+    snap = dataclasses.asdict(state)
+    # Non-JSON-native containers -> canonical JSON shapes.
+    snap["granted"] = sorted(state.granted)
+    snap["placements"] = {j: [list(p) for p in pairs]
+                          for j, pairs in state.placements.items()}
+    snap["schema"] = SNAPSHOT_SCHEMA
+    snap["ts"] = journal.clock.now()
+    path = journal.snapshot_path()
+    if path is None:
+        journal.storage.snapshot = snap
+        return snap
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(snap, f, separators=(",", ":"), default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return snap
+
+
+def compact(journal) -> dict:
+    """Fold the journal into a snapshot and truncate the active
+    segment to records after it. Caller holds the journal lock
+    (Journal.maybe_compact)."""
+    from vodascheduler_tpu.durability.recover import read_state
+    from vodascheduler_tpu.durability.journal import frame
+
+    state = read_state(journal)
+    snap = write_snapshot(journal, state)
+    keep = bytearray()
+    for rec in journal.records():
+        if int(rec.get("seq", 0)) > state.last_seq:
+            keep.extend(frame(json.dumps(
+                rec, separators=(",", ":"), default=str).encode()))
+    journal._records_cache = None
+    journal.storage.replace(bytes(keep))
+    journal.append("jsnap", {"snapshot_seq": state.last_seq})
+    return snap

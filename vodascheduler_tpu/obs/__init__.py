@@ -17,8 +17,10 @@ See doc/observability.md.
 """
 
 from vodascheduler_tpu.obs.audit import (  # noqa: F401
+    JOURNAL_KINDS,
     PHASE_NAMES,
     REASON_CODES,
+    RECOVERY_REASONS,
     ROUTE_REASONS,
     SPAN_NAMES,
     STATUS_REASONS,

@@ -101,6 +101,43 @@ ROUTE_REASONS = frozenset({
     "router_disabled",   # VODA_FLEET_ROUTER=0: static default-pool path
 })
 
+# The durability plane's write-ahead journal record kinds
+# (doc/durability.md "Record catalog"): `Journal.append` REJECTS a kind
+# outside this set at write time, recover.read_state understands
+# exactly these, and vodalint's vocab rule checks journal-append
+# literals forward and sweeps usage in reverse — the journal can never
+# grow records recovery doesn't know how to replay.
+JOURNAL_KINDS = frozenset({
+    "jstatus",   # one lifecycle transition (job, from, to, reason, chips)
+    "jbook",     # one BookingLedger commit/release (op, job, chips)
+    "jpass",     # one decide-phase commit_pass, as a delta (set, del)
+    "jplace",    # placement-intent delta after a placed pass (set, del)
+    "jclock",    # resize (hysteresis/cooldown) clock re-arm (job, at)
+    "jretire",   # terminal tombstone: delete/complete survives compaction
+    "jroute",    # one fleet-router placement decision (job, pool)
+    "jlease",    # leadership milestone (op, holder; epoch in envelope)
+    "jrecover",  # recovery completed (divergence count, torn tail)
+    "jsnap",     # compaction marker (snapshot_seq)
+})
+
+# Why crash recovery took a corrective step (the audited divergence
+# classes of recover.recover_scheduler — doc/durability.md "Recovery").
+# Closed both ways like the other vocabularies: `_add_divergence`
+# literals are checked forward by vodalint, usage swept in reverse, and
+# a recovery_report naming an unknown code fails validation.
+RECOVERY_REASONS = frozenset({
+    "backend_lost_job",          # journal says running, backend lost it
+    "backend_running_unbooked",  # backend runs it, journal booked nothing
+    "chips_diverged",            # booked size != live size (crash mid-scale)
+    "placement_diverged",        # journal intent != live binding (mid-
+                                 # migration crash or a deferred re-binding)
+    "unjournaled_job",           # admitted to the store, never accepted
+                                 # pre-crash: re-accepted, never lost
+    "journal_torn_tail",         # a torn final record was dropped
+    "stale_epoch_dropped",       # a deposed leader's stale-epoch records
+                                 # were rejected at replay
+})
+
 # The decide/actuate sub-stages the performance observatory times
 # (obs/profile.py; doc/observability.md "Performance observatory").
 # Closed both ways like the other vocabularies: every literal
@@ -169,6 +206,9 @@ _REQUIRED_PERF_FIELDS = ("kind", "schema", "ts", "pool", "seq", "trace_id",
                          "actuate_ms", "num_jobs", "phases")
 _REQUIRED_ROUTE_FIELDS = ("kind", "schema", "ts", "job", "pool", "reasons",
                           "scores")
+_REQUIRED_RECOVERY_FIELDS = ("kind", "schema", "ts", "pool", "epoch",
+                             "last_seq", "records", "torn_tail",
+                             "divergences", "duration_ms")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -192,7 +232,29 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _validate_perf(rec)
     if kind == "fleet_route":
         return _validate_route(rec)
+    if kind == "recovery_report":
+        return _validate_recovery(rec)
     return [f"unknown record kind {kind!r}"]
+
+
+def _validate_recovery(rec: Dict[str, Any]) -> List[str]:
+    """One crash recovery (doc/durability.md): the journal's committed
+    prefix that was replayed and every audited corrective step the
+    backend reconciliation took — with its reason code drawn from the
+    closed RECOVERY_REASONS vocabulary."""
+    problems = _check_fields(rec, _REQUIRED_RECOVERY_FIELDS)
+    divergences = rec.get("divergences", ())
+    if not isinstance(divergences, list):
+        problems.append("divergences is not a list")
+        return problems
+    for d in divergences:
+        if not isinstance(d, dict) or "job" not in d or "reason" not in d:
+            problems.append(f"malformed divergence {d!r}")
+            continue
+        if d["reason"] not in RECOVERY_REASONS:
+            problems.append(f"unknown recovery reason {d['reason']!r} "
+                            f"(job {d.get('job')!r})")
+    return problems
 
 
 def _validate_route(rec: Dict[str, Any]) -> List[str]:

@@ -80,13 +80,19 @@ class FleetRouter:
                  enabled: Optional[bool] = None,
                  default_pool: Optional[str] = None,
                  tracer: Optional[obs_tracer.Tracer] = None,
-                 bus=None):
+                 bus=None, journal=None):
         self.schedulers = schedulers  # live dict, shared with the app
         self.enabled = config.FLEET_ROUTER if enabled is None else bool(enabled)
         self.default_pool = (config.DEFAULT_POOL if default_pool is None
                              else default_pool)
         self.tracer = tracer
         self.bus = bus
+        # Durability seam (doc/durability.md): committed routing
+        # decisions append `jroute` records to the fleet journal so a
+        # restarted control plane can audit where every admitted job
+        # was sent (the store's pool field is the recovery authority;
+        # the journal is the durable decision trail).
+        self.journal = journal
         self._lock = threading.Lock()
         self._routed_total = 0
         # In-flight correction: jobs this router has sent to a pool that
@@ -176,6 +182,14 @@ class FleetRouter:
                 if p["scores"]:
                     self._last_scores = dict(p["scores"])
         for p in pendings:
+            if self.journal is not None:
+                # FencedOut propagates (a deposed control plane must
+                # not keep admitting); storage errors only cost audit.
+                try:
+                    self.journal.append("jroute", {"job": p["job"],
+                                                   "pool": p["pool"]})
+                except OSError:
+                    log.exception("jroute append failed")
             self._emit(p["job"], p["pool"], p["reasons"], p["scores"])
 
     def abort_routes(self, pendings) -> None:

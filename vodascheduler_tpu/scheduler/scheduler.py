@@ -45,6 +45,7 @@ from vodascheduler_tpu.common import lifecycle
 from vodascheduler_tpu.common.lifecycle import BookingLedger
 from vodascheduler_tpu.common.metrics import Registry
 from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.durability.journal import FencedOut
 from vodascheduler_tpu.common.types import (
     EventVerb,
     JobStatus,
@@ -145,6 +146,7 @@ class Scheduler:
         resize_cooldown_seconds: float = DEFAULT_RESIZE_COOLDOWN_SECONDS,
         defrag_cross_host_threshold: int = 0,
         fractional_sharing: Optional[bool] = None,
+        journal=None,
         tracer: Optional[obs_tracer.Tracer] = None,
         actuation_workers: Optional[int] = None,
         actuation_parallel: Optional[bool] = None,
@@ -216,7 +218,21 @@ class Scheduler:
         # booking-release rule).
         self.ready_jobs: Dict[str, TrainingJob] = {}
         self.done_jobs: Dict[str, TrainingJob] = {}
-        self.job_num_chips: BookingLedger = BookingLedger()
+        # Durability plane (doc/durability.md): the write-ahead journal
+        # every transition(), ledger mutation, placement delta and
+        # resize-clock re-arm flows through (vodalint's `journal-seam`
+        # rule pins the call sites). None = ephemeral scheduler (tests,
+        # replay, model worlds without the crash profile).
+        self.journal = journal
+        self.job_num_chips: BookingLedger = BookingLedger(journal=journal)
+        # Last journaled placement intent per job — jplace records are
+        # deltas against this (a steady-state pass appends only moves).
+        self._journaled_placements: Dict[str, tuple] = {}
+        # The last crash recovery's audited report (recovery_report
+        # record) and as-rebuilt tables (before the resume pass),
+        # for /debug/journal and the model checker.
+        self._last_recovery_report: Optional[dict] = None
+        self._recovered_tables: Optional[tuple] = None
 
         # Host capacity (reference: TotalGpus via node informer).
         self.total_chips = 0
@@ -343,7 +359,16 @@ class Scheduler:
             bus.subscribe(pool_id, self._on_job_events, batch=True)
 
         if resume:
-            self._construct_status_on_restart()
+            if self.journal is not None and self.journal.has_state():
+                # Journal-backed recovery (doc/durability.md): replay
+                # the committed prefix, reconcile against the backend's
+                # live view, audit every corrective step.
+                from vodascheduler_tpu.durability.recover import (
+                    recover_scheduler,
+                )
+                recover_scheduler(self)
+            else:
+                self._construct_status_on_restart()
 
         self._start_ticker()
 
@@ -473,6 +498,23 @@ class Scheduler:
                            1 for name in list(self.ready_jobs)
                            if self._is_fractional(name))),
                        const_labels=pool_l)
+        # Durability plane (doc/durability.md): journal size + the last
+        # crash recovery's wall time. Registered only for journaled
+        # schedulers — a disabled journal must not export 0 bytes as if
+        # a healthy journal were empty.
+        self.m_recovery_seconds = None
+        if self.journal is not None:
+            registry.gauge(
+                "voda_scheduler_journal_bytes",
+                "Active write-ahead journal segment size (compaction "
+                "folds it into the snapshot past the bound)",
+                fn=lambda: float(self.journal.size_bytes()),
+                const_labels=pool_l)
+            self.m_recovery_seconds = registry.gauge(
+                "voda_scheduler_recovery_seconds",
+                "Wall time of the last journal-backed crash recovery "
+                "(replay + backend reconciliation)",
+                const_labels=pool_l)
 
     def _start_ticker(self) -> None:
         def tick() -> None:
@@ -499,10 +541,24 @@ class Scheduler:
     # pass's commit point — the alternative is a JOB_COMPLETED popping
     # bookkeeping that a wave worker is concurrently writing.
 
+    def _journal_fenced(self) -> bool:
+        """Whether this scheduler has been deposed: its journal's
+        fencing epoch moved past it (a standby took the lease). A
+        deposed scheduler stops itself — its in-memory state past the
+        fence is unjournalable by construction (append-before-apply),
+        and the new leader owns the journal's committed prefix."""
+        j = self.journal
+        if j is not None and j.fenced:
+            self._stopped = True
+            return True
+        return False
+
     def _locked_or_deferred(self, fn, *args) -> List[str]:
         """Run a _*_locked mutator under the lock, unless an actuation is
         in flight — then defer it (with its args) to the commit point.
         Returns the trigger reasons to fire once the lock is released."""
+        if self._journal_fenced():
+            return []
         with self._lock:
             if self._actuating_gen:
                 self._deferred_events.append((fn, args))
@@ -568,9 +624,24 @@ class Scheduler:
 
     # ---- job lifecycle ---------------------------------------------------
 
+    def _require_leadership(self) -> None:
+        """User-facing mutations on a DEPOSED scheduler must fail
+        loudly (the REST layer surfaces the error and the client
+        retries against the new leader) — an ack-and-drop would tell
+        the user their delete happened while the new leader keeps the
+        job running. Internal event paths keep the silent drop
+        (_locked_or_deferred): a deposed leader's backend events are
+        meaningless and the raise would only wedge monitor threads."""
+        if self._journal_fenced():
+            raise FencedOut(
+                f"pool {self.pool_id}: this scheduler was deposed (a "
+                f"newer leader holds the journal lease) — retry "
+                f"against the current leader")
+
     def create_training_job(self, name: str) -> None:
         """Accept a job announced by the admission service
         (reference: scheduler.go:845-890)."""
+        self._require_leadership()
         self._fire(self._locked_or_deferred(self._create_job_locked, name))
 
     def _create_job_locked(self, name: str) -> List[str]:
@@ -586,7 +657,7 @@ class Scheduler:
             return []
         lifecycle.transition(job, JobStatus.WAITING, reason="accepted",
                              chips=0, tracer=self.tracer,
-                             pool=self.pool_id)
+                             pool=self.pool_id, journal=self.journal)
         job.metrics.last_update_time = self.clock.now()
         self.store.update_job(job)
         self.ready_jobs[name] = job
@@ -597,15 +668,27 @@ class Scheduler:
 
     def delete_training_job(self, name: str) -> None:
         """User-initiated cancel (reference: scheduler.go:916-1000)."""
+        self._require_leadership()
         self._fire(self._locked_or_deferred(self._delete_job_locked, name))
 
     def _delete_job_locked(self, name: str) -> List[str]:
         job = self.ready_jobs.pop(name, None)
         if job is None:
             return []
-        chips = self.job_num_chips.release(name)
+        # Tombstone BEFORE the booking release: the CANCELED edge and
+        # the jretire record must hit the journal ahead of the jbook
+        # release, or a crash between them replays to "RUNNING with no
+        # booking" and recovery re-adopts the deleted job from the
+        # backend's live view — resurrection (doc/durability.md
+        # "Tombstones"). Recovery best-effort stops a retired job the
+        # backend still runs.
         lifecycle.transition(job, JobStatus.CANCELED, reason="user_delete",
-                             tracer=self.tracer, pool=self.pool_id)
+                             tracer=self.tracer, pool=self.pool_id,
+                             journal=self.journal)
+        if self.journal is not None:
+            self.journal.append("jretire", {"job": name,
+                                            "status": job.status.value})
+        chips = self.job_num_chips.release(name)
         job.finish_time = self.clock.now()
         self.store.update_job(job)
         self.done_jobs[name] = job
@@ -681,13 +764,14 @@ class Scheduler:
         if status == JobStatus.COMPLETED:
             lifecycle.transition(job, JobStatus.COMPLETED,
                                  reason="completed", tracer=self.tracer,
-                                 pool=self.pool_id)
+                                 pool=self.pool_id, journal=self.journal)
             self._job_done(job)
             self.m_jobs_completed.inc()
             reasons.append("job_completed")
         else:
             lifecycle.transition(job, JobStatus.FAILED, reason="failed",
-                                 tracer=self.tracer, pool=self.pool_id)
+                                 tracer=self.tracer, pool=self.pool_id,
+                                 journal=self.journal)
             self._job_done(job)
             self.m_jobs_failed.inc()
             reasons.append("job_failed")
@@ -696,6 +780,12 @@ class Scheduler:
 
     def _job_done(self, job: TrainingJob) -> None:
         """Reference: handleJobDoneInternal (scheduler.go:673-686)."""
+        if self.journal is not None:
+            # Durable tombstone (doc/durability.md): a completed/failed
+            # job survives crash-recover-compact-crash-recover as
+            # retired, never resurrected into the ready queue.
+            self.journal.append("jretire", {"job": job.name,
+                                            "status": job.status.value})
         job.finish_time = self.clock.now()
         self.store.update_job(job)
         self.done_jobs[job.name] = job
@@ -838,6 +928,8 @@ class Scheduler:
             self.rate_limit_seconds = seconds
 
     def _run_resched_now(self) -> None:
+        if self._journal_fenced():
+            return
         with self._lock:
             if (not self._resched_pending or self._stopped
                     or self._in_resched):
@@ -849,6 +941,14 @@ class Scheduler:
             self._actuating_gen = gen
         try:
             self.resched()
+        except FencedOut:
+            # Deposed mid-pass: the journal rejected a write-ahead
+            # append, so nothing past the fence was applied (append-
+            # before-apply). Stop; the new leader recovers from the
+            # journal's committed prefix.
+            log.warning("pool %s: journal fenced mid-pass — deposed "
+                        "leader stopping", self.pool_id)
+            self._stopped = True
         finally:
             with self._lock:
                 if self._actuating_gen == gen:
@@ -886,6 +986,18 @@ class Scheduler:
                     log.exception("deferred event %s%r failed; "
                                   "continuing with the rest",
                                   getattr(fn, "__name__", fn), args)
+            if self.journal is not None and not self._stopped:
+                # Compaction rides the pass commit point, off the
+                # decide path: fold the journal into a snapshot once
+                # the active segment outgrows its bound
+                # (doc/durability.md "Compaction").
+                try:
+                    self.journal.maybe_compact()
+                except FencedOut:
+                    self._stopped = True
+                except OSError:
+                    log.exception("journal compaction failed; the "
+                                  "active segment keeps growing")
             if rearm_at is not None:
                 # Re-triggered mid-pass (a Tiresias priority flip, a
                 # wave worker's retry): run again once the window opens —
@@ -1080,6 +1192,7 @@ class Scheduler:
                     placements = decision.placements
                     placed = True
                     self._placement_dirty = False
+                    self._journal_placements(placements)
             prof.mark_decide_end()
 
         # ---- actuate (lock released; re-acquired per bookkeeping) ----
@@ -1253,6 +1366,66 @@ class Scheduler:
             index = min(range(len(bins)), key=bins.__getitem__)
             bins[index] += cost
         return max(bins)
+
+    def _journal_placements(self, placements: Dict[str, List[Tuple[str, int]]]
+                            ) -> None:
+        """Append this pass's placement-intent delta (`jplace`) — only
+        bindings that CHANGED since the last journaled intent, so a
+        steady-state fleet pass appends its moves, not its whole map
+        (doc/durability.md "Record catalog").
+
+        Decide-window fast: the placement manager's persistent view
+        keeps the SAME list object for an untouched job across passes
+        (touched jobs get fresh lists), so an identity probe skips the
+        normalize+compare for the untouched 10k-job bulk — the delta
+        computation costs the pass's touched set, not the fleet."""
+        if self.journal is None:
+            return
+        journaled = self._journaled_placements
+        pre_len = len(journaled)
+        changed: Dict[str, List[List[object]]] = {}
+        new_key = False
+        for job, pairs in placements.items():
+            entry = journaled.get(job)
+            if entry is not None and entry[0] is pairs:
+                continue  # untouched: same persistent-view object
+            key = tuple(sorted((h, int(n)) for h, n in pairs))
+            if entry is not None and entry[1] == key:
+                entry[0] = pairs  # rebuilt but identical binding
+                continue
+            if entry is None:
+                new_key = True
+            journaled[job] = [pairs, key]
+            changed[job] = [list(p) for p in key]
+        # A removal implies the maps' sizes diverged or a key was added
+        # this pass (net-zero swap) — only then pay the O(n) sweep.
+        removed: List[str] = []
+        if new_key or pre_len != len(placements):
+            removed = [j for j in journaled if j not in placements]
+            for job in removed:
+                del journaled[job]
+        if changed or removed:
+            self.journal.append("jplace", {"set": changed, "del": removed})
+
+    def _arm_resize_clock(self, name: str) -> None:
+        """Re-arm the job's hysteresis/cooldown clock — write-ahead
+        journaled (`jclock`) so recovery restores the exact suppression
+        windows the pre-crash scheduler was honoring."""
+        at = self.clock.now()
+        if self.journal is not None:
+            self.journal.append("jclock", {"job": name, "at": at})
+        self._last_resize_at[name] = at
+
+    def journal_stats(self) -> Dict[str, object]:
+        """GET /debug/journal (doc/durability.md): journal size, last
+        seq, epoch, snapshot age, torn-tail count — plus the last crash
+        recovery's audited report when this process recovered."""
+        if self.journal is None:
+            return {"enabled": False}
+        stats = self.journal.stats()
+        if self._last_recovery_report is not None:
+            stats["last_recovery"] = dict(self._last_recovery_report)
+        return stats
 
     def _is_fractional(self, name: str) -> bool:
         """Whether `name`'s resolved resource class is fractional on
@@ -1507,7 +1680,7 @@ class Scheduler:
         with self._lock:
             self._add_reason(job_name, "migrated")
             self._pass_resize_seconds[job_name] = price
-            self._last_resize_at[job_name] = self.clock.now()
+            self._arm_resize_clock(job_name)
 
     def _apply_hysteresis(self, old: ScheduleResult, new: ScheduleResult) -> None:
         """Suppress small scale-outs of recently-resized running jobs (see
@@ -1731,7 +1904,8 @@ class Scheduler:
                 lifecycle.transition(job, JobStatus.WAITING,
                                      reason="backend_lost", chips=0,
                                      tracer=self.tracer,
-                                     pool=self.pool_id)
+                                     pool=self.pool_id,
+                                     journal=self.journal)
                 job.metrics.last_waiting_seconds = 0.0
                 self.store.update_job(job)
 
@@ -1752,7 +1926,8 @@ class Scheduler:
             self.m_job_restarts.inc()
             lifecycle.transition(job, JobStatus.RUNNING, reason="scheduled",
                                  chips=self.job_num_chips.get(name, 0),
-                                 tracer=self.tracer, pool=self.pool_id)
+                                 tracer=self.tracer, pool=self.pool_id,
+                                 journal=self.journal)
             job.metrics.last_chip_seconds = 0.0
             job.metrics.last_running_seconds = 0.0
             job.metrics.seconds_since_restart = 0.0
@@ -1761,7 +1936,7 @@ class Scheduler:
             # immediately satisfy the Tiresias promote test and bounce
             # back to queue 0).
             job.metrics.last_waiting_seconds = 0.0
-            self._last_resize_at[name] = self.clock.now()
+            self._arm_resize_clock(name)
             if job.metrics.running_seconds == 0:
                 job.metrics.first_start_time = self.clock.now()
             self.store.update_job(job)
@@ -1793,7 +1968,7 @@ class Scheduler:
             self._add_reason(name,
                              "resize_inplace" if path == ResizePath.INPLACE
                              else "resize_cold")
-            self._last_resize_at[name] = self.clock.now()
+            self._arm_resize_clock(name)
             if path == ResizePath.INPLACE:
                 # The job never stopped: no restart counted, and the
                 # preemption lease (seconds_since_restart) keeps running
@@ -1824,7 +1999,8 @@ class Scheduler:
                                      reason="preempted",
                                      chips=self.job_num_chips.get(name, 0),
                                      tracer=self.tracer,
-                                     pool=self.pool_id)
+                                     pool=self.pool_id,
+                                     journal=self.journal)
                 job.metrics.last_waiting_seconds = 0.0
                 self.store.update_job(job)
                 self._bump_state_version()
@@ -2095,7 +2271,7 @@ class Scheduler:
                 job,
                 JobStatus.RUNNING if n > 0 else JobStatus.WAITING,
                 reason="resume", chips=n, tracer=self.tracer,
-                pool=self.pool_id)
+                pool=self.pool_id, journal=self.journal)
             job.metrics.last_update_time = self.clock.now()
             self.ready_jobs[job.name] = job
             self.job_num_chips.commit(job.name, n)

@@ -71,16 +71,29 @@ class AdmissionShed(AdmissionError):
         self.retry_after = retry_after
 
 
+class NotLeader(AdmissionError):
+    """This control plane was deposed: a standby holds the leadership
+    lease (doc/durability.md). Admissions must refuse LOUDLY — the
+    store commit + bus publish would otherwise ack a mutation the
+    fenced scheduler then silently drops. REST maps this to 503 so the
+    client retries against the current leader."""
+
+
 class AdmissionService:
     def __init__(self, store: JobStore, bus: EventBus, clock: Clock,
                  registry: Optional[Registry] = None,
                  valid_pools: Optional[set] = None,
                  tracer: Optional[obs_tracer.Tracer] = None,
-                 router=None):
+                 router=None, deposed=None):
         self.store = store
         self.bus = bus
         self.clock = clock
         self.tracer = tracer
+        # Leadership probe (doc/durability.md): a zero-arg callable
+        # returning True when this process no longer holds the lease.
+        # Checked at every admission entry point; None = standalone
+        # deployment with no leadership plane.
+        self.deposed = deposed
         # Cross-pool admission router (scheduler/fleet.py FleetRouter,
         # doc/observability.md "Fleet decide"): specs naming no pool are
         # placed by fleet-wide score BEFORE the shed pre-check below —
@@ -180,10 +193,18 @@ class AdmissionService:
                 }
         return results
 
+    def _require_leadership(self) -> None:
+        if self.deposed is not None and self.deposed():
+            self.m_errors.inc()
+            raise NotLeader(
+                "this control plane was deposed (a standby holds the "
+                "leadership lease); retry against the current leader")
+
     def _admit_batch(self, specs: List[JobSpec],
                      on_admitted=None) -> List[Dict[str, str]]:
         if not specs:
             return []
+        self._require_leadership()
         # Cross-pool routing first: a spec that names no pool gets its
         # fleet-wide placement here, so the shed pre-check and the
         # validation below see the pool the job will actually land in.
@@ -381,6 +402,7 @@ class AdmissionService:
             log.exception("router abort_routes failed")
 
     def delete_training_job(self, name: str) -> None:
+        self._require_leadership()
         with timed(self.m_delete_duration):
             job = self.store.get_job(name)
             if job is None:

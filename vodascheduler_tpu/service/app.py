@@ -129,6 +129,46 @@ class VodaApp:
 
         self.allocator = ResourceAllocator(self.store, registry=self.registry)
 
+        # Durability plane (doc/durability.md): one leadership lease for
+        # the process (fencing epochs), one write-ahead journal per pool
+        # plus a fleet journal for router decisions. VODA_JOURNAL=0
+        # runs the ephemeral pre-durability control plane.
+        self.lease = None
+        self.journals: Dict[str, object] = {}
+        self.fleet_journal = None
+        if config.JOURNAL:
+            from vodascheduler_tpu.durability.journal import Journal
+            from vodascheduler_tpu.durability.leader import FileLease
+            from vodascheduler_tpu.durability.leader import LeaseHeld
+            self.lease = FileLease(
+                os.path.join(self.workdir, "leader.lease"),
+                holder=f"pid:{os.getpid()}",
+                ttl_seconds=config.LEASE_TTL_SECONDS, clock=self.clock)
+            # A crash restart arrives with the dead leader's lease
+            # still unexpired (the PRIMARY recovery scenario): wait it
+            # out, bounded by one TTL + slack, instead of dying. A
+            # lease that keeps being RENEWED past the deadline is a
+            # genuinely live leader — then two leaders journaling one
+            # workdir is the split brain fencing exists to prevent,
+            # and startup fails loudly.
+            deadline = self.clock.now() + config.LEASE_TTL_SECONDS + 2.0
+            while True:
+                try:
+                    self.lease.try_acquire()
+                    break
+                except LeaseHeld:
+                    if self.clock.now() >= deadline:
+                        raise
+                    log.info("waiting out the previous leader's lease "
+                             "(%s)", self.workdir)
+                    self.clock.sleep(1.0)
+            self.fleet_journal = Journal(
+                os.path.join(self.workdir, "journal", "fleet.wal"),
+                epoch=self.lease.epoch, fence=self.lease.current_epoch,
+                clock=self.clock, fsync=config.JOURNAL_FSYNC,
+                compact_bytes=config.JOURNAL_COMPACT_BYTES)
+            self.lease.announce(self.fleet_journal, op="acquire")
+
         # Pool set: explicit multi-pool spec, or the single-pool args
         # (reference: one scheduler Deployment per GPU type; here one
         # Scheduler per pool in-process, same shared store/bus).
@@ -207,13 +247,24 @@ class VodaApp:
                                   topology=ps.topology, clock=self.clock)
             pm = PlacementManager(pool_id=ps.name, topology=ps.topology,
                                   registry=self.registry)
+            jnl = None
+            if self.lease is not None:
+                from vodascheduler_tpu.durability.journal import Journal
+                jnl = Journal(
+                    os.path.join(self.workdir, "journal",
+                                 f"{ps.name}.wal"),
+                    epoch=self.lease.epoch,
+                    fence=self.lease.current_epoch, clock=self.clock,
+                    fsync=config.JOURNAL_FSYNC,
+                    compact_bytes=config.JOURNAL_COMPACT_BYTES)
+                self.journals[ps.name] = jnl
             sched = Scheduler(
                 pool_id=ps.name, backend=be, store=self.store,
                 allocator=self.allocator, clock=self.clock, bus=self.bus,
                 algorithm=ps.algorithm or algorithm,
                 rate_limit_seconds=rate_limit_seconds,
                 resume=resume, registry=self.registry,
-                placement_manager=pm, tracer=self.tracer)
+                placement_manager=pm, journal=jnl, tracer=self.tracer)
             self.backends[ps.name] = be
             self.placements[ps.name] = pm
             self.schedulers[ps.name] = sched
@@ -236,7 +287,8 @@ class VodaApp:
             FleetRouter,
         )
         self.router = FleetRouter(self.schedulers, tracer=self.tracer,
-                                  bus=self.bus)
+                                  bus=self.bus,
+                                  journal=self.fleet_journal)
         self.fleet = FleetCoordinator(self.schedulers, tracer=self.tracer,
                                       registry=self.registry,
                                       router=self.router)
@@ -244,7 +296,8 @@ class VodaApp:
                                           registry=self.registry,
                                           valid_pools=set(names),
                                           tracer=self.tracer,
-                                          router=self.router)
+                                          router=self.router,
+                                          deposed=self._deposed)
         # Chip telemetry on the shared /metrics endpoints (reference
         # delegates this to a separate nvidia_smi_exporter, SURVEY.md §5.5).
         # Collected only when this process may own a jax backend: hermetic
@@ -252,6 +305,12 @@ class VodaApp:
         # from the workers). On a real TPU host libtpu grants the chips to
         # one process — the training supervisors must win, not us.
         periodic = [(collector_interval_seconds, self._collect_and_resched)]
+        if self.lease is not None:
+            # Leader renewal at TTL/3; a failed renew means a standby
+            # took over — the journals fence on their next append and
+            # the schedulers stop themselves (doc/durability.md).
+            periodic.append((max(1.0, config.LEASE_TTL_SECONDS / 3.0),
+                             self._renew_lease))
         self.tpu_monitor = None
         if (hermetic_devices is not None
                 or os.environ.get("VODA_TPU_MONITOR") == "1"):
@@ -288,6 +347,21 @@ class VodaApp:
         self.allocator_server = make_allocator_server(
             self.allocator, self.registry, host=host, port=allocator_port)
 
+    def _deposed(self) -> bool:
+        """Whether a standby took the leadership lease: admissions on a
+        deposed control plane must 503 (retry against the current
+        leader), never ack a mutation the fenced scheduler drops
+        (doc/durability.md). One small lease-file read per admission
+        request (the batch path checks once per burst)."""
+        return (self.lease is not None
+                and self.lease.current_epoch() != self.lease.epoch)
+
+    def _renew_lease(self) -> None:
+        if self.lease is not None and not self.lease.renew():
+            log.warning("leadership lease lost (a standby took over); "
+                        "admissions now answer 503 and the schedulers "
+                        "fence on their next journal append")
+
     def _collect_and_resched(self) -> None:
         """Collector pass; fresh curves can change info-driven allocations
         (reference: collector writes Mongo, next resched reads it §3.5)."""
@@ -321,6 +395,14 @@ class VodaApp:
             if hasattr(be, "close"):
                 be.close()
         self.store.flush()
+        for jnl in self.journals.values():
+            jnl.close()
+        if self.fleet_journal is not None:
+            self.fleet_journal.close()
+        if self.lease is not None:
+            # Clean shutdown: expire the lease now so a standby takes
+            # over without waiting out the TTL.
+            self.lease.release()
 
 
 def main(argv=None) -> int:

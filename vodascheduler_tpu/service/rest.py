@@ -39,6 +39,7 @@ from vodascheduler_tpu.service.admission import (
     AdmissionError,
     AdmissionService,
     AdmissionShed,
+    NotLeader,
 )
 
 log = logging.getLogger(__name__)
@@ -135,6 +136,12 @@ class RestServer:
                     status, payload = result[0], result[1]
                     if len(result) > 2:
                         headers = result[2]
+                except NotLeader as e:
+                    # Deposed control plane (doc/durability.md): never
+                    # ack a mutation the fenced scheduler would drop —
+                    # 503 tells the client to retry against the
+                    # current leader.
+                    status, payload = 503, {"error": str(e)}
                 except AdmissionShed as e:
                     # Backpressure (doc/observability.md "Ingestion
                     # plane"): the pool's event queue is past its shed
@@ -457,6 +464,14 @@ def make_scheduler_server(scheduler, registry: Registry,
         n = int(query.get("n", ["20"])[0])
         return 200, pick(body, query).profile_records(n)
 
+    def debug_journal(body, query):
+        """The durability plane's health (doc/durability.md): journal
+        size, last seq, fencing epoch, snapshot age, torn-tail count,
+        and the last crash recovery's audited report. Backs the
+        `voda top` durability line; `voda fsck` is the offline
+        counterpart."""
+        return 200, pick(body, query).journal_stats()
+
     def debug_fleet(body, query):
         """One fleet view over every pool (doc/observability.md "Fleet
         decide"): lock-free per-pool load snapshot, per-pool decide/
@@ -479,6 +494,7 @@ def make_scheduler_server(scheduler, registry: Registry,
         ("GET", "/debug/trace"): debug_trace,
         ("GET", "/debug/trace/*"): debug_trace,
         ("GET", "/debug/profile"): debug_profile,
+        ("GET", "/debug/journal"): debug_journal,
         ("GET", "/debug/fleet"): debug_fleet,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
