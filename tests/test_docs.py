@@ -322,6 +322,78 @@ class TestFractionalSharingDoc:
             assert "VODA_FRACTIONAL_SHARING" in f.read()
 
 
+class TestLearnedModelsDoc:
+    """doc/learned-models.md is pinned two ways: every load-bearing
+    symbol/knob it names exists in code, and the plane's code-side
+    vocabulary (trigger, journal kind, record kind, gauge) is
+    documented in it."""
+
+    def _doc(self):
+        with open(os.path.join(REPO, "doc", "learned-models.md")) as f:
+            return f.read()
+
+    def test_observation_model_documented(self):
+        doc = self._doc()
+        for term in ("spread", "cotenancy", "fit_serial_seconds",
+                     "estimate_comms_fraction", "MIN_DELTA",
+                     "decayed_weight", "blend", "drift_exceeds_band",
+                     "DRIFT_MIN_WEIGHT", "model_version",
+                     "job_infos_for", "_refresh_learned_models",
+                     "learned_weight", "interference_weight_from_fraction",
+                     "LEARNED_FRACTION_WEIGHT_UNIT", "MAX_COMMS_WEIGHT",
+                     "_migration_unpaid"):
+            assert term in doc, f"learned-models term {term!r} missing"
+        # The documented estimation symbols exist.
+        from vodascheduler_tpu.metricscollector import learned
+        for sym in ("fit_serial_seconds", "estimate_comms_fraction",
+                    "estimate_interference_fraction", "blend",
+                    "decayed_weight", "drift_exceeds_band"):
+            assert hasattr(learned, sym), f"documented symbol {sym} gone"
+        from vodascheduler_tpu.placement import comms
+        assert hasattr(comms, "learned_weight")
+        assert hasattr(comms, "interference_weight_from_fraction")
+
+    def test_vocabulary_documented(self):
+        doc = self._doc()
+        from vodascheduler_tpu.obs import JOURNAL_KINDS, TRIGGERS
+        assert "model_drift_detected" in TRIGGERS
+        assert "jmodel" in JOURNAL_KINDS
+        for term in ("model_drift_detected", "jmodel", "whatif_report",
+                     "voda_job_model_drift_ratio",
+                     "voda explain --whatif", "/debug/whatif",
+                     "learned_models_ab", "mismatched_prior_trace",
+                     "detail.learned_models", "planner_overhead",
+                     "make perf-gate"):
+            assert term in doc, f"learned-models term {term!r} missing"
+
+    def test_knobs_documented_and_exist(self):
+        import vodascheduler_tpu.config as cfg
+        doc = self._doc()
+        for knob, attr in (
+                ("VODA_LEARNED_MODELS", "LEARNED_MODELS"),
+                ("VODA_MODEL_DRIFT_BAND", "MODEL_DRIFT_BAND"),
+                ("VODA_MODEL_CONFIDENCE_K", "MODEL_CONFIDENCE_K"),
+                ("VODA_MODEL_HALF_LIFE_SECONDS",
+                 "MODEL_HALF_LIFE_SECONDS")):
+            assert knob in doc, f"knob {knob} undocumented"
+            assert hasattr(cfg, attr), f"documented knob {knob} gone"
+
+    def test_cross_linked(self):
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            obs = f.read()
+        assert "learned-models.md" in obs
+        assert "whatif_report" in obs
+        with open(os.path.join(REPO, "doc", "get-started.md")) as f:
+            assert "VODA_LEARNED_MODELS" in f.read()
+        with open(os.path.join(REPO, "doc", "apis.md")) as f:
+            assert "/debug/whatif" in f.read()
+        with open(os.path.join(REPO, "doc", "durability.md")) as f:
+            assert "jmodel" in f.read()
+        with open(os.path.join(REPO, "vodascheduler_tpu", "service",
+                               "rest.py")) as f:
+            assert "/debug/whatif" in f.read()
+
+
 class TestDurabilityDoc:
     """doc/durability.md is pinned two ways: every journal record kind
     and recovery reason in the closed vocabularies is documented (and

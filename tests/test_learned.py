@@ -1,0 +1,601 @@
+"""Learned-model plane tests (doc/learned-models.md): the serial fit
+(incl. the sub-host min>1 regression), the fraction estimators, drift
+detection end-to-end on FakeClusterBackend, jmodel durability, learned
+consumption by the scheduler, and the what-if shadow planner."""
+
+import math
+
+import pytest
+
+from vodascheduler_tpu import config
+from vodascheduler_tpu.allocator import ResourceAllocator
+from vodascheduler_tpu.cluster.fake import (
+    FakeClusterBackend,
+    MetricsRow,
+    WorkloadProfile,
+)
+from vodascheduler_tpu.common.clock import VirtualClock
+from vodascheduler_tpu.common.events import EventBus
+from vodascheduler_tpu.common.job import JobConfig, JobSpec, base_job_info
+from vodascheduler_tpu.common.store import JobStore
+from vodascheduler_tpu.metricscollector import (
+    BackendRowSource,
+    MetricsCollector,
+)
+from vodascheduler_tpu.metricscollector import learned
+from vodascheduler_tpu.scheduler import Scheduler
+from vodascheduler_tpu.service import AdmissionService
+
+
+class TestSerialFit:
+    def test_single_count_keeps_linear_anchor(self):
+        fit = learned.fit_serial_seconds({4: 25.0})
+        assert fit == (100.0, 1.0)
+
+    def test_real_1chip_measurement_authoritative(self):
+        fit = learned.fit_serial_seconds({1: 97.0, 4: 30.0})
+        assert fit[0] == 97.0
+
+    def test_two_counts_fit_exponent(self):
+        # Ground truth: t1=100, e=0.8 -> t(n) = 100 / n^0.8.
+        t = {3: 100.0 / 3 ** 0.8, 6: 100.0 / 6 ** 0.8}
+        t1, e = learned.fit_serial_seconds(t)
+        assert abs(e - 0.8) < 1e-9
+        assert abs(t1 - 100.0) < 1e-6
+
+    def test_exponent_clamped(self):
+        # Superlinear-looking noise clamps to 1 (and the intercept is
+        # re-derived at the clamp, not the rejected slope).
+        t1, e = learned.fit_serial_seconds({2: 40.0, 4: 10.0})
+        assert e == 1.0
+        assert t1 > 0
+
+    def test_min_gt_1_nonpow2_regression(self):
+        """The sub-host fix (ISSUE satellite 1): a min=3 job measured
+        only at the fractional partitions 3 and 6 chips (never 1) must
+        anchor its serial time through the MEASURED scaling, not the
+        linear assumption. True exponent 0.8: the old linear anchor
+        t1 = t[3] * 3 overestimated by 3^0.2 (~25%), permanently —
+        a min>1 job never produces the 1-chip row that used to be the
+        only correction path."""
+        store = JobStore()
+        from vodascheduler_tpu.common.job import TrainingJob
+        name = "frac-20260101-000000"
+        spec = JobSpec(name=name,
+                       config=JobConfig(min_num_chips=3, max_num_chips=12,
+                                        epochs=10))
+        store.insert_job(TrainingJob.from_spec(spec, submit_time=0.0))
+        store.upsert_job_info(base_job_info(name, "frac", "pool"))
+        t1_true, e_true = 300.0, 0.8
+        rows = [
+            MetricsRow(name, 0, t1_true / 3 ** e_true, 3, 0),
+            MetricsRow(name, 1, t1_true / 3 ** e_true, 3, 0),
+            MetricsRow(name, 2, t1_true / 6 ** e_true, 6, 0),
+        ]
+
+        class Src:
+            def job_names(self):
+                return [name]
+
+            def rows(self, job):
+                return rows
+
+        collector = MetricsCollector(store, Src())
+        assert collector.collect_all() == 1
+        info = store.get_job_info(name)
+        linear_anchor = (t1_true / 3 ** e_true) * 3  # the old bias
+        # The fitted serial estimate recovers the truth, not the
+        # 25%-inflated linear anchor.
+        fitted_t1 = info.estimated_remaining_seconds / info.remaining_epochs
+        assert abs(fitted_t1 - t1_true) < 1e-6
+        assert fitted_t1 < linear_anchor - 1.0
+        # Relative gains across the measured partitions are exact.
+        assert abs(info.speedup[6] / info.speedup[3]
+                   - 2 ** e_true) < 1e-9
+        # Extrapolation: an unmeasured count reads the fitted power law
+        # blended halfway toward the prior (2 measured counts).
+        expected_12 = learned.blend(12.0, 12.0 ** e_true, 1.0,
+                                    confidence_k=1.0)
+        assert abs(info.speedup[12] - expected_12) < 1e-6
+        assert info.speedup[12] < 12.0
+
+
+class TestEstimators:
+    def test_comms_fraction_inverts_cost_model(self):
+        # Physics: t(sigma)/t(ref) = s^(f * dsigma).
+        s, f = 8.0, 0.4
+        t_ref = 10.0
+        t_obs = t_ref * s ** (f * 0.5)
+        est = learned.estimate_comms_fraction(t_obs, t_ref, s, 0.5)
+        assert abs(est - f) < 1e-9
+
+    def test_comms_fraction_guards(self):
+        assert learned.estimate_comms_fraction(10, 10, 8.0, 0.01) is None
+        assert learned.estimate_comms_fraction(10, 10, 1.0, 0.5) is None
+        # Super-ideal observation clamps to 0, never negative.
+        assert learned.estimate_comms_fraction(5.0, 10.0, 8.0, 0.5) == 0.0
+
+    def test_interference_fraction_inverts_cost_model(self):
+        fi = 0.35
+        t_ref = 10.0 / (1 - fi * 0.1)
+        t_obs = 10.0 / (1 - fi * 0.6)
+        est = learned.estimate_interference_fraction(t_obs, t_ref, 0.6, 0.1)
+        assert abs(est - fi) < 1e-9
+
+    def test_blend_confidence_curve(self):
+        assert learned.blend(0.2, 0.6, 0.0) == 0.2
+        mid = learned.blend(0.2, 0.6, config.MODEL_CONFIDENCE_K)
+        assert abs(mid - 0.4) < 1e-9
+        assert abs(learned.blend(0.2, 0.6, 1e9) - 0.6) < 1e-6
+
+    def test_recency_decay(self):
+        hl = config.MODEL_HALF_LIFE_SECONDS
+        assert learned.decayed_weight(0.0) == 1.0
+        assert abs(learned.decayed_weight(hl) - 0.5) < 1e-9
+        assert abs(learned.decayed_weight(2 * hl) - 0.25) < 1e-9
+
+    def test_drift_band(self):
+        assert not learned.drift_exceeds_band(2.0, 1.0)  # too few samples
+        assert learned.drift_exceeds_band(1.3, 5.0)
+        assert learned.drift_exceeds_band(0.7, 5.0)
+        assert not learned.drift_exceeds_band(1.1, 5.0)
+
+
+def _world(topology=None, algorithm="ElasticTiresias",
+           learned_models=None, hosts=2, chips=8):
+    clock = VirtualClock(start=1753760000.0)
+    store, bus = JobStore(), EventBus()
+    backend = FakeClusterBackend(clock, restart_overhead_seconds=1.0)
+    for i in range(hosts):
+        backend.add_host(f"h{i}", chips, announce=False)
+    sched = Scheduler("pool", backend, store, ResourceAllocator(store),
+                      clock, bus=bus, algorithm=algorithm,
+                      rate_limit_seconds=5.0,
+                      learned_models=learned_models)
+    admission = AdmissionService(store, bus, clock)
+    return clock, store, bus, backend, sched, admission
+
+
+class TestCollectorLearned:
+    def _rows_with_burden(self, name, t1=80.0, e=0.6, f=0.5, fi=0.0,
+                          n=8):
+        """Rows mimicking the simulator physics at count n: spread 0
+        then spread 0.5 — the variation the estimator identifies
+        from."""
+        rows = []
+        s = n ** e
+        half = (n // 2) ** e
+        # Two contiguous counts identify the exponent; then spread
+        # variation at n identifies the fraction against the fit.
+        rows.append(MetricsRow(name, 0, t1 / half, n // 2, 0.0))
+        for epoch in range(1, 3):
+            rows.append(MetricsRow(name, epoch, t1 / s, n, 0.0))
+        for epoch in range(3, 6):
+            rate = s ** (1 - f * 0.5)
+            rows.append(MetricsRow(name, epoch, t1 / rate, n,
+                                   0.0, spread=0.5))
+        return rows
+
+    def test_comms_fraction_learned_from_spread_variation(self):
+        clock, store, bus, backend, sched, admission = _world()
+        name = "j-20260101-000000"
+        backend.metrics_rows[name] = self._rows_with_burden(name)
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock)
+        assert collector.collect_all() == 1
+        info = store.get_job_info(name)
+        assert info.comms_fraction_weight > 0
+        assert abs(info.comms_fraction_est - 0.5) < 0.05
+        assert info.model_version == 1
+
+    def test_interference_learned_from_cotenancy_variation(self):
+        clock, store, bus, backend, sched, admission = _world()
+        name = "j-20260101-000000"
+        fi, n, t1 = 0.35, 2, 40.0
+        rows = []
+        for epoch in range(3):
+            rows.append(MetricsRow(name, epoch, t1 / n, n, 0.0))
+        for epoch in range(3, 6):
+            rows.append(MetricsRow(
+                name, epoch, t1 / (n * (1 - fi * 0.6)), n, 0.0,
+                cotenancy=0.6))
+        backend.metrics_rows[name] = rows
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock)
+        collector.collect_all()
+        info = store.get_job_info(name)
+        assert info.interference_fraction_weight > 0
+        assert abs(info.interference_fraction_est - fi) < 0.05
+
+    def test_prior_only_arm_learns_nothing_new(self):
+        """VODA_LEARNED_MODELS=0 semantics: measured-count curves still
+        refine (the reference's own loop), but no fraction estimation,
+        no extrapolation, no drift state, no model-version bump."""
+        clock, store, bus, backend, sched, admission = _world()
+        name = "j-20260101-000000"
+        backend.metrics_rows[name] = self._rows_with_burden(name)
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock, learned=False)
+        assert collector.collect_all() == 1
+        info = store.get_job_info(name)
+        assert info.comms_fraction_weight == 0.0
+        assert info.model_version == 0
+        assert store.model_version == 0
+        # Unmeasured counts keep the linear prior (no extrapolation).
+        assert info.speedup[16] == 16.0
+
+    def test_contiguous_rows_preferred_for_curves(self):
+        """A count observed both contiguous and spread keeps the
+        contiguous mean (spread measures placement, not scaling)."""
+        clock, store, bus, backend, sched, admission = _world()
+        name = "j-20260101-000000"
+        backend.metrics_rows[name] = [
+            MetricsRow(name, 0, 10.0, 4, 0.0),
+            MetricsRow(name, 1, 18.0, 4, 1.0, spread=0.8),
+        ]
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock)
+        collector.collect_all()
+        info = store.get_job_info(name)
+        assert info.epoch_seconds[4] == 10.0
+
+
+class TestDriftDetection:
+    def _drift_world(self):
+        clock, store, bus, backend, sched, admission = _world()
+        fired = []
+        collector = MetricsCollector(
+            store, BackendRowSource(backend), clock,
+            drift_trigger=lambda job: (
+                fired.append(job),
+                sched.trigger_resched("model_drift_detected"))[-1])
+        return clock, store, backend, sched, admission, collector, fired
+
+    def _mismatched_rows(self, name, t1=400.0, e=0.3):
+        """A family whose measured step times deliberately mis-match
+        the prior: 3 epochs at 4 chips anchor a (linear-looking)
+        model, then 3 epochs at 8 chips land 62% slower than the
+        model's prediction (true exponent 0.3 vs the inferred linear
+        scaling)."""
+        rows = [MetricsRow(name, i, t1 / 4 ** e, 4, 0.0)
+                for i in range(3)]
+        rows += [MetricsRow(name, 3 + i, t1 / 8 ** e, 8, 0.0)
+                 for i in range(3)]
+        return rows
+
+    def test_exactly_one_drift_resched_fires(self):
+        """ISSUE satellite 3: the mis-matched family trips the drift
+        band exactly once per episode (deduped under the rate limit —
+        two drifting jobs in one window coalesce into ONE
+        model_drift_detected pass), and the post-resched allocation
+        runs on the learned curve, not the prior."""
+        (clock, store, backend, sched, admission, collector,
+         fired) = self._drift_world()
+        names = []
+        for base in ("bad-a", "bad-b"):
+            name = admission.create_training_job(JobSpec(
+                name=base, pool="pool",
+                config=JobConfig(min_num_chips=2, max_num_chips=8,
+                                 epochs=50)))
+            names.append(name)
+        clock.advance(6.0)  # accept + first pass
+
+        # First collection: counts at 4 chips only — the model anchors,
+        # nothing to diverge from.
+        for name in names:
+            backend.metrics_rows[name] = self._mismatched_rows(name)[:3]
+        collector.collect_all()
+        assert fired == []
+
+        # Second collection: the 8-chip epochs arrive 62% slower than
+        # the anchored model predicts — BOTH jobs drift in one window.
+        for name in names:
+            backend.metrics_rows[name] = self._mismatched_rows(name)
+        audit_before = len(sched.audit_records(0))
+        collector.collect_all()
+        assert sorted(fired) == sorted(names)  # each job: one episode
+        clock.advance(12.0)  # the coalesced pass runs
+
+        drift_passes = [r for r in sched.audit_records(0)
+                        if "model_drift_detected" in r.get("triggers", ())]
+        assert len(drift_passes) == 1, [
+            r["triggers"] for r in sched.audit_records(0)[audit_before:]]
+
+        # Re-collecting the SAME rows re-fires nothing (episode dedup).
+        collector.collect_all()
+        assert sorted(fired) == sorted(names)
+
+        # The post-resched allocation consumed the learned curve: the
+        # attached info's speedup at the measured counts reflects the
+        # measured (deeply sublinear) scaling, not the linear prior.
+        for name in names:
+            info = store.get_job_info(name)
+            assert info.speedup[8] < 3.0  # true: 8^0.3 ~= 1.87; prior: 8
+            assert info.model_drift_ratio > 1.2
+        job = sched.ready_jobs[names[0]]
+        assert job.info is not None
+        assert job.info.speedup[8] < 3.0
+
+    def test_drift_gauge_exported(self):
+        from vodascheduler_tpu.common.metrics import Registry
+        from vodascheduler_tpu.common.job import TrainingJob
+        clock, store, bus, backend, sched, admission = _world()
+        registry = Registry()
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock, registry=registry, pool="pool")
+        name = "j-20260101-000000"
+        store.insert_job(TrainingJob.from_spec(JobSpec(
+            name=name, pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=8,
+                             epochs=50)), submit_time=0.0))
+        backend.metrics_rows[name] = [
+            MetricsRow(name, i, 100.0 / 4, 4, 0.0) for i in range(3)]
+        collector.collect_all()
+        backend.metrics_rows[name] = backend.metrics_rows[name] + [
+            MetricsRow(name, 3 + i, 100.0 / 4, 8, 0.0)
+            for i in range(3)]  # 8 chips, no faster: drifts vs linear
+        collector.collect_all()
+        text = registry.exposition()
+        assert "voda_job_model_drift_ratio" in text
+        assert f'job="{name}"' in text
+        # Terminal jobs' series are reaped (cardinality bound): mark
+        # the job done and the next pass drops the series + state.
+        from vodascheduler_tpu.common.types import JobStatus
+        job = store.get_job(name)
+        job.status = JobStatus.COMPLETED
+        store.update_job(job)
+        collector.collect_all()
+        assert f'job="{name}"' not in registry.exposition()
+        assert name not in collector._drift_epoch
+
+
+class TestJmodelDurability:
+    def test_jmodel_journaled_and_replayed(self):
+        from vodascheduler_tpu.durability.journal import (
+            Journal,
+            MemoryStorage,
+        )
+        from vodascheduler_tpu.durability.recover import read_state
+
+        clock, store, bus, backend, sched, admission = _world()
+        journal = Journal(storage=MemoryStorage())
+        name = "j-20260101-000000"
+        rows = TestCollectorLearned()._rows_with_burden(name)
+        backend.metrics_rows[name] = rows
+        collector = MetricsCollector(store, BackendRowSource(backend),
+                                     clock, journal=journal)
+        collector.collect_all()
+        kinds = [r.get("k") for r in journal.records()]
+        assert "jmodel" in kinds
+        state = read_state(journal)
+        assert name in state.models
+        payload = state.models[name]
+        assert abs(payload["cf_est"]
+                   - store.get_job_info(name).comms_fraction_est) < 1e-9
+        assert payload["epoch_seconds"]  # measured counts ride along
+
+    def test_recovery_restores_learned_state_into_store(self):
+        from vodascheduler_tpu.durability.recover import (
+            JournalState,
+            _restore_models,
+        )
+
+        class StubSched:
+            pool_id = "pool"
+            store = JobStore()
+
+        state = JournalState()
+        state.models["j-20260101-000000"] = {
+            "job": "j-20260101-000000", "category": "j", "pool": "pool",
+            "cf_est": 0.42, "cf_w": 5.0, "if_est": 0.1, "if_w": 2.0,
+            "drift": 1.3, "drift_w": 4.0, "stamp": 12.0, "version": 3,
+            "epoch_seconds": {"4": 25.0, "8": 16.0},
+            "step_seconds": {"4": 0.25}, "current_epoch": 5,
+        }
+        _restore_models(StubSched, state)
+        info = StubSched.store.get_job_info("j-20260101-000000")
+        assert info is not None
+        assert info.comms_fraction_est == 0.42
+        assert info.model_version == 3
+        assert info.epoch_seconds[4] == 25.0
+        assert info.speedup[8] > info.speedup[4] > 0
+        assert StubSched.store.model_version == 1
+        # A store doc that already caught up is never clobbered.
+        info.comms_fraction_est = 0.99
+        StubSched.store.upsert_job_info(info)
+        _restore_models(StubSched, state)
+        assert StubSched.store.get_job_info(
+            "j-20260101-000000").comms_fraction_est == 0.99
+
+
+class TestSchedulerConsumption:
+    def _seeded_world(self, learned_models=None):
+        from vodascheduler_tpu.placement import (
+            PlacementManager,
+            PoolTopology,
+        )
+        clock = VirtualClock(start=1753760000.0)
+        store, bus = JobStore(), EventBus()
+        backend = FakeClusterBackend(clock, restart_overhead_seconds=1.0)
+        topo = PoolTopology(torus_dims=(4, 2, 2), host_block=(2, 2, 1))
+        for c in topo.host_coords():
+            backend.add_host(topo.host_name(c), topo.chips_per_host,
+                             announce=False)
+        pm = PlacementManager("pool", topology=topo)
+        sched = Scheduler("pool", backend, store,
+                          ResourceAllocator(store), clock, bus=bus,
+                          placement_manager=pm, algorithm="ElasticFIFO",
+                          rate_limit_seconds=5.0,
+                          learned_models=learned_models)
+        admission = AdmissionService(store, bus, clock)
+        return clock, store, bus, backend, sched, admission
+
+    def test_learned_fraction_drives_weights_and_payback(self):
+        clock, store, bus, backend, sched, admission = self._seeded_world()
+        name = admission.create_training_job(JobSpec(
+            name="resnet50", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=2, epochs=9)))
+        clock.advance(6.0)
+        info = store.get_job_info(name) or base_job_info(
+            name, "resnet50", "pool")
+        # Measured far chattier than the resnet50 family table (0.04).
+        info.comms_fraction_est = 0.6
+        info.comms_fraction_weight = 50.0
+        info.interference_fraction_est = 0.5
+        info.interference_fraction_weight = 50.0
+        store.upsert_job_info(info)
+        store.bump_model_version()
+        requests = {name: sched.job_num_chips.get(name, 0) or 1}
+        sched._refresh_comms_weights(requests)
+        from vodascheduler_tpu.placement import comms as comms_mod
+        lf = sched._learned_fraction[name]
+        assert lf[0] > 0.5  # blended toward the measurement
+        profile = comms_mod.profile_for_category("resnet50")
+        assert sched._comms_weight[name] == comms_mod.learned_weight(
+            profile, lf[0])
+        assert sched._comms_weight[name] > profile.weight()
+        assert sched._interference_weight[name] == \
+            comms_mod.interference_weight_from_fraction(lf[1])
+
+    def test_prior_only_scheduler_ignores_learned_docs(self):
+        clock, store, bus, backend, sched, admission = self._seeded_world(
+            learned_models=False)
+        name = admission.create_training_job(JobSpec(
+            name="resnet50", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=2, epochs=9)))
+        clock.advance(6.0)
+        info = store.get_job_info(name) or base_job_info(
+            name, "resnet50", "pool")
+        info.comms_fraction_est = 0.6
+        info.comms_fraction_weight = 50.0
+        store.upsert_job_info(info)
+        store.bump_model_version()
+        requests = {name: 1}
+        sched._refresh_comms_weights(requests)
+        assert sched._learned_fraction == {}
+        from vodascheduler_tpu.placement import comms as comms_mod
+        assert sched._comms_weight[name] == \
+            comms_mod.profile_for_category("resnet50").weight()
+
+    def test_steady_state_refresh_is_one_version_compare(self):
+        clock, store, bus, backend, sched, admission = self._seeded_world()
+        name = admission.create_training_job(JobSpec(
+            name="resnet50", pool="pool",
+            config=JobConfig(min_num_chips=1, max_num_chips=2, epochs=9)))
+        clock.advance(6.0)
+        requests = {name: 1}
+        sched._refresh_comms_weights(requests)
+        calls = []
+        orig = store.job_infos_for
+        store.job_infos_for = lambda jobs: (calls.append(1),
+                                            orig(jobs))[-1]
+        sched._refresh_comms_weights(requests)  # version unchanged
+        assert calls == []
+        store.bump_model_version()
+        sched._refresh_comms_weights(requests)
+        assert calls == [1]
+
+
+class TestWhatifPlanner:
+    def _planned_world(self):
+        clock, store, bus, backend, sched, admission = \
+            TestSchedulerConsumption()._seeded_world()
+        name = admission.create_training_job(JobSpec(
+            name="llama8b", pool="pool",
+            config=JobConfig(min_num_chips=2, max_num_chips=8,
+                             epochs=50)))
+        clock.advance(6.0)
+        return clock, store, sched, name
+
+    def test_whatif_report_schema_and_content(self):
+        from vodascheduler_tpu.obs import audit as obs_audit
+
+        clock, store, sched, name = self._planned_world()
+        rec = sched.whatif(name)
+        assert obs_audit.validate_record(rec) == []
+        assert rec["job"] == name
+        assert rec["model"] == ("learned" if sched.learned_models
+                                else "prior")
+        chips = [c["chips"] for c in rec["candidates"]]
+        assert chips == sorted(chips)
+        assert rec["candidates_total"] >= len(chips) > 0
+        assert all(2 <= c <= 8 for c in chips)
+        assert rec["would_grant"] >= 0
+        assert rec["current_chips"] == sched.job_num_chips.get(name, 0)
+        # Modeled remaining grows as chips shrink (monotone sanity).
+        rem = [c["prior_remaining_s"] for c in rec["candidates"]]
+        assert rem == sorted(rem, reverse=True)
+
+    def test_whatif_unknown_job_raises(self):
+        clock, store, sched, name = self._planned_world()
+        with pytest.raises(KeyError):
+            sched.whatif("no-such-job")
+
+    def test_whatif_never_emits_invalid_schema(self):
+        """The planner validates its own record before emitting — a
+        schema break raises instead of polluting the trace stream."""
+        from vodascheduler_tpu.replay import whatif as whatif_mod
+
+        clock, store, sched, name = self._planned_world()
+        rec = whatif_mod.run_whatif(sched, name)
+        assert rec["kind"] == "whatif_report"
+
+    def test_whatif_rest_route(self):
+        from vodascheduler_tpu.service.rest import make_scheduler_server
+        from vodascheduler_tpu.common.metrics import Registry
+
+        clock, store, sched, name = self._planned_world()
+        server = make_scheduler_server(sched, Registry(), port=0)
+        handler = server.routes[("GET", "/debug/whatif/*")]
+        status, body = handler(None, {"__path__": [name]})
+        assert status == 200
+        assert body["job"] == name
+        status, body = handler(None, {"__path__": ["ghost"]})
+        assert status == 404
+
+    def test_learned_weight_helpers(self):
+        from vodascheduler_tpu.placement import comms as comms_mod
+
+        profile = comms_mod.profile_for_category("llama8b")
+        base = profile.weight()
+        # Measured chattier -> heavier, capped at MAX_COMMS_WEIGHT.
+        assert comms_mod.learned_weight(profile, 0.36) > base
+        assert comms_mod.learned_weight(profile, 0.9) \
+            <= comms_mod.MAX_COMMS_WEIGHT
+        # Measured at exactly the table: identical weight.
+        assert comms_mod.learned_weight(
+            profile, profile.comms_fraction) == base
+        # No byte profile: derived from the fraction at the unit.
+        assert comms_mod.learned_weight(None, 0.2) == round(
+            0.2 / comms_mod.LEARNED_FRACTION_WEIGHT_UNIT)
+        assert comms_mod.learned_weight(None, 0.0) == 0
+        assert comms_mod.interference_weight_from_fraction(0.35) == min(
+            comms_mod.MAX_INTERFERENCE_WEIGHT,
+            round(0.35 / comms_mod.INTERFERENCE_WEIGHT_UNIT))
+        assert comms_mod.interference_weight_from_fraction(0.35) == \
+            comms_mod.MAX_INTERFERENCE_WEIGHT
+
+
+class TestPerfPins:
+    def test_committed_learned_baseline_meets_pins(self):
+        """The committed perf baseline's schema-8 `learned` section:
+        10k decide p95 with learned lookups forced live every pass
+        stays under the 50 ms pin, and the planner column does not
+        inflate it past the gate bound (doc/learned-models.md)."""
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "doc", "perf_baseline.json")
+        with open(path) as f:
+            baseline = json.load(f)
+        assert baseline["schema"] >= 8
+        learned_pts = {c["n_jobs"]: c for c in baseline["learned"]}
+        assert 10000 in learned_pts
+        pt = learned_pts[10000]
+        assert pt["decide_wall_ms"]["p95"] < 50.0, pt
+        # The pass-yielding planner must not inflate the live tail
+        # (same bound shape as the gate's planner_overhead column).
+        assert pt["planner"]["decide_wall_ms"]["p95"] \
+            < pt["decide_wall_ms"]["p95"] * 1.5 + 25.0, pt
+        assert pt["planner"]["plans"] > 0

@@ -124,13 +124,16 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 7  # v7: + the recovery section
+        assert base["schema"] == 8  # v8: + the learned section
         assert base["fleet"], "fleet section missing (make perf-baseline)"
         assert base["fractional"], \
             "fractional section missing (make perf-baseline)"
         assert base["recovery"], \
             "recovery section missing (make perf-baseline; " \
             "doc/durability.md)"
+        assert base["learned"], \
+            "learned section missing (make perf-baseline; " \
+            "doc/learned-models.md)"
         assert base["tool"] == "scripts/perf_scale.py"
         assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}

@@ -111,6 +111,12 @@ def main(argv=None) -> int:
     p_explain.add_argument("name")
     p_explain.add_argument("-n", type=int, default=20,
                            help="max decisions to show (newest last)")
+    p_explain.add_argument("--whatif", action="store_true",
+                           help="what-if shadow plan instead of history: "
+                                "score the job's feasible chip counts on "
+                                "the placement-sensitive step-time model, "
+                                "learned vs prior (GET /debug/whatif/"
+                                "<job>; doc/learned-models.md)")
 
     p_fsck = sub.add_parser(
         "fsck",
@@ -222,6 +228,11 @@ def main(argv=None) -> int:
         report = _fsck(args.path)
         print(json.dumps(report, indent=1, default=str))
         return 1 if report["problems"] else 0
+    elif args.command == "explain" and args.whatif:
+        from urllib.parse import quote
+        out = _request(f"{args.scheduler_server}/debug/whatif/"
+                       f"{quote(args.name, safe='')}{pool_q}")
+        _print_whatif(out)
     elif args.command == "explain":
         from urllib.parse import quote
         out = _request(f"{args.scheduler_server}/debug/trace/"
@@ -415,6 +426,42 @@ def _print_fleet(stats: dict) -> None:
               f"decisions={router.get('decisions_total', 0)} [{mix or '-'}]")
         print(f"  route latency (last {ms.get('count', 0)}): "
               f"p50={ms.get('p50', 0.0):.4f}ms p99={ms.get('p99', 0.0):.4f}ms")
+
+
+def _print_whatif(rec: dict) -> None:
+    """Human rendering of one whatif_report (doc/learned-models.md):
+    the shadow allocator's would-be grant, the learned-vs-prior model
+    fractions, and the candidate table."""
+    print(f"what-if plan for {rec.get('job')} "
+          f"(pool {rec.get('pool')}, {rec.get('algorithm')}, "
+          f"model={rec.get('model')}):")
+    print(f"  current: {rec.get('current_chips')} chips "
+          f"(spread {rec.get('current_spread', 0.0)}); shadow allocator "
+          f"would grant {rec.get('would_grant')}")
+    print(f"  comms fraction: learned "
+          f"{rec.get('comms_fraction_learned')} vs prior "
+          f"{rec.get('comms_fraction_prior')}; drift ratio "
+          f"{rec.get('drift_ratio')}")
+    if rec.get("shadow_error"):
+        print(f"  (shadow decide failed: {rec['shadow_error']})")
+    header = (f"  {'CHIPS':>6}{'SPREAD':>8}{'STEP_X':>8}"
+              f"{'REMAIN_S':>12}{'PRIOR_S':>12}")
+    print(header)
+    for c in rec.get("candidates", ()):
+        marker = " <- current" if c.get("chips") == rec.get(
+            "current_chips") else (
+            " <- would grant" if c.get("chips") == rec.get("would_grant")
+            else "")
+        print(f"  {c.get('chips'):>6}{c.get('spread'):>8}"
+              f"{c.get('modeled_step_ratio'):>8}"
+              f"{c.get('modeled_remaining_s'):>12}"
+              f"{c.get('prior_remaining_s'):>12}{marker}")
+    total = rec.get("candidates_total", 0)
+    shown = len(rec.get("candidates", ()))
+    if total > shown:
+        print(f"  ({shown} of {total} feasible counts shown)")
+    print(f"  planned in {rec.get('duration_ms', 0.0):.1f}ms off the "
+          f"decide path")
 
 
 def _print_explain(job: str, payload: dict, limit: int = 20) -> None:

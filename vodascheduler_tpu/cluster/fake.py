@@ -100,6 +100,14 @@ class MetricsRow:
     workers: int
     timestamp: float
     step_time_sec: float = 0.0
+    # Placement context at the epoch's end (doc/learned-models.md): the
+    # normalized spread of the job's host set and the chip-weighted
+    # co-tenancy share — what the learned-model plane needs to decompose
+    # an observed step time into scaling vs placement vs interference.
+    # Real CSV rows default to 0.0 (contiguous/exclusive) until the
+    # trainer-side logger grows the columns.
+    spread: float = 0.0
+    cotenancy: float = 0.0
 
 
 @dataclasses.dataclass
@@ -395,7 +403,12 @@ class FakeClusterBackend(ClusterBackend):
         for h in touched:
             affected.update(self._occupancy.get(h, ()))
         affected.discard(name)
-        for other_name in affected:
+        # Sorted: set iteration is hash-order, and the re-armed epoch
+        # timers' insertion order breaks VirtualClock ties — an
+        # unsorted walk made replay differ across PYTHONHASHSEED
+        # (surfaced by the learned-model plane, whose telemetry->
+        # decision feedback amplifies tie-order microdifferences).
+        for other_name in sorted(affected):
             other = self.jobs.get(other_name)
             if other is None:
                 continue
@@ -722,12 +735,21 @@ class FakeClusterBackend(ClusterBackend):
         rate = self._effective_speedup(sim)
         clean_epoch_time = (sim.profile.epoch_seconds_at_1 / rate
                             if rate > 0 else now - sim.epoch_started_at)
+        # Step time the way a real trainer's logger reports it (mean
+        # step x steps/epoch backs the epoch figure), stamped with the
+        # placement context the learned-model plane decomposes against
+        # (doc/learned-models.md): the same spread/cotenancy the
+        # step-time model degraded this epoch's rate by.
+        steps = max(1, sim.spec.steps_per_epoch)
         self.metrics_rows[sim.spec.name].append(MetricsRow(
             job=sim.spec.name,
             epoch=sim.epochs_done - 1,  # 0-based like the reference CSV
             epoch_time_sec=clean_epoch_time,
             workers=sim.num_workers,
             timestamp=now,
+            step_time_sec=clean_epoch_time / steps,
+            spread=sim.comms_spread,
+            cotenancy=sim.cotenancy,
         ))
         sim.epoch_started_at = now
         sim.epoch_started_serial = sim.progress_serial

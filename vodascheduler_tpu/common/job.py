@@ -151,6 +151,27 @@ class JobInfo:
     step_seconds: Dict[int, float] = dataclasses.field(default_factory=dict)
     current_epoch: int = -1
     remaining_epochs: int = 0
+    # --- learned-model plane (doc/learned-models.md) ---------------------
+    # Online-estimated effective comms/interference fractions with their
+    # recency-decayed observation weights (metricscollector/learned.py):
+    # raw EWMA estimates — consumers blend them against the family prior
+    # through the confidence curve (learned.blend), so a single noisy
+    # epoch can't flip placement policy. weight 0.0 = never observed.
+    comms_fraction_est: float = 0.0
+    comms_fraction_weight: float = 0.0
+    interference_fraction_est: float = 0.0
+    interference_fraction_weight: float = 0.0
+    # EWMA measured/modeled step-time ratio (1.0 = the model predicts
+    # the job perfectly) and its observation weight — what the
+    # voda_job_model_drift_ratio gauge exports and the drift band
+    # judges.
+    model_drift_ratio: float = 1.0
+    model_drift_weight: float = 0.0
+    # Clock timestamp of the last learned-model update (recency decay
+    # anchors here) and a monotonic per-doc stamp consumers use to
+    # invalidate derived caches (the scheduler's weight memos).
+    model_stamp: float = 0.0
+    model_version: int = 0
 
     def speedup_at(self, n: int) -> float:
         return self.speedup.get(n, 0.0)

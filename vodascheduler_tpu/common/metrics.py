@@ -106,6 +106,16 @@ class Gauge:
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled series (for per-entity gauges whose entity
+        retired — a per-job series left forever is a cardinality
+        leak)."""
+        if not self.label_names:
+            return
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values.pop(key, None)
+
     def set_all(self, values: Dict[Tuple[str, ...], float]) -> None:
         """Atomically replace every labeled series (keys are label tuples
         in label_names order) — a concurrent scrape sees either the old

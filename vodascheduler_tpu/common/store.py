@@ -43,6 +43,20 @@ class JobStore:
         # foreign category is invisible to get_job_info's bucket walk
         # and must stay invisible to the batch path too).
         self._info_by_name: Dict[str, JobInfo] = {}
+        # Learned-model mutation stamp (doc/learned-models.md): bumped
+        # by the metrics collector whenever a job's LEARNED fields
+        # (fraction estimates, drift state) change — separate from
+        # `_version` so the scheduler's per-pass weight caches refresh
+        # only when a model actually moved, not on every metadata
+        # write. A steady-state 10k-job decide pays one int compare,
+        # and a pass after a collector update pays the CHANGED names
+        # (per-name stamps below), not a full-fleet rescan.
+        self._model_version = 0
+        self._model_name_versions: Dict[str, int] = {}
+        # Names pruned below this version are gone from the per-name
+        # map; a consumer whose last-seen version predates the floor
+        # must do a full refresh of its own working set.
+        self._model_floor = 0
 
     # -- job metadata (reference: job_metadata collection) -------------------
 
@@ -165,6 +179,50 @@ class JobStore:
         lock-free (int loads are atomic) — a racing write just makes the
         caller's cache comparison fail and rebuild."""
         return self._version
+
+    @property
+    def model_version(self) -> int:
+        """The learned-model mutation stamp (see __init__), read
+        lock-free like `version` — the scheduler compares it per pass
+        and batch-refreshes its placement-weight caches only when a
+        collector pass actually moved a model."""
+        return self._model_version
+
+    @property
+    def model_floor(self) -> int:
+        """Versions below this were pruned from the per-name map (see
+        bump_model_version); consumers behind it must full-refresh."""
+        return self._model_floor
+
+    def bump_model_version(self, name: Optional[str] = None) -> None:
+        """Collector hook: `name`'s learned-model fields changed —
+        invalidate consumers' derived caches for it. The per-name
+        stamp lets a consumer refresh only what moved; the map is
+        bounded (a clear raises the floor, forcing stragglers into one
+        full refresh instead of growing forever with retired jobs)."""
+        with self._lock:
+            self._model_version += 1
+            if name is not None:
+                self._model_name_versions[name] = self._model_version
+                if len(self._model_name_versions) > 100_000:
+                    self._model_name_versions.clear()
+                    self._model_floor = self._model_version
+            else:
+                # No name: everything may have moved (recovery's bulk
+                # restore) — raise the floor so consumers full-refresh.
+                self._model_name_versions.clear()
+                self._model_floor = self._model_version
+
+    def model_changes_since(self, version: int) -> Optional[List[str]]:
+        """Names whose learned model moved after `version`, or None
+        when `version` predates the prune floor (caller must
+        full-refresh its working set). One locked scan of the per-name
+        int map — ~µs per thousand tracked names."""
+        with self._lock:
+            if version < self._model_floor:
+                return None
+            return [n for n, v in self._model_name_versions.items()
+                    if v > version]
 
     def _dirty(self) -> None:  # persistence hook (subclasses extend)
         self._version += 1

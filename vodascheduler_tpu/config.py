@@ -148,6 +148,40 @@ MIGRATION_PAYBACK_SECONDS = _env_float(
 # fractional_sharing_ab bench row measures stranded capacity against.
 FRACTIONAL_SHARING = os.environ.get("VODA_FRACTIONAL_SHARING", "1") != "0"
 
+# --- Learned-model plane (doc/learned-models.md) ----------------------------
+# On (the default), the metrics collector refines each job's speedup
+# curve AND an effective comms/interference fraction online from the
+# step times it actually observed at each (size, placement-spread,
+# co-tenancy), with confidence-weighted blending against the family
+# prior — and the scheduler's placement weights, interference pricing,
+# and migration payback gate consume the blended estimates. Divergence
+# past the drift band triggers an audited `model_drift_detected`
+# resched. VODA_LEARNED_MODELS=0 is the prior-only A/B reference path:
+# assumed per-family tables, no fraction estimation, no drift rescheds
+# (the pre-learned behavior the learned_models_ab bench row measures
+# against).
+LEARNED_MODELS = os.environ.get("VODA_LEARNED_MODELS", "1") != "0"
+
+# Drift band: a job whose EWMA measured/modeled step-time ratio leaves
+# [1/band, band] (with enough samples) has outgrown its model — the
+# collector fires one audited `model_drift_detected` resched per drift
+# episode so the next pass re-plans on the refreshed curves.
+MODEL_DRIFT_BAND = _env_float("VODA_MODEL_DRIFT_BAND", "1.25")
+
+# Confidence half-point: a learned fraction with K effective samples
+# blends 50/50 with its family prior (weight = n/(n+K)); more samples
+# asymptotically trust the measurement. Guards a single noisy epoch
+# from flipping placement policy (one sample moves a third of the
+# way); kept low because identification needs burden VARIATION and a
+# short job only yields a handful of informative epochs.
+MODEL_CONFIDENCE_K = _env_float("VODA_MODEL_CONFIDENCE_K", "2")
+
+# Recency half-life of learned-model observations (seconds): sample
+# weight decays by half per half-life, so a workload whose behavior
+# shifted (new dataset, new phase) re-learns instead of averaging
+# against stale history forever.
+MODEL_HALF_LIFE_SECONDS = _env_float("VODA_MODEL_HALF_LIFE_SECONDS", "7200")
+
 # Durability plane (doc/durability.md). VODA_JOURNAL=0 disables the
 # write-ahead journal entirely (ephemeral control plane — the pre-PR-13
 # behavior); on, every transition/booking/placement mutation appends a

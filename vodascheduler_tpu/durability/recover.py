@@ -61,6 +61,11 @@ class JournalState:
     retired: Dict[str, str] = dataclasses.field(default_factory=dict)
     granted: Set[str] = dataclasses.field(default_factory=set)
     routes: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Learned-model state (doc/learned-models.md): newest jmodel payload
+    # per job. Kept across retirement on purpose — learned curves
+    # outlive the run (the store's category-fallback seeding inherits
+    # them for repeat submissions), so recovery must not drop them.
+    models: Dict[str, dict] = dataclasses.field(default_factory=dict)
     last_seq: int = 0
     epoch: int = 0
     records: int = 0
@@ -86,6 +91,7 @@ def read_state(journal) -> JournalState:
         state.retired = dict(snap.get("retired", {}))
         state.granted = set(snap.get("granted", ()))
         state.routes = dict(snap.get("routes", {}))
+        state.models = dict(snap.get("models", {}))
         state.last_seq = int(snap.get("last_seq", 0))
         state.epoch = int(snap.get("epoch", 0))
     for rec in journal.records():
@@ -150,6 +156,11 @@ def _apply_record(state: JournalState, rec: dict) -> None:
         state.resize_at.pop(job, None)
     elif kind == "jroute":
         state.routes[rec["job"]] = rec.get("pool", "")
+    elif kind == "jmodel":
+        # Newest-per-job wins (each record carries the full learned
+        # state, not a delta — see MetricsCollector._model_payload).
+        state.models[rec["job"]] = {k: v for k, v in rec.items()
+                                    if k not in ("k", "seq", "epoch", "ts")}
     # jlease / jsnap / jrecover carry no replayable scheduler state.
 
 
@@ -288,6 +299,7 @@ def recover_scheduler(sched) -> dict:
                 sched.backend.stop_job(name)
             except Exception:  # noqa: BLE001 - reap is best-effort; the
                 pass           # backend's own monitor collects stragglers
+    _restore_models(sched, state)
     duration = _walltime.monotonic() - t0
     journal.append("jrecover", {"divergences": len(divergences),
                                 "torn_tail": state.torn_tail})
@@ -315,6 +327,59 @@ def recover_scheduler(sched) -> dict:
         sched.m_recovery_seconds.set(duration)
     sched.trigger_resched("resume")
     return rec
+
+
+def _restore_models(sched, state: JournalState) -> None:
+    """Fold the journal's learned-model state (`jmodel`,
+    doc/learned-models.md) back into the store's job-info docs. The
+    journal was appended AHEAD of each store write (append-before-
+    apply), so the journal can only be fresher-or-equal — but the store
+    is itself persistent, so a doc whose model_version already matches
+    (or passed) the journal's is left alone rather than clobbered with
+    an equal copy."""
+    from vodascheduler_tpu.common.job import base_job_info
+
+    restored = 0
+    for job, payload in state.models.items():
+        version = int(payload.get("version", 0))
+        info = sched.store.get_job_info(job)
+        if info is not None and info.model_version >= version:
+            continue
+        if info is None:
+            info = base_job_info(job, payload.get("category", job),
+                                 payload.get("pool", sched.pool_id))
+        info.comms_fraction_est = float(payload.get("cf_est", 0.0))
+        info.comms_fraction_weight = float(payload.get("cf_w", 0.0))
+        info.interference_fraction_est = float(payload.get("if_est", 0.0))
+        info.interference_fraction_weight = float(payload.get("if_w", 0.0))
+        info.model_drift_ratio = float(payload.get("drift", 1.0))
+        info.model_drift_weight = float(payload.get("drift_w", 0.0))
+        info.model_stamp = float(payload.get("stamp", 0.0))
+        info.model_version = version
+        measured = {int(n): float(t) for n, t in
+                    (payload.get("epoch_seconds") or {}).items()}
+        if measured:
+            info.epoch_seconds = {**info.epoch_seconds, **measured}
+            info.step_seconds = {
+                **info.step_seconds,
+                **{int(n): float(t) for n, t in
+                   (payload.get("step_seconds") or {}).items()}}
+            from vodascheduler_tpu.metricscollector import learned
+            fit = learned.fit_serial_seconds(info.epoch_seconds)
+            if fit is not None:
+                info.speedup = dict(info.speedup)
+                info.efficiency = dict(info.efficiency)
+                for n, t in measured.items():
+                    if t > 0:
+                        info.speedup[n] = fit[0] / t
+                        info.efficiency[n] = info.speedup[n] / n
+        if "current_epoch" in payload:
+            info.current_epoch = max(info.current_epoch,
+                                     int(payload["current_epoch"]))
+        sched.store.upsert_job_info(info)
+        restored += 1
+    if restored:
+        sched.store.bump_model_version()
 
 
 def logical_tables(sched) -> Tuple:

@@ -36,6 +36,10 @@ TRIGGERS = frozenset({
     "priority_change",   # Tiresias promote/demote flipped a priority
     "algorithm_changed",  # PUT /algorithm
     "metrics_update",    # collector learned fresh speedup curves
+    "model_drift_detected",  # measured step time diverged from the
+                             # learned/prior model beyond the drift band
+                             # (doc/learned-models.md) — re-plan on the
+                             # refreshed curves
     "retry",             # a failed apply scheduled this retry pass
     "resume",            # crash-resume reconstruction
     "manual",            # untagged trigger_resched caller
@@ -115,6 +119,8 @@ JOURNAL_KINDS = frozenset({
     "jclock",    # resize (hysteresis/cooldown) clock re-arm (job, at)
     "jretire",   # terminal tombstone: delete/complete survives compaction
     "jroute",    # one fleet-router placement decision (job, pool)
+    "jmodel",    # one learned-model update (fractions, drift, measured
+                 # curves — doc/learned-models.md); newest-per-job wins
     "jlease",    # leadership milestone (op, holder; epoch in envelope)
     "jrecover",  # recovery completed (divergence count, torn tail)
     "jsnap",     # compaction marker (snapshot_seq)
@@ -209,6 +215,17 @@ _REQUIRED_ROUTE_FIELDS = ("kind", "schema", "ts", "job", "pool", "reasons",
 _REQUIRED_RECOVERY_FIELDS = ("kind", "schema", "ts", "pool", "epoch",
                              "last_seq", "records", "torn_tail",
                              "divergences", "duration_ms")
+# The what-if shadow planner's record (doc/learned-models.md "What-if
+# planner"): a read-only shadow decide scored off the decide critical
+# path — the allocator's would-be grant plus a candidate table modeled
+# under both the learned and the prior cost model.
+_REQUIRED_WHATIF_FIELDS = ("kind", "schema", "ts", "pool", "job",
+                           "algorithm", "current_chips", "would_grant",
+                           "model", "candidates", "duration_ms")
+_REQUIRED_WHATIF_CANDIDATE_FIELDS = ("chips", "spread",
+                                     "modeled_step_ratio",
+                                     "modeled_remaining_s",
+                                     "prior_remaining_s")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -234,7 +251,31 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _validate_route(rec)
     if kind == "recovery_report":
         return _validate_recovery(rec)
+    if kind == "whatif_report":
+        return _validate_whatif(rec)
     return [f"unknown record kind {kind!r}"]
+
+
+def _validate_whatif(rec: Dict[str, Any]) -> List[str]:
+    """One what-if shadow plan (doc/learned-models.md): candidate chip
+    counts for a job scored on the placement-sensitive step-time model
+    — closed candidate shape, like the fractional delta block."""
+    problems = _check_fields(rec, _REQUIRED_WHATIF_FIELDS)
+    if rec.get("model") not in ("learned", "prior"):
+        problems.append(f"unknown whatif model {rec.get('model')!r}")
+    candidates = rec.get("candidates", ())
+    if not isinstance(candidates, list):
+        problems.append("candidates is not a list")
+        return problems
+    for c in candidates:
+        if not isinstance(c, dict):
+            problems.append(f"candidate is not an object: {c!r}")
+            continue
+        for f in _REQUIRED_WHATIF_CANDIDATE_FIELDS:
+            if f not in c:
+                problems.append(
+                    f"candidate {c.get('chips')!r}: missing {f!r}")
+    return problems
 
 
 def _validate_recovery(rec: Dict[str, Any]) -> List[str]:

@@ -162,6 +162,46 @@ INTERFERENCE_WEIGHT_UNIT = 0.02
 MAX_INTERFERENCE_WEIGHT = 8
 
 
+# Learned-fraction weight unit (doc/learned-models.md): for a job with
+# NO family byte profile, one integer placement-weight unit per this
+# much learned comms fraction. Calibrated against the family tables
+# (llama8b: 9 byte-units at fraction 0.18 ~= 0.02/unit), so a learned
+# weight and a byte-derived weight price a hop comparably.
+LEARNED_FRACTION_WEIGHT_UNIT = 0.02
+
+
+def learned_weight(profile: Optional["CollectiveProfile"],
+                   fraction: float) -> int:
+    """Integer placement weight under a LEARNED effective comms
+    fraction (doc/learned-models.md): the family's byte-derived weight
+    rescaled by measured/assumed fraction when a byte profile exists
+    (the bytes are the best traffic shape we have; the fraction is what
+    measurement corrects), else derived from the fraction alone at the
+    calibrated unit. Same cap as the static path — the Hungarian
+    integer-score theorems (PR 8) hold unchanged, learned weights are
+    just different integers."""
+    if fraction <= 0.0:
+        return 0
+    if profile is not None and profile.comms_fraction > 0.0:
+        # Rescale the RAW bytes, then bucket: rescaling the already-
+        # rounded integer weight would pin a light family (byte weight
+        # 0) at 0 no matter how chatty the job measured.
+        scaled_bytes = (profile.bytes_per_chip * fraction
+                        / profile.comms_fraction)
+        return min(MAX_COMMS_WEIGHT,
+                   int(round(scaled_bytes / WEIGHT_UNIT_BYTES)))
+    return min(MAX_COMMS_WEIGHT,
+               int(round(fraction / LEARNED_FRACTION_WEIGHT_UNIT)))
+
+
+def interference_weight_from_fraction(fraction: float) -> int:
+    """Integer interference weight from a (learned or assumed)
+    interference fraction — the one bucketing rule, shared by the
+    static table path and the learned path."""
+    return min(MAX_INTERFERENCE_WEIGHT,
+               int(round(max(0.0, fraction) / INTERFERENCE_WEIGHT_UNIT)))
+
+
 def interference_fraction_for_category(category: str) -> float:
     """The co-tenant interference fraction of a job category; 0.0 when
     unknown (interference-free, the pre-fractional physics)."""
@@ -172,9 +212,8 @@ def interference_weight_for_category(category: str) -> int:
     """Integer placement interference weight (0..MAX_INTERFERENCE_WEIGHT):
     how much one foreign chip on a shared host costs this job in the
     _pick_host pricing (placement/manager.py)."""
-    fraction = interference_fraction_for_category(category)
-    return min(MAX_INTERFERENCE_WEIGHT,
-               int(round(fraction / INTERFERENCE_WEIGHT_UNIT)))
+    return interference_weight_from_fraction(
+        interference_fraction_for_category(category))
 
 
 def profile_for_category(category: str) -> Optional[CollectiveProfile]:

@@ -26,6 +26,7 @@ from vodascheduler_tpu.replay.simulator import (
     config5_preemptions,
 )
 from vodascheduler_tpu.replay.trace import (
+    mismatched_prior_trace,
     philly_like_trace,
     topology_mix_trace,
 )
@@ -179,6 +180,59 @@ def fractional_sharing_ab(
         "small_jct_ratio": round(
             sharing["small_avg_jct_s"] / base["small_avg_jct_s"], 4)
         if base["small_avg_jct_s"] else 1.0,
+    }
+    return rows
+
+
+def learned_models_ab(
+    num_jobs: int = 48,
+    seed: int = 20260804,
+    algorithm: str = "ElasticTiresias",
+    torus_dims: tuple = (4, 4, 4),
+    defrag_cross_host_threshold: int = 3,
+) -> Dict[str, Dict[str, object]]:
+    """The learned-models A/B (doc/learned-models.md "Proof"): replay
+    the mismatched-prior mix twice — online-learned speedup & comms
+    models ON (the default: the collector measures each job's real
+    comms/interference fractions from observed step times, the
+    scheduler's placement weights and migration payback gate consume
+    the blended estimates, drift rescheds re-plan on the corrected
+    model) vs the prior-only baseline (VODA_LEARNED_MODELS=0
+    semantics: assumed family tables, no drift) — same trace, same
+    pool, same knobs, same physics. The trace's families deliberately
+    mis-match their priors (heavies twice as comms-bound as their
+    table, fillers 4x as interference-bound), so the arms differ in
+    exactly one thing: whether the scheduler's cost model is measured
+    or assumed. bench.py attaches this as detail.learned_models; the
+    tier-1 guard pins learned beating prior-only on avg JCT."""
+    rows: Dict[str, Dict[str, object]] = {}
+    for label, enabled in (("learned", True), ("prior_only", False)):
+        trace = mismatched_prior_trace(num_jobs=num_jobs, seed=seed)
+        topology = PoolTopology(torus_dims=torus_dims, host_block=(2, 2, 1))
+        harness = ReplayHarness(
+            trace, algorithm=algorithm, topology=topology,
+            learned_models=enabled,
+            defrag_cross_host_threshold=defrag_cross_host_threshold)
+        r = harness.run()
+        rows[label] = {
+            "avg_jct_s": round(r.avg_jct_seconds, 1),
+            "p95_jct_s": round(r.p95_jct_seconds, 1),
+            "comms_penalty_mean": r.comms_penalty_mean,
+            "interference_penalty_mean": r.interference_penalty_mean,
+            "steady_state_util": round(r.steady_state_utilization, 4),
+            "drift_rescheds": r.drift_rescheds_total,
+            "completed": r.completed,
+            "failed": r.failed,
+            "restarts": r.restarts_total,
+        }
+    learned, prior = rows["learned"], rows["prior_only"]
+    rows["win"] = {
+        "jct_ratio": round(learned["avg_jct_s"] / prior["avg_jct_s"], 4)
+        if prior["avg_jct_s"] else 1.0,
+        "penalty_delta": round(
+            (prior["comms_penalty_mean"] + prior["interference_penalty_mean"])
+            - (learned["comms_penalty_mean"]
+               + learned["interference_penalty_mean"]), 4),
     }
     return rows
 

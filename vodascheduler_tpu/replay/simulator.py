@@ -81,6 +81,12 @@ class ReplayReport:
     # the stranded capacity sharing recovers.
     fractional_sharing: bool = True
     interference_penalty_mean: float = 0.0
+    # Learned-model plane (doc/learned-models.md): whether online
+    # refinement + consumption was on for this run (off = the
+    # prior-only learned_models_ab baseline arm), and how many drift
+    # episodes fired an audited model_drift_detected resched.
+    learned_models: bool = True
+    drift_rescheds_total: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -161,6 +167,14 @@ class ReplayHarness:
         # hosts simply have no co-tenants to interfere with), so both
         # arms are judged under the same cost model.
         fractional_sharing: Optional[bool] = None,
+        # Learned-model plane (doc/learned-models.md): None = the
+        # environment default (VODA_LEARNED_MODELS, on unless 0);
+        # False forces the prior-only reference path — the
+        # learned_models_ab A/B arm: no fraction estimation, no drift
+        # rescheds, and the scheduler's placement weights / payback
+        # gate read the assumed family tables. The SIMULATOR's physics
+        # stays identical either way (physics is not a policy knob).
+        learned_models: Optional[bool] = None,
     ):
         self.trace = list(trace)
         self.algorithm = algorithm
@@ -217,6 +231,7 @@ class ReplayHarness:
                 else resize_cooldown_seconds),
             defrag_cross_host_threshold=defrag_cross_host_threshold,
             fractional_sharing=fractional_sharing,
+            learned_models=learned_models,
             tracer=self.tracer,
             # A live pass occupies real time while its actuation waves
             # run; under the VirtualClock it would occupy none, letting
@@ -226,9 +241,15 @@ class ReplayHarness:
             # engine paid the sum) against the next rate-limit window.
             price_actuation=True)
         self.admission = AdmissionService(self.store, self.bus, self.clock)
+        # The collector inherits the scheduler's learned-models arm and
+        # fires the audited drift trigger at it (doc/learned-models.md)
+        # — one knob decides the whole A/B arm.
         self.collector = MetricsCollector(
             self.store, BackendRowSource(self.backend), self.clock,
-            interval_seconds=collector_interval_seconds)
+            interval_seconds=collector_interval_seconds,
+            learned=self.scheduler.learned_models,
+            drift_trigger=lambda job: self.scheduler.trigger_resched(
+                "model_drift_detected"))
         self.collector.start()
 
         self._submitted: List[str] = []
@@ -411,4 +432,6 @@ class ReplayHarness:
                 self.backend.interference_penalty_chip_seconds
                 / self.backend.busy_chip_seconds, 4)
             if self.backend.busy_chip_seconds > 0 else 0.0,
+            learned_models=self.scheduler.learned_models,
+            drift_rescheds_total=self.collector.drift_fired_total,
         )

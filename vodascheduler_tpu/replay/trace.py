@@ -224,6 +224,77 @@ def topology_mix_trace(
     return jobs
 
 
+def mismatched_prior_trace(
+    num_jobs: int = 48,
+    seed: int = 20260804,
+    arrival_rate_per_hour: float = 40.0,
+    heavy_fraction: float = 0.4,
+    heavy_comms_fraction: float = 0.5,
+    filler_interference_fraction: float = 0.35,
+    heavy_speedup_exponent: float = 0.65,
+) -> List[TraceJob]:
+    """The learned-models proof trace (doc/learned-models.md): the
+    bimodal topology mix, but with the jobs' TRUE placement physics
+    deliberately mis-matching the assumed family tables the prior-only
+    scheduler plans with.
+
+    - heavy llama8b/mixtral jobs really spend `heavy_comms_fraction`
+      (0.5) of a contiguous step on collectives — the family tables
+      assume 0.18/0.25, so the prior-only arm under-weights contiguity
+      and under-prices consolidation migrations (its payback gate keeps
+      deferring moves that would in fact repay);
+    - filler resnet50 jobs really lose `filler_interference_fraction`
+      (0.35) of throughput at full co-tenancy — the table assumes 0.08,
+      so the prior-only arm packs them onto shared hosts far too
+      cheaply.
+
+    Replaying this mix with learned models ON vs OFF (ReplayHarness
+    `learned_models`) under the SAME physics is the learned_models_ab
+    bench row: the learned arm measures the real fractions from the
+    step times it observes, re-weights placement, re-prices paybacks,
+    and drift-rescheds onto the corrected model.
+    """
+    from vodascheduler_tpu.replay.restart_costs import family_restart_costs
+
+    rng = random.Random(f"{seed}-mismatch")
+    restart_costs = family_restart_costs()
+    jobs: List[TraceJob] = []
+    t = 0.0
+    for _ in range(num_jobs):
+        t += rng.expovariate(arrival_rate_per_hour / 3600.0)
+        if rng.random() < heavy_fraction:
+            model = rng.choice(("llama8b", "mixtral"))
+            max_chips = rng.choice((16, 32))
+            min_chips = max(8, max_chips // 4)
+            epochs = rng.randint(4, 8)
+            comms = heavy_comms_fraction
+            interference = 0.0
+            exponent = heavy_speedup_exponent
+        else:
+            model = "resnet50"
+            max_chips = rng.choice((1, 2, 2))
+            min_chips = 1
+            epochs = rng.randint(4, 12)
+            comms = 0.0
+            interference = filler_interference_fraction
+            exponent = float(MODEL_FAMILIES[model]["exponent"])
+        fam = MODEL_FAMILIES[model]
+        jobs.append(TraceJob(
+            submit_offset_seconds=t,
+            model=model,
+            min_chips=min_chips,
+            max_chips=max_chips,
+            epochs=epochs,
+            epoch_seconds_at_1=float(fam["epoch_seconds"]),
+            speedup_exponent=exponent,
+            restart_overhead_seconds=restart_costs[model].restart_s,
+            inplace_overhead_seconds=restart_costs[model].inplace_s,
+            comms_fraction=comms,
+            interference_fraction=interference,
+        ))
+    return jobs
+
+
 def save_trace(jobs: Sequence[TraceJob], path: str) -> None:
     with open(path, "w") as f:
         json.dump([dataclasses.asdict(j) for j in jobs], f, indent=1)

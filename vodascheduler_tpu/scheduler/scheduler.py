@@ -146,6 +146,7 @@ class Scheduler:
         resize_cooldown_seconds: float = DEFAULT_RESIZE_COOLDOWN_SECONDS,
         defrag_cross_host_threshold: int = 0,
         fractional_sharing: Optional[bool] = None,
+        learned_models: Optional[bool] = None,
         journal=None,
         tracer: Optional[obs_tracer.Tracer] = None,
         actuation_workers: Optional[int] = None,
@@ -202,6 +203,41 @@ class Scheduler:
         self._interference_weight: Dict[str, int] = {}
         self._last_fractional_stats: Dict[str, int] = {}
         self._comms_weight: Dict[str, int] = {}
+        # Learned-model consumption (doc/learned-models.md): on, the
+        # placement comms weights, the interference pricing, and the
+        # migration payback gate read the collector's confidence-
+        # blended fraction estimates instead of the static family
+        # tables. VODA_LEARNED_MODELS=0 is the prior-only A/B
+        # reference path.
+        self.learned_models = (config.LEARNED_MODELS
+                               if learned_models is None
+                               else bool(learned_models))
+        # job -> (blended comms fraction, blended interference
+        # fraction), refreshed in ONE batched store read per model-
+        # version bump (a steady-state pass pays one int compare; a
+        # collector pass that moved a model costs one store scan on the
+        # NEXT pass, off the per-job hot loop).
+        self._learned_fraction: Dict[str, Tuple[float, float]] = {}
+        self._learned_seen_version = -1
+        # Model bumps consumed from the store's change feed while their
+        # job was NOT in ready_jobs yet (e.g. mid-recovery): stashed
+        # here and applied once the job shows up — advancing the seen
+        # version must never silently drop a bump.
+        self._learned_pending: set = set()
+        # Persistent per-pass weight OUTPUT maps (what the placement
+        # manager consumes), maintained by DELTA: request-set arrivals/
+        # departures plus the dirty set below. Rebuilding two 2.5k-entry
+        # dicts from a 10k-probe sweep every placed pass measurably ate
+        # the decide budget (the perf_scale `learned` column).
+        self._weights_out: Dict[str, int] = {}
+        self._iweights_out: Dict[str, int] = {}
+        self._weight_request_names: set = set()
+        self._weight_dirty: set = set()
+        # What-if shadow planner (doc/learned-models.md): one bounded
+        # worker per scheduler, created lazily — the planner runs
+        # snapshot-in/read-only and NEVER on the decide critical path.
+        self._whatif_pool = None
+        self._whatif_inflight = 0
         self._last_contiguity_cost = 0
         self._last_comms_score = 0
         self._migration_cost_cache: Dict[str, float] = {}
@@ -1482,56 +1518,194 @@ class Scheduler:
             return
         from vodascheduler_tpu.placement import comms as comms_mod
 
-        if self.fractional_sharing and pm.topology is not None:
-            icache = self._interference_weight
-            iweights: Dict[str, int] = {}
-            for job in requests:
+        self._refresh_learned_models(requests)
+        do_interference = (self.fractional_sharing
+                           and pm.topology is not None)
+        comms_enabled = pm.comms_enabled
+        if not do_interference and not comms_enabled:
+            return
+        # DELTA maintenance of the persistent output maps: only names
+        # that arrived, departed, or were invalidated since the last
+        # pass are re-derived — a steady-state 10k churn pass pays one
+        # set build + a handful of derivations, not a 20k-probe sweep
+        # (the perf_scale `learned` column's budget).
+        learned = self._learned_fraction
+        learned_get = learned.get
+        icache = self._interference_weight
+        cache = self._comms_weight
+        iweights = self._iweights_out
+        weights = self._weights_out
+        ready = self.ready_jobs
+        ready_get = ready.get
+        cur = set(requests)
+        prev_names = self._weight_request_names
+        dirty = self._weight_dirty
+        todo = cur - prev_names if prev_names else cur
+        if dirty:
+            todo |= dirty & cur
+            dirty.clear()
+        for job in prev_names - cur:
+            iweights.pop(job, None)
+            weights.pop(job, None)
+        self._weight_request_names = cur
+        for job in todo:
+            if do_interference:
                 w = icache.get(job)
                 if w is None:
                     if not self._is_fractional(job):
                         w = 0
                     else:
-                        from vodascheduler_tpu.common.job import category_of
-                        w = comms_mod.interference_weight_for_category(
-                            category_of(job))
+                        lf = learned_get(job)
+                        if lf is not None:
+                            # Blended learned interference fraction
+                            # (doc/learned-models.md): measured
+                            # co-tenant behavior wins over the family
+                            # table once confident.
+                            w = comms_mod.interference_weight_from_fraction(
+                                lf[1])
+                        else:
+                            from vodascheduler_tpu.common.job import (
+                                category_of,
+                            )
+                            w = comms_mod.interference_weight_for_category(
+                                category_of(job))
                     icache[job] = w
                 if w:
                     iweights[job] = w
-            if len(icache) > 2 * len(requests) + 64:
-                keep = set(requests)
-                self._interference_weight = {
-                    k: v for k, v in icache.items() if k in keep}
-                self._fractional_class = {
-                    k: v for k, v in self._fractional_class.items()
-                    if k in keep}
-            pm.set_interference_weights(iweights)
-        if not pm.comms_enabled:
-            return
-        cache = self._comms_weight
-        weights: Dict[str, int] = {}
-        ready = self.ready_jobs
-        for job in requests:
-            w = cache.get(job)
-            if w is None:
-                tj = ready.get(job)
-                if tj is None:
-                    w = 0
                 else:
-                    # Spec descriptor wins over the family default
-                    # (doc/placement.md "Collective profiles").
+                    iweights.pop(job, None)
+            if comms_enabled:
+                w = cache.get(job)
+                if w is None:
+                    tj = ready_get(job)
+                    if tj is None:
+                        w = 0
+                    else:
+                        # Spec descriptor wins over the family default
+                        # (doc/placement.md "Collective profiles").
+                        profile = comms_mod.profile_for_job(
+                            tj.spec.collectives, tj.category)
+                        lf = learned_get(job)
+                        if lf is not None:
+                            # Blended learned comms fraction rescales
+                            # the family byte profile (doc/learned-
+                            # models.md): a job measured chattier than
+                            # its table gets a proportionally stronger
+                            # contiguity pull.
+                            w = comms_mod.learned_weight(profile, lf[0])
+                        else:
+                            w = 0 if profile is None else profile.weight()
+                    cache[job] = w
+                if w:
+                    weights[job] = w
+                else:
+                    weights.pop(job, None)
+        # Bound the memos by the live request set (completed/deleted
+        # jobs drop out), same policy as the allocator's prior cache.
+        if len(icache) > 2 * len(requests) + 64:
+            self._interference_weight = {
+                k: v for k, v in icache.items() if k in cur}
+            self._fractional_class = {
+                k: v for k, v in self._fractional_class.items()
+                if k in cur}
+        if len(cache) > 2 * len(requests) + 64:
+            self._comms_weight = {k: v for k, v in cache.items()
+                                  if k in cur}
+        if do_interference:
+            pm.set_interference_weights(iweights)
+        if comms_enabled:
+            pm.set_comms_weights(weights)
+
+    def _refresh_learned_models(self, requests: ScheduleResult) -> None:
+        """Re-read the learned-model fractions (doc/learned-models.md)
+        when — and only when — the store's model version moved since
+        the last pass, and then only for the names whose models
+        actually changed (the store's per-name stamps): ONE batched
+        info fetch for the changed slice, blended against the family
+        priors through the confidence curve, with the derived weight
+        memos invalidated for every job whose blend moved. No-op with
+        learned models off (the prior-only A/B path) and in the steady
+        state (one int compare); a consumer behind the store's prune
+        floor falls back to one full-working-set refresh."""
+        if not self.learned_models:
+            return
+        version = self.store.model_version
+        seen = self._learned_seen_version
+        if version == seen:
+            return
+        changed = self.store.model_changes_since(seen) if seen >= 0 \
+            else None
+        self._learned_seen_version = version
+        ready = self.ready_jobs
+        # Membership and pruning are against the READY set, not the
+        # granted request set: a preempted job keeps its blended entry
+        # (a version bump consumed while it waited would otherwise be
+        # lost, silently reverting it to the family tables when
+        # re-granted), and entries die only with the job.
+        pending = self._learned_pending
+        if changed is None:
+            names = list(ready)
+            pending.clear()
+        else:
+            pending.update(changed)
+            names = [n for n in pending if n in ready]
+            pending.difference_update(names)
+            # Bound: pending bumps for jobs that will never be ready
+            # here (completed elsewhere, deleted) must not accrete.
+            if len(pending) > 2 * len(ready) + 64:
+                pending.intersection_update(ready)
+        if len(self._learned_fraction) > 2 * len(ready) + 64:
+            self._learned_fraction = {
+                k: v for k, v in self._learned_fraction.items()
+                if k in ready}
+        if not names:
+            return
+        from vodascheduler_tpu.metricscollector import learned as learned_mod
+        from vodascheduler_tpu.placement import comms as comms_mod
+
+        jobs = [ready[n] for n in names]
+        infos = self.store.job_infos_for(jobs)
+        table = self._learned_fraction
+        for tj in jobs:
+            info = infos.get(tj.name)
+            prev = table.get(tj.name)
+            pair = None
+            if info is not None:
+                cw = getattr(info, "comms_fraction_weight", 0.0)
+                iw = getattr(info, "interference_fraction_weight", 0.0)
+                if cw > 0.0 or iw > 0.0:
                     profile = comms_mod.profile_for_job(
                         tj.spec.collectives, tj.category)
-                    w = 0 if profile is None else profile.weight()
-                cache[job] = w
-            if w:
-                weights[job] = w
-        # Bound the memo by the live request set (completed/deleted
-        # jobs drop out), same policy as the allocator's prior cache.
-        if len(cache) > 2 * len(requests) + 64:
-            keep = set(requests)
-            self._comms_weight = {k: v for k, v in cache.items()
-                                  if k in keep}
-        pm.set_comms_weights(weights)
+                    f_prior = (0.0 if profile is None
+                               else profile.comms_fraction)
+                    fi_prior = comms_mod.interference_fraction_for_category(
+                        tj.category)
+                    pair = (
+                        learned_mod.blend(f_prior,
+                                          info.comms_fraction_est, cw),
+                        learned_mod.blend(
+                            fi_prior, info.interference_fraction_est, iw))
+            if pair is None:
+                if prev is not None:
+                    del table[tj.name]
+                    self._comms_weight.pop(tj.name, None)
+                    self._interference_weight.pop(tj.name, None)
+                    self._weight_dirty.add(tj.name)
+                continue
+            # Invalidate the derived INTEGER weights only when the
+            # blend moved enough to plausibly flip a bucket (the units
+            # are 0.02 of fraction): a converged collector nudges the
+            # blend by epsilon every pass, and re-deriving 10k weights
+            # for sub-bucket noise measurably ate the decide budget. A
+            # boundary-hugging fraction may serve a one-bucket-stale
+            # weight until its next real move — advisory pricing, not
+            # a booking.
+            if (prev is None or abs(prev[0] - pair[0]) > 0.005
+                    or abs(prev[1] - pair[1]) > 0.005):
+                table[tj.name] = pair
+                self._comms_weight.pop(tj.name, None)
+                self._interference_weight.pop(tj.name, None)
+                self._weight_dirty.add(tj.name)
 
     def _migration_cost_seconds(self, job_name: str) -> float:
         """Priced resharding cost of migrating `job_name`: a migration
@@ -1606,7 +1780,16 @@ class Scheduler:
         profile = comms_mod.profile_for_job(
             tj.spec.collectives if tj is not None else None,
             category_of(job_name))
-        fraction = 0.0 if profile is None else profile.comms_fraction
+        # The payback gate prices the move at the LEARNED fraction when
+        # one is blended in (doc/learned-models.md): a job measured
+        # chattier than its family table repays consolidation sooner;
+        # one measured quieter defers moves the table would have fired.
+        lf = (self._learned_fraction.get(job_name)
+              if self.learned_models else None)
+        if lf is not None:
+            fraction = lf[0]
+        else:
+            fraction = 0.0 if profile is None else profile.comms_fraction
         spread_old = pm.spread_of_pairs(live_pairs)
         spread_new = pm.spread_of_pairs(target)
         win_rate = max(0.0, spread_old - spread_new) * fraction
@@ -2180,6 +2363,40 @@ class Scheduler:
         hits = [r for r in records
                 if any(d.get("job") == job for d in r.get("deltas", ()))]
         return hits[-max(0, int(n)):] if n else hits
+
+    def whatif(self, job: str) -> dict:
+        """What-if shadow plan for one job (doc/learned-models.md
+        "What-if planner", replay/whatif.py): snapshot-in under one
+        brief lock hold, then scored entirely OFF the decide critical
+        path on this scheduler's single bounded planner worker — the
+        planner never holds the scheduler lock while it computes, and
+        a small in-flight cap sheds pile-ups instead of queueing them.
+        Backs GET /debug/whatif/<job> and `voda explain --whatif`."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from vodascheduler_tpu.replay import whatif as whatif_mod
+
+        with self._lock:
+            if self._whatif_inflight >= 4:
+                raise RuntimeError(
+                    "what-if planner busy (in-flight cap reached; "
+                    "retry shortly)")
+            self._whatif_inflight += 1
+            if self._whatif_pool is None:
+                self._whatif_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="voda-whatif")
+            pool = self._whatif_pool
+        parent = obs_tracer.current_context()
+
+        def _run() -> dict:
+            try:
+                with obs_tracer.use_context(parent, self.tracer):
+                    return whatif_mod.run_whatif(self, job)
+            finally:
+                with self._lock:
+                    self._whatif_inflight -= 1
+
+        return pool.submit(_run).result(timeout=60.0)
 
     # ---- time accounting + Tiresias transitions (reference :757-813) -----
 

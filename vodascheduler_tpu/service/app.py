@@ -268,10 +268,16 @@ class VodaApp:
             self.backends[ps.name] = be
             self.placements[ps.name] = pm
             self.schedulers[ps.name] = sched
+            # The collector journals its learned-model state (`jmodel`)
+            # through the pool's journal and fires the audited drift
+            # trigger at the pool's scheduler (doc/learned-models.md).
             self.collectors[ps.name] = MetricsCollector(
                 self.store, CsvDirRowSource(be.metrics_dir),
                 interval_seconds=collector_interval_seconds,
-                registry=self.registry, pool=ps.name)
+                registry=self.registry, pool=ps.name,
+                journal=jnl,
+                drift_trigger=lambda job, s=sched: s.trigger_resched(
+                    "model_drift_detected"))
 
         # Back-compat single-pool attributes (first pool).
         first = pool_specs[0].name

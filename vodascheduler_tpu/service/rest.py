@@ -74,6 +74,10 @@ class RestServer:
 
     def __init__(self, routes: Dict[Tuple[str, str], Route],
                  host: str = "127.0.0.1", port: int = 0):
+        # Exposed for tests/introspection: handlers are plain callables
+        # of (body, query), so a route can be exercised without a
+        # socket round trip.
+        self.routes = routes
         class Handler(BaseHTTPRequestHandler):
             # Socket read timeout: a client that connects and never
             # sends a request line (or stalls mid-headers) must not pin
@@ -472,6 +476,20 @@ def make_scheduler_server(scheduler, registry: Registry,
         counterpart."""
         return 200, pick(body, query).journal_stats()
 
+    def debug_whatif(body, query):
+        """What-if shadow plan for one job (doc/learned-models.md):
+        /debug/whatif/<job> or ?job=<name>. Backs `voda explain
+        --whatif <job>`. Runs on the scheduler's bounded planner
+        worker — read-only, never on the decide critical path."""
+        job = (query.get("__path__", [None])[0]
+               or query.get("job", [None])[0])
+        if not job:
+            raise ValueError("job name required: /debug/whatif/<job>")
+        try:
+            return 200, pick(body, query).whatif(job)
+        except KeyError as e:
+            return 404, {"error": str(e)}
+
     def debug_fleet(body, query):
         """One fleet view over every pool (doc/observability.md "Fleet
         decide"): lock-free per-pool load snapshot, per-pool decide/
@@ -494,6 +512,8 @@ def make_scheduler_server(scheduler, registry: Registry,
         ("GET", "/debug/trace"): debug_trace,
         ("GET", "/debug/trace/*"): debug_trace,
         ("GET", "/debug/profile"): debug_profile,
+        ("GET", "/debug/whatif"): debug_whatif,
+        ("GET", "/debug/whatif/*"): debug_whatif,
         ("GET", "/debug/journal"): debug_journal,
         ("GET", "/debug/fleet"): debug_fleet,
         ("GET", "/metrics"): _metrics_route(registry),
