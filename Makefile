@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-crash modelcheck-selftest journal-fsck lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-crash modelcheck-selftest journal-fsck failover-bench lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -81,6 +81,16 @@ modelcheck-crash:
 # real journal file.
 journal-fsck:
 	$(PY) -m vodascheduler_tpu.durability.journal --selftest
+
+# Standalone hot-standby failover point (schema 9, doc/durability.md
+# "Hot standby"): a bounded journaled world with a live shipping tailer
+# attached, repeated warm takeovers measured lease-loss -> first
+# committed decide, and the cold-recovery fastpath-vs-reference A/B.
+# ~30 s; the full-size pins live in doc/perf_baseline.json via
+# make perf-baseline / perf-gate.
+failover-bench:
+	JAX_PLATFORMS=cpu $(PY) scripts/perf_scale.py --failover-only \
+		--ns 1000
 
 # Prove the checker has teeth: every seeded-bug scheduler variant must
 # be caught AND its counterexample must replay deterministically

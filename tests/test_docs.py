@@ -113,7 +113,8 @@ class TestApisDoc:
             assert knob in doc, f"retention knob {knob} undocumented"
         for kind in ("resched_audit", "span", "http_access",
                      "status_transition", "modelcheck_counterexample",
-                     "perf_report", "recovery_report"):
+                     "perf_report", "recovery_report",
+                     "takeover_report"):
             assert kind in doc, f"record kind {kind} undocumented"
 
     def test_performance_observatory_documented(self):
@@ -446,7 +447,14 @@ class TestDurabilityDoc:
                            ("VODA_JOURNAL_COMPACT_BYTES",
                             "JOURNAL_COMPACT_BYTES"),
                            ("VODA_LEASE_TTL_SECONDS",
-                            "LEASE_TTL_SECONDS")):
+                            "LEASE_TTL_SECONDS"),
+                           ("VODA_JOURNAL_RETIRE_RETENTION_SECONDS",
+                            "JOURNAL_RETIRE_RETENTION_SECONDS"),
+                           ("VODA_RECOVERY_FASTPATH",
+                            "RECOVERY_FASTPATH"),
+                           ("VODA_STANDBY", "STANDBY"),
+                           ("VODA_STANDBY_POLL_SECONDS",
+                            "STANDBY_POLL_SECONDS")):
             assert knob in doc, f"knob {knob} undocumented"
             assert hasattr(cfg, attr), f"documented knob {knob} gone"
 
@@ -468,11 +476,59 @@ class TestDurabilityDoc:
         from vodascheduler_tpu.analysis import modelcheck
         assert "crash" in modelcheck.PROFILES
         for tooth in ("skip-journal-on-commit", "apply-before-append",
-                      "stale-epoch-accepted"):
+                      "stale-epoch-accepted",
+                      "stale-standby-serves-decide"):
             assert tooth in modelcheck.DURABILITY_VARIANTS
         for inv in ("crash_recovery_divergence",
-                    "recovery_unjournaled_grant", "stale_epoch_write"):
+                    "recovery_unjournaled_grant", "stale_epoch_write",
+                    "standby_prefix_divergence"):
             assert inv in modelcheck.INVARIANTS
+
+    def test_hot_standby_documented(self):
+        """The hot-standby plane (doc/durability.md 'Hot standby') is
+        pinned two ways: the shipping protocol, applier state machine,
+        and takeover budget are documented; the REST/metric/CLI
+        surfaces it names exist in code."""
+        doc = self._doc()
+        for term in ("Hot standby", "JournalTailer", "StandbyApplier",
+                     "HotStandby",
+                     "FileTailSource", "HttpTailSource", "resync",
+                     "resume_hint", "recovered_state", "Journal.batch",
+                     "takeover_report", "probe_fence",
+                     "/debug/standby", "/journal/segment",
+                     "/journal/snapshot",
+                     "voda_scheduler_takeover_seconds",
+                     "voda_standby_apply_lag_records",
+                     "standby_prefix_divergence",
+                     "stale-standby-serves-decide",
+                     "make failover-bench", "read_states_parallel",
+                     "VODA_RECOVERY_FASTPATH", "failover"):
+            assert term in doc, f"hot-standby term {term!r} missing"
+        with open(os.path.join(REPO, "vodascheduler_tpu", "service",
+                               "rest.py")) as f:
+            rest = f.read()
+        for route in ("/debug/standby", "/journal/segment",
+                      "/journal/snapshot"):
+            assert route in rest, f"documented route {route} missing"
+        with open(os.path.join(REPO, "doc", "apis.md")) as f:
+            apis = f.read()
+        for route in ("/debug/standby", "/journal/segment",
+                      "/journal/snapshot"):
+            assert route in apis, f"route {route} not in apis.md"
+        with open(os.path.join(REPO, "doc",
+                               "prometheus-metrics-exposed.md")) as f:
+            prom = f.read()
+        for series in ("voda_scheduler_takeover_seconds",
+                       "voda_standby_apply_lag_records"):
+            assert series in prom, f"series {series} undocumented"
+        from vodascheduler_tpu.durability import (  # noqa: F401
+            FileTailSource as _f,
+            HotStandby as _h,
+            HttpTailSource as _t,
+            JournalTailer as _j,
+            PoolStandby as _p,
+            StandbyApplier as _a,
+        )
 
 
 def _modelcheck_invariants():

@@ -124,7 +124,7 @@ class TestCommittedBaseline:
 
     def test_schema_and_coverage(self):
         base = self._baseline()
-        assert base["schema"] == 8  # v8: + the learned section
+        assert base["schema"] == 9  # v9: + the failover section
         assert base["fleet"], "fleet section missing (make perf-baseline)"
         assert base["fractional"], \
             "fractional section missing (make perf-baseline)"
@@ -134,6 +134,11 @@ class TestCommittedBaseline:
         assert base["learned"], \
             "learned section missing (make perf-baseline; " \
             "doc/learned-models.md)"
+        assert base["failover"], \
+            "failover section missing (make perf-baseline; " \
+            "doc/durability.md 'Hot standby')"
+        assert base["fleet_recovery"], \
+            "fleet_recovery section missing (make perf-baseline)"
         assert base["tool"] == "scripts/perf_scale.py"
         assert base["seed"] and base["passes"] >= 3
         by_n = {c["n_jobs"]: c for c in base["curves"]}

@@ -154,6 +154,13 @@ INVARIANTS: Dict[str, str] = {
         "may never gain a record whose epoch regressed — a deposed "
         "leader's appends are rejected at the write (FencedOut) and "
         "dropped at replay, never interleaved."),
+    "standby_prefix_divergence": (
+        "Crash profile, hot standby: at every shipping apply point the "
+        "standby applier's materialized state must equal a fresh batch "
+        "replay of the journal's committed prefix — the incremental "
+        "applier and read_state are the same fold, so any divergence "
+        "is a shipping/apply bug that a takeover would serve as state "
+        "(doc/durability.md 'Hot standby')."),
 }
 
 
@@ -354,13 +361,19 @@ class _StaleEpochJournal(Journal):
         pass  # seeded bug: no fence — stale writers welcome
 
 
-# name -> (Scheduler class, Journal class); the crash profile's
-# variant namespace, loud-mismatch like the others.
-DURABILITY_VARIANTS: Dict[str, Tuple[type, type]] = {
-    "default": (Scheduler, Journal),
-    "skip-journal-on-commit": (_SkipJournalOnCommit, Journal),
-    "apply-before-append": (_ApplyBeforeAppend, Journal),
-    "stale-epoch-accepted": (Scheduler, _StaleEpochJournal),
+# name -> (Scheduler class, Journal class, standby drains suffix at
+# takeover); the crash profile's variant namespace, loud-mismatch like
+# the others. The third slot seeds the hot-standby tooth: a standby
+# that takes over WITHOUT finishing the journal suffix serves a stale
+# prefix as recovered state — `stale-standby-serves-decide` — and must
+# be caught (its truncated warm-open drops committed grants the
+# backend is still running: recovery_unjournaled_grant / divergence).
+DURABILITY_VARIANTS: Dict[str, Tuple[type, type, bool]] = {
+    "default": (Scheduler, Journal, True),
+    "skip-journal-on-commit": (_SkipJournalOnCommit, Journal, True),
+    "apply-before-append": (_ApplyBeforeAppend, Journal, True),
+    "stale-epoch-accepted": (Scheduler, _StaleEpochJournal, True),
+    "stale-standby-serves-decide": (Scheduler, Journal, False),
 }
 
 
@@ -456,7 +469,8 @@ class _World:
                     f"variant (the crash profile seeds journaling bugs; "
                     f"scheduler/placement variants need the bounded/deep "
                     f"profiles)")
-            cls, journal_cls = DURABILITY_VARIANTS[config.variant]
+            cls, journal_cls, self._standby_drains = \
+                DURABILITY_VARIANTS[config.variant]
             pm_cls = PlacementManager
         else:
             if (config.variant not in VARIANTS
@@ -483,12 +497,25 @@ class _World:
         self.fence_done = False
         self.old_scheds: List[Scheduler] = []
         self._crash_problems: List[str] = []
+        self.standby = None
         if config.durability:
             self.lease = MemoryLease(holder="leader-1")
             self.storage = MemoryStorage()
             self.journal = journal_cls(
                 storage=self.storage, epoch=self.lease.epoch,
                 fence=self.lease.current_epoch, clock=self.clock)
+            # The hot standby (doc/durability.md "Hot standby"): the
+            # REAL shipping tailer + incremental applier over the same
+            # in-memory storage — `ship` actions advance it to
+            # arbitrary journal prefixes, and a `fence` takeover lands
+            # on whatever it has applied (plus the protocol's final
+            # suffix drain).
+            from vodascheduler_tpu.durability.shipping import (
+                StorageTailSource,
+            )
+            from vodascheduler_tpu.durability.standby import PoolStandby
+            self.standby = PoolStandby("mc-pool",
+                                       StorageTailSource(self.storage))
         self.sched: Scheduler = cls(
             "mc-pool", self.backend, self.store, self.allocator,
             self.clock, bus=self.bus, placement_manager=self.pm,
@@ -549,6 +576,12 @@ class _World:
                     acts.append(f"crash:{k}")
             if self.config.fence and not self.fence_done:
                 acts.append("fence")
+            if (self.standby is not None and not self.fence_done
+                    and self.storage.size() > self.standby.tailer.offset):
+                # Advance the hot standby to the current journal end —
+                # interleaved between every other action, so fences
+                # land on arbitrary applied prefixes.
+                acts.append("ship")
         return acts
 
     def apply(self, action: str) -> None:
@@ -564,6 +597,8 @@ class _World:
             self._apply_crash(arg)
         elif kind == "fence":
             self._apply_fence()
+        elif kind == "ship":
+            self._apply_ship()
         elif kind == "fault":
             self.backend.inject_fault(arg)
         elif kind == "host_down":
@@ -626,19 +661,77 @@ class _World:
                 self.storage.disarm()
         self._crash_and_recover(quiescent=quiescent)
 
+    def _apply_ship(self) -> None:
+        """One shipping cycle: the standby applies every record up to
+        the current journal end, then its materialized state is checked
+        against a fresh batch replay of the same prefix — the
+        `standby_prefix_divergence` invariant, at THIS apply point."""
+        from vodascheduler_tpu.durability.journal import parse_frames
+        from vodascheduler_tpu.durability.recover import StandbyApplier
+
+        self.standby.poll()
+        records, _, corrupt = parse_frames(self.storage.read())
+        if corrupt is not None:
+            self._crash_problems.append(
+                f"standby_prefix_divergence: journal corrupt under the "
+                f"shipping tailer: {corrupt}")
+            return
+        ref = StandbyApplier()
+        ref.bootstrap(getattr(self.storage, "snapshot", None))
+        for rec in records:
+            ref.apply(rec)
+        got, want = self.standby.applier.state, ref.state
+        diff = [
+            field for field, a, b in (
+                ("statuses", got.statuses, want.statuses),
+                ("booked", got.booked, want.booked),
+                ("placements",
+                 {j: sorted(p) for j, p in got.placements.items()},
+                 {j: sorted(p) for j, p in want.placements.items()}),
+                ("retired", got.retired, want.retired),
+                ("granted", got.granted, want.granted),
+                ("resize_at", got.resize_at, want.resize_at),
+                ("last_seq", got.last_seq, want.last_seq),
+                ("epoch", got.epoch, want.epoch),
+            ) if a != b]
+        if diff:
+            self._crash_problems.append(
+                f"standby_prefix_divergence: applier diverges from the "
+                f"batch replay of its own prefix in {diff} at seq "
+                f"{want.last_seq}")
+
     def _apply_fence(self) -> None:
         """Standby takeover while the deposed leader still RUNS (the
-        split-brain window): the lease epoch bumps, a new scheduler
-        recovers from the journal, and the old one is left alive — its
-        next journal append must fence (FencedOut) and stop it; a
+        split-brain window): the lease epoch bumps, the HOT STANDBY —
+        at whatever prefix its ship actions reached — finishes the
+        suffix (the takeover protocol's final drain; the seeded
+        stale-standby variant skips it) and the new scheduler recovers
+        from its materialized state, with the old leader left alive —
+        its next journal append must fence (FencedOut) and stop it; a
         journal that accepts the stale write is caught by the
         epoch-regression scan."""
         self.fence_done = True
         self.old_scheds.append(self.sched)  # left running, deposed
-        self._crash_and_recover(quiescent=True, stop_old=False)
+        if self._standby_drains:
+            bundle = self.standby.prepare_takeover()
+        else:
+            # SEEDED BUG (stale-standby-serves-decide): take over from
+            # the applier's CURRENT prefix without the final suffix
+            # drain — the warm open trims the journal at the stale
+            # clean offset and recovery serves decide from stale state.
+            bundle = {
+                "state": self.standby.applier.state,
+                "resume_hint": {
+                    "last_seq": self.standby.applier.last_seq,
+                    "clean_bytes": self.standby.tailer.offset},
+                "suffix_records": 0,
+            }
+        self._crash_and_recover(quiescent=True, stop_old=False,
+                                standby_bundle=bundle)
 
     def _crash_and_recover(self, quiescent: bool,
-                           stop_old: bool = True) -> None:
+                           stop_old: bool = True,
+                           standby_bundle: Optional[dict] = None) -> None:
         pre = self._logical_snapshot() if quiescent else None
         old = self.sched
         if stop_old:
@@ -648,7 +741,9 @@ class _World:
             holder=f"leader-{self.lease.epoch + 1}")
         self.journal = self._journal_cls(
             storage=self.storage, epoch=epoch,
-            fence=self.lease.current_epoch, clock=self.clock)
+            fence=self.lease.current_epoch, clock=self.clock,
+            resume_hint=(standby_bundle["resume_hint"]
+                         if standby_bundle is not None else None))
         problems: List[str] = []
         # The write-ahead property, checked on the PRE-recovery journal
         # (recovery itself appends re-assertions): every live backend
@@ -684,6 +779,8 @@ class _World:
             algorithm=self.config.algorithm,
             rate_limit_seconds=self.config.rate_limit_seconds,
             profile_cpu=False, journal=self.journal,
+            recovered_state=(standby_bundle["state"]
+                             if standby_bundle is not None else None),
             tracer=self.tracer, resume=True)
         report = self.sched._last_recovery_report or {}
         if quiescent:
@@ -755,9 +852,13 @@ class _World:
         if self.config.durability:
             # Crash bookkeeping is logical state: a path that crashed
             # must never merge with one that didn't (its remaining
-            # crash budget, epoch, and split-brain window all differ).
+            # crash budget, epoch, and split-brain window all differ) —
+            # and the standby's applied prefix is state too: a fence at
+            # lag 3 is a different world than a fence at lag 0.
             flags = flags + (self.crashes_done, self.fence_done,
                              self.journal.epoch,
+                             self.standby.applier.last_seq
+                             if self.standby is not None else -1,
                              tuple(s._stopped for s in self.old_scheds))
         return (booked, ready, done, bjobs, hosts, faults, flags)
 
@@ -1361,6 +1462,12 @@ PROFILES = {"bounded": bounded_config, "deep": deep_config,
 # means the scenario (or the dedup) silently collapsed — fail loudly.
 # Applies to the `bounded` AND `crash` profiles (both run in CI).
 MIN_BOUNDED_STATES = 2000
+# The crash profile's own floor, raised past the bounded one when the
+# hot-standby `ship` action joined the alphabet (every applied-prefix
+# choice is a distinct world — ~6k states vs the pre-standby 4k): a
+# crash run under this means the standby action space silently
+# collapsed.
+MIN_CRASH_STATES = 4000
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1501,10 +1608,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if result.counterexample is not None:
         print(json.dumps(result.counterexample, indent=1))
         return 1
-    if args.profile in ("bounded", "crash") \
-            and result.states < MIN_BOUNDED_STATES:
+    floor = {"bounded": MIN_BOUNDED_STATES,
+             "crash": MIN_CRASH_STATES}.get(args.profile)
+    if floor is not None and result.states < floor:
         print(f"modelcheck: bound collapsed — only {result.states} "
-              f"states explored (< {MIN_BOUNDED_STATES})")
+              f"states explored (< {floor})")
         return 2
     return 0
 

@@ -254,13 +254,19 @@ def main(argv=None) -> int:
         except SystemExit:
             ingest = None
         # Durability line (doc/durability.md): best-effort for
-        # pre-journal servers.
+        # pre-journal servers; the standby/takeover row likewise for
+        # pre-failover ones.
         try:
             journal = _request(
                 f"{args.scheduler_server}/debug/journal{pool_q}")
         except SystemExit:
             journal = None
-        _print_top(records, k=args.k, ingest=ingest, journal=journal)
+        try:
+            standby = _request(f"{args.scheduler_server}/debug/standby")
+        except SystemExit:
+            standby = None
+        _print_top(records, k=args.k, ingest=ingest, journal=journal,
+                   standby=standby)
     return 0
 
 
@@ -330,8 +336,29 @@ def _print_journal(stats: dict) -> None:
               f"(epoch {last.get('epoch')})")
 
 
+def _print_standby(stats: dict) -> None:
+    """Hot-standby rows for `voda top` (GET /debug/standby,
+    doc/durability.md "Hot standby"): whether this leader was born
+    from a warm takeover and what the takeover cost end to end."""
+    takeovers = stats.get("takeovers") or {}
+    for pool, t in sorted(takeovers.items()):
+        print(f"  takeover[{pool}]: {t.get('duration_ms', 0.0):.1f}ms "
+              f"lease-loss->first-commit (recovery "
+              f"{t.get('recovery_ms', 0.0):.1f}ms, suffix "
+              f"{t.get('suffix_records', 0)} record(s), "
+              f"{t.get('divergences', 0)} divergence(s), epoch "
+              f"{t.get('epoch')})")
+    for row in stats.get("standby") or ():
+        print(f"  standby[{row.get('pool')}]: applied seq "
+              f"{row.get('applied_seq', 0)} over "
+              f"{row.get('polls', 0)} poll(s), lag "
+              f"{row.get('records_behind', 0)} record(s), "
+              f"{row.get('resyncs', 0)} resync(s)")
+
+
 def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None,
-               journal: Optional[dict] = None) -> None:
+               journal: Optional[dict] = None,
+               standby: Optional[dict] = None) -> None:
     """Human rendering of /debug/profile: per-phase p50/p95 over the
     window, then the slowest passes with their dominant phase and the
     jobs whose deltas triggered them."""
@@ -339,6 +366,8 @@ def _print_top(records: list, k: int = 5, ingest: Optional[dict] = None,
         _print_ingest(ingest)
     if journal:
         _print_journal(journal)
+    if standby and (standby.get("takeovers") or standby.get("standby")):
+        _print_standby(standby)
     if not records:
         print("no profiled passes yet (ring empty; run or trigger a "
               "resched first)")

@@ -228,6 +228,17 @@ class LocalBackend(ClusterBackend):
                 max(self.hermetic_devices, num_chips))
         if self.topology is not None:
             env["VODA_TOPOLOGY"] = str(self.topology)
+        # Placement context for the epoch CSV (doc/learned-models.md):
+        # a single-host backend is contiguous by construction (spread
+        # 0); co-tenancy is the share of this host's chips other jobs
+        # hold at spawn — mirroring the fake backend's definition, so
+        # real-mode rows stop defaulting to exclusive.
+        with self._lock:
+            foreign = sum(p.num_chips for other, p in self._procs.items()
+                          if other != spec.name)
+        env["VODA_PLACEMENT_SPREAD"] = "0.0"
+        env["VODA_PLACEMENT_COTENANCY"] = (
+            f"{min(1.0, foreign / self.chips):.4f}" if self.chips else "0.0")
         cmd = [sys.executable, "-m", "vodascheduler_tpu.runtime.supervisor",
                "--workdir", job_dir, "--num-chips", str(num_chips),
                "--metrics-dir", self.metrics_dir]

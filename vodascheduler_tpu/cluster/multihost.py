@@ -253,16 +253,55 @@ class MultiHostBackend(ClusterBackend):
             raise
         return _ProcSet(procs, num_chips, list(placements))
 
+    def _placement_context(self, name: str,
+                           placements: List[Tuple[str, int]]
+                           ) -> Tuple[float, float]:
+        """(spread, cotenancy) of this incarnation's placement — the
+        CSV placement-context columns (doc/learned-models.md): the
+        topology-normalized spread of its host set and the
+        chip-weighted share of its hosts' chips held by OTHER jobs,
+        mirroring the fake backend's physics definitions so real-mode
+        and simulated rows feed the estimators identically."""
+        spread = 0.0
+        if self.topology is not None and placements:
+            coord_of = {self.topology.host_name(c): c
+                        for c in self.topology.host_coords()}
+            coords = [coord_of[h] for h, n in placements
+                      if n > 0 and h in coord_of]
+            if coords:
+                spread = self.topology.spread(coords)
+        total = sum(n for _, n in placements if n > 0)
+        cot = 0.0
+        if total > 0:
+            with self._lock:
+                occupancy: Dict[str, int] = {}
+                for other, pset in self._jobs.items():
+                    if other == name:
+                        continue
+                    for h, n in pset.placements:
+                        occupancy[h] = occupancy.get(h, 0) + n
+            for h, n in placements:
+                chips = self.hosts.get(h, 0)
+                if n <= 0 or chips <= 0:
+                    continue
+                cot += (n / total) * min(1.0, occupancy.get(h, 0) / chips)
+        return spread, cot
+
     def _spawn_procs(self, spec: JobSpec, num_chips: int,
                      placements: List[Tuple[str, int]], port: int,
                      single: bool, job_dir: str,
                      procs: List[subprocess.Popen]) -> None:
+        spread, cotenancy = self._placement_context(spec.name, placements)
         for pid, (host, chips) in enumerate(placements):
             env = dict(os.environ)
             # Each process owns its host's chips as a local CPU platform;
             # jax.distributed joins them into the global mesh. A single-
             # entry placement needs no coordinator (plain local job).
             env["VODA_FORCE_CPU_DEVICES"] = str(chips)
+            # Placement context for the epoch CSV (doc/learned-models.md):
+            # rank 0's rows carry the incarnation's spread/co-tenancy.
+            env["VODA_PLACEMENT_SPREAD"] = f"{spread:.4f}"
+            env["VODA_PLACEMENT_COTENANCY"] = f"{cotenancy:.4f}"
             if self.topology is not None:
                 env["VODA_TOPOLOGY"] = str(self.topology)
             if not single:

@@ -203,6 +203,34 @@ JOURNAL_COMPACT_BYTES = int(_env_float("VODA_JOURNAL_COMPACT_BYTES",
 # over (bumping the fencing epoch) once the lease sits expired.
 LEASE_TTL_SECONDS = _env_float("VODA_LEASE_TTL_SECONDS", "15")
 
+# Tombstone retention horizon (doc/durability.md "Known bounds"):
+# snapshot folds prune `retired` tombstones (and their `granted`
+# history) older than this, so a long-lived journal's snapshot grows
+# with the retention window, not lifetime job count. 0 disables
+# pruning (the unbounded pre-PR-15 behavior).
+JOURNAL_RETIRE_RETENTION_SECONDS = _env_float(
+    "VODA_JOURNAL_RETIRE_RETENTION_SECONDS", str(7 * 24 * 3600))
+
+# Crash-recovery fastpath (doc/durability.md "Hot standby"): batched
+# resume appends, one delta-encoded booking commit, and an end-of-
+# recovery snapshot fold. 0 forces the per-record reference path (the
+# A/B oracle perf_scale's failover section measures the speedup
+# against).
+RECOVERY_FASTPATH = os.environ.get("VODA_RECOVERY_FASTPATH", "1") != "0"
+
+# Hot-standby mode (doc/durability.md "Hot standby"): 1 = a voda-server
+# started while another leader holds the lease becomes a warm standby —
+# it tails the leader's journals via shipping, applies them
+# continuously, and takes over (bounded by the takeover budget) the
+# moment the lease expires. 0 = the pre-standby behavior: wait out one
+# TTL then fail loudly.
+STANDBY = os.environ.get("VODA_STANDBY", "0") == "1"
+
+# How often a hot standby polls the journals for new records and the
+# lease for expiry — the shipping lag (and takeover detection latency)
+# bound.
+STANDBY_POLL_SECONDS = _env_float("VODA_STANDBY_POLL_SECONDS", "1.0")
+
 # How long a backend waits for a running supervisor to ack an in-place
 # resize (Tier A of the resize fast path) before falling back to the
 # checkpoint-restart path. Must cover the resharded step's XLA compile

@@ -31,6 +31,19 @@ from vodascheduler_tpu.durability.leader import (  # noqa: F401
 )
 from vodascheduler_tpu.durability.recover import (  # noqa: F401
     JournalState,
+    StandbyApplier,
     read_state,
+    read_states_parallel,
     recover_scheduler,
+)
+from vodascheduler_tpu.durability.shipping import (  # noqa: F401
+    FileTailSource,
+    HttpTailSource,
+    JournalTailer,
+    StorageTailSource,
+)
+from vodascheduler_tpu.durability.standby import (  # noqa: F401
+    HotStandby,
+    PoolStandby,
+    finish_takeover,
 )

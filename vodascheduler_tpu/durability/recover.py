@@ -59,6 +59,10 @@ class JournalState:
         dataclasses.field(default_factory=dict)
     resize_at: Dict[str, float] = dataclasses.field(default_factory=dict)
     retired: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # When each tombstone was laid (the jretire record's envelope ts):
+    # what the snapshot fold's retention pruning keys on
+    # (doc/durability.md "Known bounds").
+    retired_at: Dict[str, float] = dataclasses.field(default_factory=dict)
     granted: Set[str] = dataclasses.field(default_factory=set)
     routes: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Learned-model state (doc/learned-models.md): newest jmodel payload
@@ -74,27 +78,53 @@ class JournalState:
     duplicate_records: int = 0
 
 
-def read_state(journal) -> JournalState:
-    """Snapshot + journal suffix -> JournalState (see module doc)."""
-    from vodascheduler_tpu.durability import snapshot as snap_mod
+class StandbyApplier:
+    """The incremental replay engine (doc/durability.md "Hot standby").
 
-    state = JournalState()
-    snap = snap_mod.load_snapshot(journal)
-    if snap is not None:
+    One applier maintains one fully-materialized `JournalState`
+    record-by-record: `bootstrap()` loads a shipped snapshot, `apply()`
+    folds in one journal record with the same seq-dedup and stale-epoch
+    fencing the batch replay performs — `read_state` IS this class run
+    over the whole journal, and a warm standby is this class run
+    continuously behind a shipping tailer, so takeover replays only the
+    suffix the tailer hadn't fed yet."""
+
+    def __init__(self, state: Optional[JournalState] = None) -> None:
+        self.state = state if state is not None else JournalState()
+
+    def bootstrap(self, snap: Optional[dict]) -> bool:
+        """Load a snapshot into the state. A snapshot older than what
+        the applier has already applied is ignored (False) — replayed
+        records are a superset of what the fold serialized; a NEWER one
+        replaces the state wholesale (a compaction/recovery fold on the
+        leader covered records this applier never saw as frames)."""
+        if snap is None:
+            return False
+        state = self.state
+        if int(snap.get("last_seq", 0)) <= state.last_seq:
+            return False
         state.statuses = dict(snap.get("statuses", {}))
-        state.booked = {j: int(n) for j, n in snap.get("booked", {}).items()}
+        state.booked = {j: int(n)
+                       for j, n in snap.get("booked", {}).items()}
         state.placements = {
             j: [(h, int(n)) for h, n in pairs]
             for j, pairs in snap.get("placements", {}).items()}
         state.resize_at = {j: float(t)
-                          for j, t in snap.get("resize_at", {}).items()}
+                           for j, t in snap.get("resize_at", {}).items()}
         state.retired = dict(snap.get("retired", {}))
+        state.retired_at = {j: float(t) for j, t in
+                            snap.get("retired_at", {}).items()}
         state.granted = set(snap.get("granted", ()))
         state.routes = dict(snap.get("routes", {}))
         state.models = dict(snap.get("models", {}))
         state.last_seq = int(snap.get("last_seq", 0))
-        state.epoch = int(snap.get("epoch", 0))
-    for rec in journal.records():
+        state.epoch = max(state.epoch, int(snap.get("epoch", 0)))
+        return True
+
+    def apply(self, rec: dict) -> bool:
+        """Fold one record; returns whether it applied (False = dropped
+        as a duplicate or a deposed leader's stale-epoch write)."""
+        state = self.state
         state.records += 1
         seq = int(rec.get("seq", 0))
         epoch = int(rec.get("epoch", 0))
@@ -106,15 +136,68 @@ def read_state(journal) -> JournalState:
             # own seq counter, so its stale appends usually alias old
             # seqs — they are stale writes, not duplicates.
             state.stale_records += 1
-            continue
+            return False
         if seq <= state.last_seq:
             state.duplicate_records += 1
-            continue
+            return False
         state.last_seq = seq
         state.epoch = max(state.epoch, epoch)
         _apply_record(state, rec)
+        return True
+
+    @property
+    def last_seq(self) -> int:
+        return self.state.last_seq
+
+
+def read_state(journal) -> JournalState:
+    """Snapshot + journal suffix -> JournalState: the batch form of
+    StandbyApplier (see module doc)."""
+    from vodascheduler_tpu.durability import snapshot as snap_mod
+
+    applier = StandbyApplier()
+    applier.bootstrap(snap_mod.load_snapshot(journal))
+    state = applier.state
+    for rec in journal.records():
+        applier.apply(rec)
     state.torn_tail = journal._torn_tail_count + journal.torn_trimmed
     return state
+
+
+def read_states_parallel(journals: Dict[str, object],
+                         workers: int = 8) -> Dict[str, JournalState]:
+    """Replay N pools' journals concurrently (the fleet cold-recovery
+    fastpath, doc/durability.md "Hot standby"): each pool's
+    snapshot-load + suffix replay runs on a bounded executor — the
+    fleet restart pays the slowest pool's replay plus the (GIL-bound)
+    shared decode, not the serial sum of N file reads."""
+    if not journals:
+        return {}
+    if len(journals) == 1:
+        name = next(iter(journals))
+        return {name: read_state(journals[name])}
+    from concurrent.futures import ThreadPoolExecutor
+
+    from vodascheduler_tpu.obs import tracer as obs_tracer
+
+    parent = obs_tracer.current_context()
+
+    def _replay(jnl) -> JournalState:
+        # Ambient context propagated explicitly (thread-local): any
+        # span a caller opened around the fleet restart stays the
+        # parent of per-pool replay work.
+        with obs_tracer.use_context(parent):
+            return read_state(jnl)
+
+    out: Dict[str, JournalState] = {}
+    with ThreadPoolExecutor(
+            max_workers=min(workers, len(journals)),
+            thread_name_prefix="voda-recover") as pool:
+        futures = {name: pool.submit(_replay, jnl)
+                   for name, jnl in journals.items()}
+        for name, fut in futures.items():
+            out[name] = fut.result()
+    return out
 
 
 def _apply_record(state: JournalState, rec: dict) -> None:
@@ -150,6 +233,7 @@ def _apply_record(state: JournalState, rec: dict) -> None:
     elif kind == "jretire":
         job = rec["job"]
         state.retired[job] = rec.get("status", "")
+        state.retired_at[job] = float(rec.get("ts", 0.0) or 0.0)
         state.statuses.pop(job, None)
         state.booked.pop(job, None)
         state.placements.pop(job, None)
@@ -191,21 +275,115 @@ def _finish_retirement(sched, job, target: JobStatus, journal) -> None:
     sched.store.update_job(job)
 
 
-def recover_scheduler(sched) -> dict:
+def recover_scheduler(sched, state: Optional[JournalState] = None,
+                      fastpath: Optional[bool] = None) -> dict:
     """Rebuild a crashed scheduler from its journal and reconcile
     against the backend's live view (see module doc). Called by the
     Scheduler constructor on `resume=True` when the journal has state.
     Returns (and retains on the scheduler) the recovery_report record.
-    """
+
+    `state`: a pre-materialized JournalState (a hot standby's applier,
+    standby.py) — replay is skipped and takeover work is only the
+    reconcile + the first pass. NOTE: the state is consumed (the fold
+    below applies the reconcile records into it).
+
+    `fastpath` (default on; VODA_RECOVERY_FASTPATH=0 forces the
+    reference path — the A/B perf_scale's failover section measures):
+    the reconcile's ~2 journal appends per job are batched into one
+    storage write, bookings land as ONE delta-encoded `jpass`, and when
+    the segment has outgrown the compaction bound the whole recovered
+    state folds into a fresh snapshot instead of appending the resume
+    records as frames at all (the compaction that would otherwise fire
+    mid-resume-pass is subsumed). The reference path retains the
+    original per-record behavior as the equivalence oracle — both paths
+    must rebuild identical logical tables (pinned by
+    tests/test_failover.py)."""
     t0 = _walltime.monotonic()
     journal = sched.journal
-    state = read_state(journal)
+    if fastpath is None:
+        from vodascheduler_tpu import config as _config
+        fastpath = _config.RECOVERY_FASTPATH
+    warm = state is not None
+    if state is None:
+        state = read_state(journal)
+    if fastpath:
+        with journal.batch() as batch:
+            rec = _reconcile(sched, journal, state, t0, batched=True)
+            # Latency vs throughput: a WARM takeover (pre-materialized
+            # standby state) is budget-bounded — always flush (one
+            # write) and let the next pass's compaction fold off the
+            # critical path; a COLD recovery folds when the segment
+            # warrants it (the recovery IS the compaction).
+            folded = _fold_or_flush(sched, journal, state, batch,
+                                    allow_fold=not warm)
+        if folded:
+            # The compaction marker, appended AFTER the batch closed —
+            # inside it the record would land in the consumed buffer
+            # and never reach the fresh segment.
+            journal.append("jsnap", {"snapshot_seq": state.last_seq})
+        # The fold/flush is recovery work too: re-stamp the duration so
+        # the report (and the takeover budget) covers it.
+        rec["duration_ms"] = round((_walltime.monotonic() - t0) * 1000.0, 3)
+    else:
+        rec = _reconcile(sched, journal, state, t0, batched=False)
+    journal.append("jrecover", {"divergences": len(rec["divergences"]),
+                                "torn_tail": state.torn_tail})
+    sched.tracer.emit(dict(rec))
+    sched._last_recovery_report = rec
+    # The recovered tables AS REBUILT, before the resume pass below
+    # rebalances anything — what the model checker compares against the
+    # pre-crash state at a quiescent crash point.
+    sched._recovered_tables = logical_tables(sched)
+    if sched.m_recovery_seconds is not None:
+        sched.m_recovery_seconds.set(rec["duration_ms"] / 1000.0)
+    sched.trigger_resched("resume")
+    return rec
+
+
+def _fold_or_flush(sched, journal, state: JournalState, batch,
+                   allow_fold: bool = True) -> bool:
+    """End-of-recovery durability commit (the fastpath's second half):
+    when the active segment plus the buffered resume records would
+    outgrow the compaction bound, fold — apply the buffered records
+    into the already-materialized state and write it as a fresh
+    snapshot, truncating the segment (the recovery IS the compaction:
+    no re-parse, no separate fold at the resume pass's commit point).
+    Below the bound the batch simply flushes as one storage write on
+    exit. Every crash window stays safe: the snapshot rename is atomic
+    and replay dedups by seq, so losing the race anywhere only costs
+    extra replay."""
+    from vodascheduler_tpu.durability import snapshot as snap_mod
+
+    if not allow_fold or (journal.size_bytes() + len(batch.buffer)
+                          < journal.compact_bytes // 2):
+        return False  # flush on batch exit (warm takeover / small segment)
+    # The fold is the recovery's one DESTRUCTIVE write (snapshot
+    # install + segment truncate): fence it like the flush branch
+    # fences its storage append. A recovery that outlived the lease
+    # (a standby took over mid-reconcile) must raise here, not
+    # overwrite the new leader's committed records with a stale fold.
+    journal._check_fence()
+    applier = StandbyApplier(state)
+    for rec in batch.consume():
+        applier.apply(rec)
+    snap_mod.write_snapshot(journal, state)
+    journal._records_cache = None
+    journal.storage.replace(b"")
+    return True  # the caller appends the jsnap marker post-batch
+
+
+def _reconcile(sched, journal, state: JournalState, t0: float,
+               batched: bool) -> dict:
+    """The reconcile phase shared by both recovery paths: rebuild the
+    scheduler's tables from store + replayed state, audit every
+    divergence vs the backend's live view (see module doc)."""
     divergences: List[dict] = []
     if state.torn_tail:
         _add_divergence(divergences, "journal_torn_tail", "")
     if state.stale_records:
         _add_divergence(divergences, "stale_epoch_dropped", "")
     running = sched.backend.running_jobs()
+    booked_out: Dict[str, int] = {}
     for job in sched.store.list_jobs(pool=sched.pool_id):
         name = job.name
         jstat = state.statuses.get(name)
@@ -254,7 +432,14 @@ def recover_scheduler(sched) -> dict:
             pool=sched.pool_id, journal=journal)
         job.metrics.last_update_time = sched.clock.now()
         sched.ready_jobs[name] = job
-        sched.job_num_chips.commit(name, n)
+        if batched:
+            booked_out[name] = n
+        else:
+            sched.job_num_chips.commit(name, n)
+    if batched:
+        # One delta-encoded jpass + one table swap for the whole fleet
+        # instead of a journaled ledger commit per job.
+        sched.job_num_chips.commit_pass(booked_out)
     # Hysteresis/cooldown clocks: exactly the pre-crash values.
     sched._last_resize_at.update(
         {j: t for j, t in state.resize_at.items()
@@ -301,9 +486,7 @@ def recover_scheduler(sched) -> dict:
                 pass           # backend's own monitor collects stragglers
     _restore_models(sched, state)
     duration = _walltime.monotonic() - t0
-    journal.append("jrecover", {"divergences": len(divergences),
-                                "torn_tail": state.torn_tail})
-    rec = {
+    return {
         "kind": "recovery_report",
         "schema": obs_audit.SCHEMA_VERSION,
         "ts": sched.clock.now(),
@@ -317,16 +500,6 @@ def recover_scheduler(sched) -> dict:
         "divergences": divergences,
         "duration_ms": round(duration * 1000.0, 3),
     }
-    sched.tracer.emit(dict(rec))
-    sched._last_recovery_report = rec
-    # The recovered tables AS REBUILT, before the resume pass below
-    # rebalances anything — what the model checker compares against the
-    # pre-crash state at a quiescent crash point.
-    sched._recovered_tables = logical_tables(sched)
-    if sched.m_recovery_seconds is not None:
-        sched.m_recovery_seconds.set(duration)
-    sched.trigger_resched("resume")
-    return rec
 
 
 def _restore_models(sched, state: JournalState) -> None:

@@ -17,6 +17,13 @@ from typing import Dict, List
 FIELDS = [
     "epoch", "epoch_time_sec", "step_time_sec", "workers",
     "global_batch_size", "local_batch_size", "start_time", "total_epochs",
+    # Placement context (doc/learned-models.md): the normalized spread
+    # of the incarnation's host set and the chip-weighted co-tenancy of
+    # its hosts, stamped by the backend at spawn (VODA_PLACEMENT_SPREAD
+    # / VODA_PLACEMENT_COTENANCY). Without them every real-mode row
+    # reads as contiguous/exclusive and the collector's burden
+    # deflation and fraction estimators stay silent.
+    "spread", "cotenancy",
 ]
 
 
@@ -33,12 +40,34 @@ class EpochCsvLogger:
         os.makedirs(metrics_dir, exist_ok=True)
         self.next_epoch = 0
         if os.path.exists(self.path):
+            self._migrate_header()
             rows = read_epoch_csv(self.path)
             if rows:
                 self.next_epoch = int(rows[-1]["epoch"]) + 1
 
+    def _migrate_header(self) -> None:
+        """Rewrite a pre-upgrade CSV whose header lacks columns FIELDS
+        has since grown (spread/cotenancy): appending wider rows under
+        the old header would push the new values into DictReader's
+        restkey — silently lost — and read as ragged to strict parsers.
+        Old rows get the missing columns empty (read back as 0.0)."""
+        with open(self.path, newline="") as f:
+            reader = csv.DictReader(f)
+            header = reader.fieldnames
+            if header is None or set(FIELDS) <= set(header):
+                return
+            rows = list(reader)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=FIELDS, extrasaction="ignore")
+            w.writeheader()
+            for r in rows:
+                w.writerow({k: r.get(k, "") for k in FIELDS})
+        os.replace(tmp, self.path)
+
     def log_epoch(self, epoch_time_sec: float, step_time_sec: float,
-                  workers: int, start_time: str = "") -> None:
+                  workers: int, start_time: str = "",
+                  spread: float = 0.0, cotenancy: float = 0.0) -> None:
         new_file = not os.path.exists(self.path) or os.path.getsize(self.path) == 0
         with open(self.path, "a", newline="") as f:
             w = csv.DictWriter(f, fieldnames=FIELDS)
@@ -55,6 +84,8 @@ class EpochCsvLogger:
                 "local_batch_size": local,
                 "start_time": start_time,
                 "total_epochs": self.total_epochs,
+                "spread": f"{spread:.4f}",
+                "cotenancy": f"{cotenancy:.4f}",
             })
         self.next_epoch += 1
 

@@ -215,6 +215,12 @@ _REQUIRED_ROUTE_FIELDS = ("kind", "schema", "ts", "job", "pool", "reasons",
 _REQUIRED_RECOVERY_FIELDS = ("kind", "schema", "ts", "pool", "epoch",
                              "last_seq", "records", "torn_tail",
                              "divergences", "duration_ms")
+# One hot-standby takeover (doc/durability.md "Hot standby"): the
+# end-to-end budget (lease-loss -> first committed decide), the suffix
+# the final drain fed, and the reconcile's recovery_report summary.
+_REQUIRED_TAKEOVER_FIELDS = ("kind", "schema", "ts", "pool", "epoch",
+                             "suffix_records", "applied_seq",
+                             "duration_ms", "recovery_ms", "divergences")
 # The what-if shadow planner's record (doc/learned-models.md "What-if
 # planner"): a read-only shadow decide scored off the decide critical
 # path — the allocator's would-be grant plus a candidate table modeled
@@ -251,6 +257,8 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
         return _validate_route(rec)
     if kind == "recovery_report":
         return _validate_recovery(rec)
+    if kind == "takeover_report":
+        return _check_fields(rec, _REQUIRED_TAKEOVER_FIELDS)
     if kind == "whatif_report":
         return _validate_whatif(rec)
     return [f"unknown record kind {kind!r}"]

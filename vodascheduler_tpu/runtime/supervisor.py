@@ -311,6 +311,18 @@ def run_job(workdir: str, num_chips: int,
                                 global_batch_size=spec.global_batch_size)
         # Trust the checkpoint for position; the CSV may lag a crash.
         logger.next_epoch = session.step // steps_per_epoch
+    # Placement context for the learned plane (doc/learned-models.md):
+    # the backend stamps this incarnation's normalized host-set spread
+    # and chip-weighted co-tenancy into the environment at spawn, and
+    # every epoch row carries them — without the columns, real-mode
+    # rows default to contiguous/exclusive and the collector's burden
+    # deflation never engages. Stamped per incarnation: a resize is a
+    # respawn (cold) or keeps the host set (in-place), so the values
+    # hold for every row this process writes.
+    placement_spread = float(os.environ.get("VODA_PLACEMENT_SPREAD")
+                             or 0.0)
+    placement_cotenancy = float(os.environ.get("VODA_PLACEMENT_COTENANCY")
+                                or 0.0)
 
     # The first step after every (re)build compiles the resharded XLA
     # program (20-40s on TPU). It must not enter the telemetry: the
@@ -544,7 +556,9 @@ def run_job(workdir: str, num_chips: int,
             logger.log_epoch(epoch_time_sec=step_time * steps_this_epoch,
                              step_time_sec=step_time,
                              workers=num_chips,
-                             start_time=str(time.time()))
+                             start_time=str(time.time()),
+                             spread=placement_spread,
+                             cotenancy=placement_cotenancy)
         if jax.process_index() == 0:
             # Greppable per-epoch loss: e2e artifacts parse these lines
             # to assert training-loss continuity across a checkpoint

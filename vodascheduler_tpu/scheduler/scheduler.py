@@ -148,6 +148,7 @@ class Scheduler:
         fractional_sharing: Optional[bool] = None,
         learned_models: Optional[bool] = None,
         journal=None,
+        recovered_state=None,
         tracer: Optional[obs_tracer.Tracer] = None,
         actuation_workers: Optional[int] = None,
         actuation_parallel: Optional[bool] = None,
@@ -269,6 +270,10 @@ class Scheduler:
         # for /debug/journal and the model checker.
         self._last_recovery_report: Optional[dict] = None
         self._recovered_tables: Optional[tuple] = None
+        # Hot-standby takeover stamp (takeover_report fields, set by
+        # durability/standby.finish_takeover) — the /debug/standby
+        # surface on a leader that was born from a warm standby.
+        self._last_takeover: Optional[dict] = None
 
         # Host capacity (reference: TotalGpus via node informer).
         self.total_chips = 0
@@ -395,14 +400,19 @@ class Scheduler:
             bus.subscribe(pool_id, self._on_job_events, batch=True)
 
         if resume:
-            if self.journal is not None and self.journal.has_state():
+            if self.journal is not None and (
+                    recovered_state is not None
+                    or self.journal.has_state()):
                 # Journal-backed recovery (doc/durability.md): replay
                 # the committed prefix, reconcile against the backend's
-                # live view, audit every corrective step.
+                # live view, audit every corrective step. A hot-standby
+                # takeover passes its applier's pre-materialized state
+                # (recovered_state) so recovery skips the replay and
+                # pays only the reconcile + first pass.
                 from vodascheduler_tpu.durability.recover import (
                     recover_scheduler,
                 )
-                recover_scheduler(self)
+                recover_scheduler(self, state=recovered_state)
             else:
                 self._construct_status_on_restart()
 
@@ -585,6 +595,20 @@ class Scheduler:
         and the new leader owns the journal's committed prefix."""
         j = self.journal
         if j is not None and j.fenced:
+            self._stopped = True
+            return True
+        return False
+
+    def _probe_leadership(self) -> bool:
+        """Actively probe the lease (one small read) at pass start: the
+        append-time fence alone cannot stop a deposed leader whose pass
+        decides a no-op booking delta — delta-encoded journaling
+        appends nothing, so nothing raises, and the pass would actuate
+        its (stale) migration wave against the shared backend. The
+        probe closes that window at the pass boundary; a deposition
+        landing MID-pass still fences at the first append, as before."""
+        j = self.journal
+        if j is not None and j.probe_fence():
             self._stopped = True
             return True
         return False
@@ -964,7 +988,7 @@ class Scheduler:
             self.rate_limit_seconds = seconds
 
     def _run_resched_now(self) -> None:
-        if self._journal_fenced():
+        if self._journal_fenced() or self._probe_leadership():
             return
         with self._lock:
             if (not self._resched_pending or self._stopped

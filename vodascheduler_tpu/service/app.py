@@ -101,6 +101,7 @@ class VodaApp:
                  collector_interval_seconds: float = 60.0,
                  resume: bool = False,
                  pools: Union[None, str, List[PoolSpec]] = None,
+                 standby: Optional[bool] = None,
                  kube: Optional[object] = None):
         self.workdir = os.path.abspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
@@ -129,46 +130,6 @@ class VodaApp:
 
         self.allocator = ResourceAllocator(self.store, registry=self.registry)
 
-        # Durability plane (doc/durability.md): one leadership lease for
-        # the process (fencing epochs), one write-ahead journal per pool
-        # plus a fleet journal for router decisions. VODA_JOURNAL=0
-        # runs the ephemeral pre-durability control plane.
-        self.lease = None
-        self.journals: Dict[str, object] = {}
-        self.fleet_journal = None
-        if config.JOURNAL:
-            from vodascheduler_tpu.durability.journal import Journal
-            from vodascheduler_tpu.durability.leader import FileLease
-            from vodascheduler_tpu.durability.leader import LeaseHeld
-            self.lease = FileLease(
-                os.path.join(self.workdir, "leader.lease"),
-                holder=f"pid:{os.getpid()}",
-                ttl_seconds=config.LEASE_TTL_SECONDS, clock=self.clock)
-            # A crash restart arrives with the dead leader's lease
-            # still unexpired (the PRIMARY recovery scenario): wait it
-            # out, bounded by one TTL + slack, instead of dying. A
-            # lease that keeps being RENEWED past the deadline is a
-            # genuinely live leader — then two leaders journaling one
-            # workdir is the split brain fencing exists to prevent,
-            # and startup fails loudly.
-            deadline = self.clock.now() + config.LEASE_TTL_SECONDS + 2.0
-            while True:
-                try:
-                    self.lease.try_acquire()
-                    break
-                except LeaseHeld:
-                    if self.clock.now() >= deadline:
-                        raise
-                    log.info("waiting out the previous leader's lease "
-                             "(%s)", self.workdir)
-                    self.clock.sleep(1.0)
-            self.fleet_journal = Journal(
-                os.path.join(self.workdir, "journal", "fleet.wal"),
-                epoch=self.lease.epoch, fence=self.lease.current_epoch,
-                clock=self.clock, fsync=config.JOURNAL_FSYNC,
-                compact_bytes=config.JOURNAL_COMPACT_BYTES)
-            self.lease.announce(self.fleet_journal, op="acquire")
-
         # Pool set: explicit multi-pool spec, or the single-pool args
         # (reference: one scheduler Deployment per GPU type; here one
         # Scheduler per pool in-process, same shared store/bus).
@@ -184,6 +145,116 @@ class VodaApp:
             # Two schedulers with one pool_id would race on the same bus
             # topic and collide their const-labeled metric series.
             raise ValueError(f"duplicate pool names: {names}")
+
+        # Durability plane (doc/durability.md): one leadership lease for
+        # the process (fencing epochs), one write-ahead journal per pool
+        # plus a fleet journal for router decisions. VODA_JOURNAL=0
+        # runs the ephemeral pre-durability control plane.
+        self.lease = None
+        self.journals: Dict[str, object] = {}
+        self.fleet_journal = None
+        self.hot_standby = None
+        self._takeovers: Dict[str, dict] = {}
+        takeover_epoch = 0
+        t_takeover = 0.0
+        standby = config.STANDBY if standby is None else bool(standby)
+        if config.JOURNAL:
+            import time as _walltime
+
+            from vodascheduler_tpu.durability.journal import Journal
+            from vodascheduler_tpu.durability.leader import FileLease
+            from vodascheduler_tpu.durability.leader import LeaseHeld
+            # Holder identity must be unique per INSTANCE, not per
+            # process: two VodaApps in one process (hermetic tests, an
+            # embedded standby) would otherwise silently re-acquire
+            # each other's lease as "their own".
+            self.lease = FileLease(
+                os.path.join(self.workdir, "leader.lease"),
+                holder=f"pid:{os.getpid()}.{id(self):x}",
+                ttl_seconds=config.LEASE_TTL_SECONDS, clock=self.clock)
+            try:
+                self.lease.try_acquire()
+            except LeaseHeld:
+                if standby:
+                    # Hot standby (doc/durability.md "Hot standby"): a
+                    # live leader holds the lease — tail its journals
+                    # via shipping, apply them continuously, and block
+                    # here until the lease is won; construction then
+                    # resumes as a WARM takeover (the appliers'
+                    # materialized states skip the replay).
+                    from vodascheduler_tpu.durability.shipping import (
+                        FileTailSource,
+                    )
+                    from vodascheduler_tpu.durability.standby import (
+                        HotStandby,
+                    )
+                    self.hot_standby = HotStandby(
+                        {ps.name: FileTailSource(os.path.join(
+                            self.workdir, "journal", f"{ps.name}.wal"))
+                         for ps in pool_specs},
+                        acquire=self.lease.try_acquire,
+                        clock=self.clock, registry=self.registry)
+                    log.info("standing by: tailing %d pool journal(s) "
+                             "until the leader's lease expires (%s)",
+                             len(pool_specs), self.workdir)
+                    self.hot_standby.run_until_leader()
+                    t_takeover = _walltime.monotonic()
+                    takeover_epoch = self.lease.epoch
+                    self._takeovers = self.hot_standby.prepare_takeovers()
+                    resume = True
+                else:
+                    # A crash restart arrives with the dead leader's
+                    # lease still unexpired (the PRIMARY recovery
+                    # scenario): wait it out, bounded by one TTL +
+                    # slack, instead of dying. A lease that keeps being
+                    # RENEWED past the deadline is a genuinely live
+                    # leader — then two leaders journaling one workdir
+                    # is the split brain fencing exists to prevent, and
+                    # startup fails loudly.
+                    deadline = (self.clock.now()
+                                + config.LEASE_TTL_SECONDS + 2.0)
+                    while True:
+                        try:
+                            self.lease.try_acquire()
+                            break
+                        except LeaseHeld:
+                            if self.clock.now() >= deadline:
+                                raise
+                            log.info("waiting out the previous leader's "
+                                     "lease (%s)", self.workdir)
+                            self.clock.sleep(1.0)
+            self.fleet_journal = Journal(
+                os.path.join(self.workdir, "journal", "fleet.wal"),
+                epoch=self.lease.epoch, fence=self.lease.current_epoch,
+                clock=self.clock, fsync=config.JOURNAL_FSYNC,
+                compact_bytes=config.JOURNAL_COMPACT_BYTES)
+            self.lease.announce(self.fleet_journal, op="acquire")
+            for ps in pool_specs:
+                bundle = self._takeovers.get(ps.name)
+                self.journals[ps.name] = Journal(
+                    os.path.join(self.workdir, "journal",
+                                 f"{ps.name}.wal"),
+                    epoch=self.lease.epoch,
+                    fence=self.lease.current_epoch, clock=self.clock,
+                    fsync=config.JOURNAL_FSYNC,
+                    compact_bytes=config.JOURNAL_COMPACT_BYTES,
+                    resume_hint=(bundle["resume_hint"]
+                                 if bundle is not None else None))
+
+        # Cold multi-pool resume: replay every pool's journal
+        # concurrently on a bounded executor BEFORE the serial scheduler
+        # construction below, so an N-pool restart pays the slowest
+        # pool's replay, not the sum (doc/durability.md "Hot standby").
+        self._recovered_states: Dict[str, object] = {
+            name: b["state"] for name, b in self._takeovers.items()}
+        if resume and not self._takeovers and len(self.journals) > 1:
+            from vodascheduler_tpu.durability.recover import (
+                read_states_parallel,
+            )
+            with_state = {name: jnl for name, jnl in self.journals.items()
+                          if jnl.has_state()}
+            self._recovered_states = read_states_parallel(
+                with_state, workers=config.FLEET_WORKERS)
 
         if backend not in ("local", "gke"):
             raise ValueError(f"unknown backend {backend!r} (local = "
@@ -247,24 +318,27 @@ class VodaApp:
                                   topology=ps.topology, clock=self.clock)
             pm = PlacementManager(pool_id=ps.name, topology=ps.topology,
                                   registry=self.registry)
-            jnl = None
-            if self.lease is not None:
-                from vodascheduler_tpu.durability.journal import Journal
-                jnl = Journal(
-                    os.path.join(self.workdir, "journal",
-                                 f"{ps.name}.wal"),
-                    epoch=self.lease.epoch,
-                    fence=self.lease.current_epoch, clock=self.clock,
-                    fsync=config.JOURNAL_FSYNC,
-                    compact_bytes=config.JOURNAL_COMPACT_BYTES)
-                self.journals[ps.name] = jnl
+            jnl = self.journals.get(ps.name)
             sched = Scheduler(
                 pool_id=ps.name, backend=be, store=self.store,
                 allocator=self.allocator, clock=self.clock, bus=self.bus,
                 algorithm=ps.algorithm or algorithm,
                 rate_limit_seconds=rate_limit_seconds,
                 resume=resume, registry=self.registry,
+                recovered_state=self._recovered_states.get(ps.name),
                 placement_manager=pm, journal=jnl, tracer=self.tracer)
+            bundle = self._takeovers.get(ps.name)
+            if bundle is not None:
+                # Warm takeover complete for this pool: stamp the
+                # end-to-end budget + the takeover_report record
+                # (doc/durability.md "Hot standby").
+                from vodascheduler_tpu.durability.standby import (
+                    finish_takeover,
+                )
+                finish_takeover(
+                    sched, self.hot_standby.pools[ps.name], t_takeover,
+                    takeover_epoch, bundle["suffix_records"],
+                    registry=self.registry)
             self.backends[ps.name] = be
             self.placements[ps.name] = pm
             self.schedulers[ps.name] = sched
@@ -349,7 +423,9 @@ class VodaApp:
             self.admission, self.registry, host=host, port=service_port)
         self.scheduler_server = make_scheduler_server(
             self.schedulers, self.registry, host=host, port=scheduler_port,
-            fleet=self.fleet)
+            fleet=self.fleet,
+            standby_stats=(self.hot_standby.stats
+                           if self.hot_standby is not None else None))
         self.allocator_server = make_allocator_server(
             self.allocator, self.registry, host=host, port=allocator_port)
 
@@ -440,6 +516,11 @@ def main(argv=None) -> int:
     parser.add_argument("--resume", action="store_true",
                         help="reconstruct state from store + running jobs "
                              "(reference: -resume flag)")
+    parser.add_argument("--standby", action="store_true",
+                        help="hot standby (doc/durability.md): if a live "
+                             "leader holds the lease, tail its journals "
+                             "and take over the moment the lease expires "
+                             "(also VODA_STANDBY=1)")
     parser.add_argument("--collector-interval", type=float, default=60.0)
     args = parser.parse_args(argv)
 
@@ -449,7 +530,8 @@ def main(argv=None) -> int:
                   hermetic_devices=args.hermetic_devices, chips=args.chips,
                   host=args.host, resume=args.resume,
                   collector_interval_seconds=args.collector_interval,
-                  pools=args.pools)
+                  pools=args.pools,
+                  standby=True if args.standby else None)
     app.start()
     try:
         import threading

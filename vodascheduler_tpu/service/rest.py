@@ -383,7 +383,8 @@ def make_service_server(admission: AdmissionService, registry: Registry,
 def make_scheduler_server(scheduler, registry: Registry,
                           host: str = "0.0.0.0",
                           port: int = config.SCHEDULER_PORT,
-                          fleet=None) -> RestServer:
+                          fleet=None,
+                          standby_stats=None) -> RestServer:
     """Scheduler API (reference: scheduler.go:256-261).
 
     Accepts a single Scheduler or a {pool: Scheduler} dict; with several
@@ -490,6 +491,58 @@ def make_scheduler_server(scheduler, registry: Registry,
         except KeyError as e:
             return 404, {"error": str(e)}
 
+    def debug_standby(body, query):
+        """The hot-standby surface (doc/durability.md "Hot standby"):
+        whether this leader was born from a warm standby takeover (the
+        takeover_report fields: budget, suffix drained, divergences),
+        plus the process's standby-phase shipping stats when it ran
+        one. Backs the `voda top` durability line's takeover row."""
+        out = {"takeovers": {
+            name: dict(s._last_takeover)
+            for name, s in sorted(schedulers.items())
+            if s._last_takeover is not None}}
+        if standby_stats is not None:
+            try:
+                out["standby"] = standby_stats()
+            except Exception as e:  # noqa: BLE001 - surface, never 500
+                out["standby_error"] = str(e)
+        return 200, out
+
+    def _journal_of(body, query):
+        jnl = pick(body, query).journal
+        if jnl is None:
+            raise ValueError("journal disabled on this pool "
+                             "(VODA_JOURNAL=0): nothing to ship")
+        return jnl
+
+    def journal_segment(body, query):
+        """Shipped-segment fetch (doc/durability.md "Hot standby"): the
+        active journal segment's raw framed bytes from ?offset=N — what
+        a cross-host standby's HttpTailSource polls. ?stat=1 answers
+        just the size, so the poll loop pays one cheap probe per idle
+        cycle instead of a full transfer."""
+        jnl = _journal_of(body, query)
+        if query.get("stat"):
+            return 200, {"size_bytes": jnl.size_bytes(),
+                         "epoch": jnl.epoch}
+        # Suffix served via a storage-level offset read (a seek, not a
+        # whole-file read-and-slice): a caught-up standby polling every
+        # second must cost the leader the suffix, not the segment.
+        offset = max(0, int(query.get("offset", ["0"])[0]))
+        return 200, Raw("application/octet-stream",
+                        jnl.storage.read(offset))
+
+    def journal_snapshot(body, query):
+        """The journal's latest snapshot (raw JSON; empty body when no
+        fold has happened yet) — the bootstrap half of the shipped-
+        segment fetch path: a fresh cross-host standby loads this, then
+        follows the segment suffix."""
+        snap = _journal_of(body, query).load_snapshot()
+        if snap is None:
+            return 200, Raw("application/json", b"")
+        return 200, Raw("application/json",
+                        json.dumps(snap, default=str).encode())
+
     def debug_fleet(body, query):
         """One fleet view over every pool (doc/observability.md "Fleet
         decide"): lock-free per-pool load snapshot, per-pool decide/
@@ -515,6 +568,9 @@ def make_scheduler_server(scheduler, registry: Registry,
         ("GET", "/debug/whatif"): debug_whatif,
         ("GET", "/debug/whatif/*"): debug_whatif,
         ("GET", "/debug/journal"): debug_journal,
+        ("GET", "/debug/standby"): debug_standby,
+        ("GET", "/journal/segment"): journal_segment,
+        ("GET", "/journal/snapshot"): journal_snapshot,
         ("GET", "/debug/fleet"): debug_fleet,
         ("GET", "/metrics"): _metrics_route(registry),
     }, host, port)
