@@ -8,7 +8,7 @@ CXX ?= g++
 NATIVE_SRC := vodascheduler_tpu/native/voda_native.cc
 NATIVE_SO := vodascheduler_tpu/native/_voda_native.so
 
-.PHONY: test test-all test-fast lint lint-baseline vodacheck modelcheck modelcheck-fleet modelcheck-crash modelcheck-selftest journal-fsck failover-bench lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
+.PHONY: test test-all test-fast lint lint-baseline vodacheck racecheck racecheck-selftest thread-roles check-all modelcheck modelcheck-fleet modelcheck-crash modelcheck-selftest journal-fsck failover-bench lock-order bench bench-dryrun trace-dryrun perf-baseline perf-gate native docker deploy-gke clean
 
 # Default: the fast suite (~6 min on one CPU core). Compile-heavy JAX
 # matrices and subprocess e2e tests are marked `slow`;
@@ -44,6 +44,33 @@ lint-baseline:
 # release on its exception edge. No baseline, no suppressions.
 vodacheck:
 	$(PY) -m vodascheduler_tpu.analysis.vodacheck vodascheduler_tpu
+
+# vodarace: the thread-role x shared-state race checker
+# (doc/static-analysis.md "vodarace") — discovers every thread entry
+# point, propagates roles through the call graph, and rejects any
+# attribute two roles can reach that is written without a lock. Zero
+# baseline: accepted lock-free seams are inline
+# `# vodarace: ignore[rule] reason` suppressions.
+racecheck:
+	$(PY) -m vodascheduler_tpu.analysis.vodarace vodascheduler_tpu
+
+# Prove the race checker has teeth: the live tree must be clean and
+# every seeded race in vodarace.VARIANTS (dropped metrics lock, REST
+# handler writing a scheduler table, actuation bookkeeping outside the
+# re-acquired lock) must be CAUGHT with a file:line finding.
+racecheck-selftest:
+	$(PY) -m vodascheduler_tpu.analysis.vodarace --selftest
+
+# Regenerate the pinned thread-role ownership map
+# (doc/thread_roles.json) from a fresh vodarace inference. Review the
+# diff like doc/lock_order.json — tests/test_vodarace.py and the
+# witnessed stress test both pin it.
+thread-roles:
+	$(PY) -m vodascheduler_tpu.analysis.vodarace \
+		--write-map doc/thread_roles.json
+
+# The full static stack in one shot (what CI runs before the suite).
+check-all: lint vodacheck racecheck racecheck-selftest modelcheck modelcheck-selftest
 
 # Bounded exhaustive model check: BFS the REAL scheduler + fake backend
 # + VirtualClock over every interleaving of events and injected faults

@@ -67,6 +67,8 @@ def _build():
 
 LOCK_ORDER_PINNED = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "doc", "lock_order.json")
+THREAD_ROLES_PINNED = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "doc", "thread_roles.json")
 
 
 def test_scheduler_survives_concurrent_hammering(lock_witness):
@@ -82,6 +84,18 @@ def test_scheduler_survives_concurrent_hammering(lock_witness):
     lock_witness.instrument(clock, "_lock", "virtual_clock._lock")
     lock_witness.instrument(fleet, "_lock", "fleet._lock")
     lock_witness.guard_backend(backend, "fake_backend")
+    # Access witness (doc/static-analysis.md "Race witness"): every
+    # private-attribute touch on the scheduler and fleet coordinator is
+    # recorded as (thread role, class, attr, kind, lock-held?) and must
+    # be a subset of the statically-pinned doc/thread_roles.json
+    # ownership map. Shares the lock witness's TLS stack so "guarded"
+    # means the owner's instrumented lock really was held.
+    from vodascheduler_tpu.analysis import RaceWitness
+    race_witness = RaceWitness(locks_held_fn=lock_witness.held)
+    race_witness.watch(sched, cls_name="Scheduler",
+                       guard_locks=("scheduler._lock",))
+    race_witness.watch(fleet, cls_name="FleetCoordinator",
+                       guard_locks=("fleet._lock",))
     errors = []
     stop = threading.Event()
     submitted = []
@@ -135,22 +149,38 @@ def test_scheduler_survives_concurrent_hammering(lock_witness):
             time.sleep(0.004)
 
     def reader():
+        # REST-shaped traffic: the snapshot cache and the lock-free
+        # fleet view, exactly what scrapes and dashboards hit.
         while not stop.is_set():
             table = sched.status_table()
             for row in table:
                 assert row["chips"] >= 0
-            # Pump through the fleet coordinator (the production driver)
-            # and read the lock-free fleet view mid-storm — witnessing
-            # that fleet._lock nests into nothing (a leaf).
-            fleet.run_pending()
             snap = fleet.fleet_snapshot()
             assert snap["totals"]["pools"] == 1
+            time.sleep(0.001)
+
+    def pumper():
+        # Decide-shaped traffic: pump through the fleet coordinator (the
+        # production driver) so the witness records the fleet lock's
+        # (leaf) behavior, then the scheduler's own pending-pass pump.
+        while not stop.is_set():
+            fleet.run_pending()
             sched.pump()
             sched.update_time_metrics()
             time.sleep(0.001)
 
-    threads = [threading.Thread(target=guard(fn), daemon=True)
-               for fn in (submitter, advancer, chaos, reader)]
+    # Role-prefixed names (vodarace.ROLE_PREFIXES): each storm thread
+    # impersonates the production role whose entry points it drives, so
+    # the access witness checks its touches against that role's pinned
+    # ownership row — an unnamed thread would be "main" and invisible.
+    roles = {submitter: "voda-rest-submitter",
+             advancer: "voda-timer-advancer",
+             chaos: "voda-rest-chaos",
+             reader: "voda-rest-reader",
+             pumper: "voda-scheduler-daemon-pump"}
+    threads = [threading.Thread(target=guard(fn), daemon=True,
+                                name=roles[fn])
+               for fn in (submitter, advancer, chaos, reader, pumper)]
     deadline = time.monotonic() + WALL_BUDGET_SECONDS
     for t in threads:
         t.start()
@@ -207,6 +237,17 @@ def test_scheduler_survives_concurrent_hammering(lock_witness):
     assert not new_edges, (
         f"unreviewed lock nesting(s) {new_edges}: update "
         f"doc/lock_order.json via `make lock-order` if intentional")
+
+    # Access-witness verdict: everything the storm's role threads
+    # touched must be inside the statically-pinned ownership map, and
+    # every map-guarded access must have held the owner's lock. A miss
+    # means either a new ownership edge (regenerate via `make
+    # thread-roles`, review the diff) or a lock that went missing.
+    assert race_witness.observations(), \
+        "storm should witness real role-attributed accesses"
+    with open(THREAD_ROLES_PINNED) as f:
+        roles_pinned = json.load(f)
+    assert race_witness.problems(roles_pinned) == []
 
 
 @pytest.mark.parametrize("n_threads", [8])

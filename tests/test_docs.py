@@ -579,18 +579,20 @@ class TestLifecycleDoc:
 
 class TestStaticAnalysisDoc:
     def test_rule_catalog_matches_linter_registry(self):
-        """doc/static-analysis.md documents every vodalint AND vodacheck
-        rule id, and names no rule neither tool has."""
+        """doc/static-analysis.md documents every vodalint, vodacheck
+        AND vodarace rule id, and names no rule no tool has."""
         with open(os.path.join(REPO, "doc", "static-analysis.md")) as f:
             doc = f.read()
-        from vodascheduler_tpu.analysis import vodacheck, vodalint
+        from vodascheduler_tpu.analysis import vodacheck, vodalint, vodarace
         for rule in vodalint.RULES:
             assert f"`{rule}`" in doc, f"vodalint rule {rule!r} undocumented"
         for rule in vodacheck.RULES:
             assert f"`{rule}`" in doc, f"vodacheck rule {rule!r} undocumented"
+        for rule in vodarace.RULES:
+            assert f"`{rule}`" in doc, f"vodarace rule {rule!r} undocumented"
         documented = set(re.findall(r"\| `([a-z\-_]+)` \|", doc))
         known = (set(vodalint.RULES) | set(vodacheck.RULES)
-                 | set(_modelcheck_invariants()))
+                 | set(vodarace.RULES) | set(_modelcheck_invariants()))
         unknown = documented - known
         assert not unknown, f"documented but not in any registry: {unknown}"
 
@@ -613,6 +615,10 @@ class TestStaticAnalysisDoc:
         assert "vodalint_baseline.jsonl" in doc
         assert "lock_order.json" in doc
         assert "make lint" in doc and "make lock-order" in doc
+        assert "thread_roles.json" in doc
+        for target in ("make racecheck", "racecheck-selftest",
+                       "make thread-roles", "--format sarif"):
+            assert target in doc, f"{target!r} missing"
 
     def test_span_vocabulary_documented(self):
         """SPAN_NAMES joins REASON_CODES/TRIGGERS in the pinned-doc
@@ -642,6 +648,46 @@ class TestStaticAnalysisDoc:
         for src, dsts in graph["edges"].items():
             assert src in graph["nodes"]
             assert all(d in graph["nodes"] for d in dsts)
+
+    def test_thread_roles_artifact_pinned(self):
+        """doc/thread_roles.json is committed, schema-valid, and embeds
+        the SAME prefix→role table the code ships — the witness resolves
+        thread names through vodarace.ROLE_PREFIXES, so a drifted copy
+        would attribute accesses to the wrong role silently."""
+        import json
+
+        from vodascheduler_tpu.analysis import vodarace
+        with open(os.path.join(REPO, "doc", "thread_roles.json")) as f:
+            pinned = json.load(f)
+        assert pinned["schema"] == vodarace.SCHEMA_VERSION
+        assert set(pinned) == {"schema", "role_prefixes", "roles",
+                               "immutable"}
+        assert pinned["role_prefixes"] == dict(vodarace.ROLE_PREFIXES)
+        assert pinned["roles"], "ownership map should not be empty"
+        assert "main" not in pinned["roles"]
+        for role, body in pinned["roles"].items():
+            assert role in vodarace.ROLES, f"unknown role {role!r}"
+            for cls, attrs in body["access"].items():
+                for attr, kinds in attrs.items():
+                    assert set(kinds) <= {"read", "write"}, (cls, attr)
+                    assert set(kinds.values()) <= {
+                        "guarded", "unguarded", "mixed"}, (cls, attr)
+
+    def test_thread_cast_documented(self):
+        """observability.md's thread-cast table names every role the
+        checker knows (except the excluded 'main')."""
+        from vodascheduler_tpu.analysis import vodarace
+        with open(os.path.join(REPO, "doc", "observability.md")) as f:
+            doc = f.read()
+        assert "The thread cast" in doc
+        for role in vodarace.ROLES:
+            if role == "main":
+                continue
+            assert f"| {role} |" in doc, f"role {role!r} undocumented"
+        for prefix, role in vodarace.ROLE_PREFIXES.items():
+            if role == "main":
+                continue
+            assert f"`{prefix}`" in doc, f"prefix {prefix!r} undocumented"
 
 
 def test_helm_chart_values_references_resolve():

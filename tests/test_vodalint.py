@@ -164,6 +164,89 @@ class TestLockDiscipline:
                         hosts = self.backend.list_hosts()
             """, "scheduler/x.py") == []
 
+    def test_emit_laundered_through_module_helper_flagged(self):
+        """The historical blind spot: the self-call map never followed
+        bare-name module helpers, so `with self._lock: _notify(...)`
+        hid an emit from the rule entirely."""
+        fs = findings("""
+            def _notify(bus, name):
+                bus.emit("job_events", name)
+
+            class S:
+                def bad(self, name):
+                    with self._lock:
+                        _notify(self.bus, name)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+        assert "_notify" in fs[0].message
+
+    def test_two_hop_module_helper_chain_flagged(self):
+        fs = findings("""
+            def _notify(bus, name):
+                bus.emit("x", name)
+
+            def _hop(bus, name):
+                _notify(bus, name)
+
+            class S:
+                def bad(self, name):
+                    with self._lock:
+                        _hop(self.bus, name)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_method_calling_dangerous_helper_flagged(self):
+        # self-method hop INTO a module helper: two different edge
+        # kinds composed.
+        fs = findings("""
+            def _notify(bus, name):
+                bus.emit("x", name)
+
+            class S:
+                def _tell(self, name):
+                    _notify(self.bus, name)
+                def bad(self, name):
+                    with self._lock:
+                        self._tell(name)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_module_helper_with_foreign_lock_region_flagged(self):
+        # Module functions guard with the OWNER's lock (no self at
+        # module scope) — that region is checked too.
+        fs = findings("""
+            def _notify(bus, name):
+                bus.emit("x", name)
+
+            def apply(sched, name):
+                with sched._lock:
+                    _notify(sched.bus, name)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["lock-discipline"]
+
+    def test_clean_module_helper_not_flagged(self):
+        assert findings("""
+            def _fmt(name):
+                return name.title()
+
+            class S:
+                def good(self, name):
+                    with self._lock:
+                        self._t[name] = _fmt(name)
+            """, "scheduler/x.py") == []
+
+    def test_helper_called_outside_lock_clean(self):
+        assert findings("""
+            def _notify(bus, name):
+                bus.emit("x", name)
+
+            class S:
+                def good(self, name):
+                    with self._lock:
+                        ev = name
+                    _notify(self.bus, ev)
+            """, "scheduler/x.py") == []
+
 
 class TestVocab:
     def test_unknown_reason_code_flagged(self):
@@ -294,7 +377,7 @@ class TestThreadHygiene:
         fs = findings("""
             import threading
             def g():
-                t = threading.Thread(target=g)
+                t = threading.Thread(target=g, name="voda-x")
                 t.start()
             """, "service/x.py")
         assert rules_of(fs) == ["thread-daemon"]
@@ -303,7 +386,8 @@ class TestThreadHygiene:
         assert findings("""
             import threading
             def g():
-                threading.Thread(target=g, daemon=True).start()
+                threading.Thread(target=g, daemon=True,
+                                 name="voda-x").start()
             """, "service/x.py") == []
 
     def test_daemon_attribute_after_construction_clean(self):
@@ -312,8 +396,96 @@ class TestThreadHygiene:
             def g():
                 timer = threading.Timer(1.0, g)
                 timer.daemon = True
+                timer.name = "voda-timer-x"
                 timer.start()
             """, "common/x.py") == []
+
+    def test_anonymous_thread_flagged(self):
+        fs = findings("""
+            import threading
+            def g():
+                threading.Thread(target=g, daemon=True).start()
+            """, "service/x.py")
+        assert rules_of(fs) == ["thread-name"]
+
+    def test_non_voda_name_flagged(self):
+        fs = findings("""
+            import threading
+            def g():
+                threading.Thread(target=g, daemon=True,
+                                 name="worker-1").start()
+            """, "service/x.py")
+        assert rules_of(fs) == ["thread-name"]
+
+    def test_voda_fstring_name_clean(self):
+        assert findings("""
+            import threading
+            def g(port):
+                threading.Thread(target=g, daemon=True,
+                                 name=f"voda-rest-{port}").start()
+            """, "service/x.py") == []
+
+    def test_name_attribute_after_construction_clean(self):
+        assert findings("""
+            import threading
+            def g():
+                t = threading.Thread(target=g, daemon=True)
+                t.name = "voda-monitor-x"
+                t.start()
+            """, "cluster/x.py") == []
+
+    def test_dynamic_name_expression_accepted(self):
+        # A name the AST cannot read is not judged (the runtime witness
+        # still sees the real name).
+        assert findings("""
+            import threading
+            def g(name):
+                threading.Thread(target=g, daemon=True,
+                                 name=name).start()
+            """, "service/x.py") == []
+
+    def test_executor_without_prefix_flagged(self):
+        fs = findings("""
+            from concurrent.futures import ThreadPoolExecutor
+            def g():
+                return ThreadPoolExecutor(max_workers=2)
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["thread-name"]
+
+    def test_executor_with_voda_prefix_clean(self):
+        assert findings("""
+            from concurrent.futures import ThreadPoolExecutor
+            def g():
+                return ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="voda-actuate")
+            """, "scheduler/x.py") == []
+
+    def test_executor_with_foreign_prefix_flagged(self):
+        fs = findings("""
+            from concurrent.futures import ThreadPoolExecutor
+            def g():
+                return ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="pool")
+            """, "scheduler/x.py")
+        assert rules_of(fs) == ["thread-name"]
+
+    def test_thread_name_suppressable(self):
+        assert findings("""
+            import threading
+            def g():
+                threading.Thread(target=g, daemon=True).start()  # vodalint: ignore[thread-name] test-local helper thread
+            """, "service/x.py") == []
+
+    def test_stripping_a_thread_name_in_events_fails(self):
+        """Re-introduction: the event-drain thread's role name is what
+        lets vodarace attribute its accesses — deleting it must fail."""
+        with open(os.path.join(PKG, "common", "events.py")) as f:
+            src = f.read()
+        needle = 'name=f"voda-event-drain-{topic}",\n'
+        assert needle in src
+        broken = src.replace(needle, "")
+        fs = vodalint.lint_source(broken, "common/events.py")
+        assert "thread-name" in {f.rule for f in fs}
 
     def test_submit_without_context_flagged(self):
         fs = findings("""

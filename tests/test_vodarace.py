@@ -1,0 +1,490 @@
+"""vodarace: the thread-role × shared-state race checker, its pinned
+ownership map, and the runtime access witness. Each rule gets a
+positive (fires on a synthetic tree), a negative (stays quiet), and a
+suppressed fixture; then the live package must check clean, every
+seeded selftest variant must be CAUGHT again when re-applied, the
+committed doc/thread_roles.json must match a fresh inference, and the
+RaceWitness must flag observations that escape the map — the "deleting
+any one enforced invariant breaks the build" guarantee, extended to
+the concurrency plane."""
+
+import io
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from vodascheduler_tpu.analysis import RaceViolation, RaceWitness, vodarace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "vodascheduler_tpu")
+THREAD_ROLES = os.path.join(REPO, "doc", "thread_roles.json")
+
+
+def analyze(tmp_path, sources):
+    """Analyze a synthetic tree: {rel: src} against an empty package
+    root, so no live-tree class couples into the fixture call graph."""
+    overrides = {rel: textwrap.dedent(src) for rel, src in sources.items()}
+    return vodarace.analyze_package(str(tmp_path), overrides=overrides)
+
+
+def findings(tmp_path, sources):
+    return vodarace.race_findings(analyze(tmp_path, sources))
+
+
+def rules_of(fs):
+    return [f.rule for f in fs]
+
+
+# A class whose table is touched by a REST-role handler thread and a
+# role thread it starts itself; `tail` controls the racy method's body.
+def _two_role_fixture(tail, init_extra=""):
+    tail_block = textwrap.indent(
+        textwrap.dedent(tail).strip("\n") or "pass", "        ")
+    extra = textwrap.indent(textwrap.dedent(init_extra).strip("\n"), "    ")
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._table = {}\n"
+        "\n"
+        + (extra + "\n\n" if extra.strip() else "")
+        + "    def start(self):\n"
+        "        t = threading.Thread(target=self._loop,\n"
+        "                             name=\"voda-monitor-x\",\n"
+        "                             daemon=True)\n"
+        "        t.start()\n"
+        "\n"
+        "    def _loop(self):\n"
+        + tail_block + "\n")
+    return {"scheduler/x.py": src,
+            "service/rest.py": """
+        def make_handlers(sched):
+            def get_table(body, query):
+                return dict(sched._table)
+            return {"GET /table": get_table}
+        """}
+
+
+class TestUnguardedSharedWrite:
+    def test_two_roles_unguarded_write_flagged(self, tmp_path):
+        fs = findings(tmp_path, _two_role_fixture("self._table['k'] = 1"))
+        assert rules_of(fs) == ["unguarded-shared-write"]
+        assert fs[0].path == "scheduler/x.py"
+        assert "Sched._table" in fs[0].message
+        assert "collector" in fs[0].message and "rest" in fs[0].message
+
+    def test_single_role_write_not_flagged(self, tmp_path):
+        # Only the collector loop touches the table — private state of
+        # one role is not a race, however unlocked.
+        src = _two_role_fixture("self._table['k'] = 1")
+        del src["service/rest.py"]
+        assert findings(tmp_path, src) == []
+
+    def test_mutator_call_counts_as_write(self, tmp_path):
+        # `self._table.clear()` mutates the container: races exactly
+        # like assignment even though the AST sees only a Load.
+        fs = findings(tmp_path, _two_role_fixture("self._table.clear()"))
+        assert rules_of(fs) == ["unguarded-shared-write"]
+
+    def test_augassign_counts_as_write(self, tmp_path):
+        fixture = _two_role_fixture("self._gen += 1")
+        fixture["scheduler/x.py"] = fixture["scheduler/x.py"].replace(
+            "self._table = {}", "self._table = {}\n        self._gen = 0")
+        fixture["service/rest.py"] = fixture["service/rest.py"].replace(
+            "sched._table", "sched._gen")
+        fs = findings(tmp_path, fixture)
+        assert rules_of(fs) == ["unguarded-shared-write"]
+        assert "Sched._gen" in fs[0].message
+
+    def test_suppressed_with_reason_clean(self, tmp_path):
+        fs = findings(tmp_path, _two_role_fixture(
+            "self._table['k'] = 1  "
+            "# vodarace: ignore[unguarded-shared-write] GIL-atomic"))
+        assert fs == []
+
+    def test_suppression_without_reason_flagged(self, tmp_path):
+        fs = findings(tmp_path, _two_role_fixture(
+            "self._table['k'] = 1  "
+            "# vodarace: ignore[unguarded-shared-write]"))
+        assert "suppression-empty-reason" in rules_of(fs)
+
+
+class TestGuardedReadUnguardedWrite:
+    def test_guarded_elsewhere_unguarded_here_flagged(self, tmp_path):
+        fs = findings(tmp_path, _two_role_fixture(
+            "self._table['k'] = 1",
+            init_extra="""
+            def put(self, k, v):
+                with self._lock:
+                    self._table[k] = v
+            """))
+        assert rules_of(fs) == ["guarded-read-unguarded-write"]
+        assert "guarded at" in fs[0].message
+        # the finding pins the UNGUARDED write, not the locked one
+        assert fs[0].line > 1
+
+    def test_all_sites_locked_clean(self, tmp_path):
+        assert findings(tmp_path, _two_role_fixture("""
+            with self._lock:
+                self._table['k'] = 1
+            """, init_extra="""
+            def put(self, k, v):
+                with self._lock:
+                    self._table[k] = v
+            """)) == []
+
+    def test_lock_via_helper_method_recognized(self, tmp_path):
+        # The locked-context fixpoint: a helper only ever called with
+        # the lock held inherits guarded-ness.
+        assert findings(tmp_path, _two_role_fixture("""
+            with self._lock:
+                self._apply()
+            """, init_extra="""
+            def _apply(self):
+                self._table['k'] = 1
+            """)) == []
+
+
+class TestImmutableAndScope:
+    def test_immutable_after_init_exempt(self, tmp_path):
+        # Written only in __init__, read everywhere: config, not state.
+        fs = findings(tmp_path, _two_role_fixture(
+            "x = self._table",
+            init_extra=""))
+        assert fs == []
+
+    def test_parse_error_reported(self, tmp_path):
+        fs = findings(tmp_path, {"scheduler/x.py": "def broken(:\n"})
+        assert rules_of(fs) == ["parse-error"]
+        assert fs[0].path == "scheduler/x.py"
+
+    def test_analysis_tooling_creates_no_roles(self, tmp_path):
+        # A driver under analysis/ calling into the class must not
+        # create role edges (ANALYZE_EXCLUDE).
+        src = _two_role_fixture("self._table['k'] = 1")
+        del src["service/rest.py"]
+        src["analysis/driver.py"] = """
+            def drive(s):
+                s._table["probe"] = 0
+            """
+        assert findings(tmp_path, src) == []
+
+
+class TestRolePlumbing:
+    def test_role_for_thread_name_prefixes(self):
+        assert vodarace.role_for_thread_name("voda-rest-8080") == "rest"
+        assert vodarace.role_for_thread_name(
+            "voda-scheduler-daemon-pool0") == "decide"
+        assert vodarace.role_for_thread_name("voda-actuate-0") == \
+            "actuate-worker"
+        assert vodarace.role_for_thread_name("voda-event-drain-jobs") == \
+            "drainer"
+        assert vodarace.role_for_thread_name("voda-standby-apply") == \
+            "standby"
+
+    def test_unknown_names_are_main(self):
+        assert vodarace.role_for_thread_name("MainThread") == "main"
+        assert vodarace.role_for_thread_name("Thread-7") == "main"
+        assert vodarace.role_for_thread_name(None) == "main"
+
+    def test_every_prefix_maps_to_a_known_role(self):
+        assert set(vodarace.ROLE_PREFIXES.values()) <= set(vodarace.ROLES)
+
+    def test_thread_entry_points_discovered(self, tmp_path):
+        an = analyze(tmp_path, _two_role_fixture("pass"))
+        assert any("scheduler/x.py:Sched._loop" in e
+                   for e in an.entry_points.get("collector", ()))
+
+
+class TestLiveTreeAndVariants:
+    def test_live_tree_clean(self):
+        fs = vodarace.race_findings(vodarace.analyze_package(PKG))
+        assert fs == [], "\n".join(
+            f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in fs)
+
+    @pytest.mark.parametrize("name", sorted(vodarace.VARIANTS))
+    def test_variant_reintroduction_caught(self, name):
+        rel, transform, rules = vodarace.VARIANTS[name]
+        with open(os.path.join(PKG, rel), encoding="utf-8") as f:
+            src = f.read()
+        fs = vodarace.race_findings(
+            vodarace.analyze_package(PKG, overrides={rel: transform(src)}))
+        hits = [f for f in fs if f.path == rel and f.rule in rules]
+        assert hits, (f"seeded race {name} not caught; findings in "
+                      f"{rel}: {[(f.line, f.rule) for f in fs]}")
+        assert all(f.line > 0 for f in hits)
+
+    def test_selftest_passes_and_reports_file_line(self):
+        out = io.StringIO()
+        assert vodarace.selftest(stream=out) == 0
+        text = out.getvalue()
+        assert "vodarace selftest: OK" in text
+        for name in vodarace.VARIANTS:
+            assert f"selftest {name}: CAUGHT" in text
+        # every CAUGHT line carries a file:line anchor
+        for line in text.splitlines():
+            if ": CAUGHT" in line:
+                assert ".py:" in line
+
+
+class TestPinnedMap:
+    def test_map_matches_committed_artifact(self):
+        fresh = vodarace.build_map(vodarace.analyze_package(PKG))
+        with open(THREAD_ROLES, encoding="utf-8") as f:
+            pinned = json.load(f)
+        assert fresh == pinned, (
+            "doc/thread_roles.json is stale — regenerate with "
+            "`make thread-roles` and review the ownership diff")
+
+    def test_map_is_deterministic(self):
+        a = vodarace.build_map(vodarace.analyze_package(PKG))
+        b = vodarace.build_map(vodarace.analyze_package(PKG))
+        assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                           sort_keys=True)
+
+    def test_map_schema(self):
+        with open(THREAD_ROLES, encoding="utf-8") as f:
+            m = json.load(f)
+        assert m["schema"] == vodarace.SCHEMA_VERSION
+        assert m["role_prefixes"] == dict(sorted(
+            vodarace.ROLE_PREFIXES.items()))
+        assert "main" not in m["roles"]
+        for role, body in m["roles"].items():
+            assert role in vodarace.ROLES
+            assert set(body) == {"entry_points", "access"}
+            for cls, attrs in body["access"].items():
+                for attr, kinds in attrs.items():
+                    assert set(kinds) <= {"read", "write"}
+                    assert set(kinds.values()) <= {
+                        "guarded", "unguarded", "mixed"}
+
+    def test_scheduler_core_ownership_pinned(self):
+        # Load-bearing rows: the decide role owns the scheduler tables
+        # under the lock; REST reads the snapshot cache.
+        with open(THREAD_ROLES, encoding="utf-8") as f:
+            m = json.load(f)
+        decide = m["roles"]["decide"]["access"]["Scheduler"]
+        assert "_in_resched" in decide
+        assert any(kinds.get("write") == "guarded"
+                   for kinds in decide.values())
+
+    def test_map_fixture_roundtrip(self, tmp_path):
+        an = analyze(tmp_path, _two_role_fixture(
+            "self._table['k'] = 1",
+            init_extra="""
+            def put(self, k, v):
+                with self._lock:
+                    self._table[k] = v
+            """))
+        m = vodarace.build_map(an)
+        rest = m["roles"]["rest"]["access"]["Sched"]
+        assert rest["_table"]["read"] == "unguarded"
+        coll = m["roles"]["collector"]["access"]["Sched"]
+        assert coll["_table"]["write"] == "unguarded"
+        path = tmp_path / "roles.json"
+        vodarace.write_map(str(path), an)
+        assert json.loads(path.read_text()) == m
+
+
+class TestCLI:
+    def test_run_clean_exits_zero(self):
+        out = io.StringIO()
+        assert vodarace.run([PKG], stream=out) == 0
+        assert "vodarace: 0 finding(s)" in out.getvalue()
+
+    def test_jsonl_byte_stable(self):
+        a, b = io.StringIO(), io.StringIO()
+        vodarace.run([PKG], fmt="jsonl", stream=a)
+        vodarace.run([PKG], fmt="jsonl", stream=b)
+        assert a.getvalue() == b.getvalue()
+
+    def test_sarif_output_well_formed(self):
+        out = io.StringIO()
+        vodarace.run([PKG], fmt="sarif", stream=out)
+        sarif = json.loads(out.getvalue())
+        assert sarif["version"] == "2.1.0"
+        tool = sarif["runs"][0]["tool"]["driver"]
+        assert tool["name"] == "vodarace"
+        assert {r["id"] for r in tool["rules"]} == set(vodarace.RULES)
+        assert sarif["runs"][0]["results"] == []
+
+
+# ---- the runtime access witness -------------------------------------------
+
+
+class _Box:
+    """A deliberately tiny shared object for witness unit tests."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._val = 0
+        self._frozen = "cfg"
+
+
+def _run_as(name, fn):
+    err = []
+
+    def wrapped():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - re-raised below
+            err.append(e)
+
+    t = threading.Thread(target=wrapped, name=name)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    if err:
+        raise err[0]
+
+
+def _pinned(access=None, immutable=None):
+    return {"schema": 1, "role_prefixes": dict(vodarace.ROLE_PREFIXES),
+            "roles": {"rest": {"entry_points": [],
+                               "access": access or {}}},
+            "immutable": immutable or {}}
+
+
+class TestRaceWitness:
+    def test_records_role_attributed_accesses(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box, guard_locks=())
+        _run_as("voda-rest-x", lambda: setattr(box, "_val", 1))
+        assert ("rest", "_Box", "_val", "write", False) in w.observations()
+
+    def test_main_thread_invisible(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        box._val = 2
+        _ = box._val
+        assert w.observations() == []
+
+    def test_subset_violation_on_unmapped_access(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        _run_as("voda-rest-x", lambda: setattr(box, "_val", 1))
+        problems = w.problems(_pinned())
+        assert problems and "not in the pinned ownership map" in problems[0]
+        with pytest.raises(RaceViolation):
+            w.check(_pinned())
+
+    def test_mapped_access_accepted(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        _run_as("voda-rest-x", lambda: setattr(box, "_val", 1))
+        pinned = _pinned(access={"_Box": {"_val": {"write": "unguarded"}}})
+        assert w.problems(pinned) == []
+
+    def test_guarded_requirement_enforced(self):
+        held = []
+        w = RaceWitness(locks_held_fn=lambda: list(held))
+        box = _Box()
+        w.watch(box, guard_locks=("box._lock",))
+        _run_as("voda-rest-x", lambda: setattr(box, "_val", 1))
+        pinned = _pinned(access={"_Box": {"_val": {"write": "guarded"}}})
+        problems = w.problems(pinned)
+        assert problems and "without box._lock held" in problems[0]
+        # same access with the lock witnessed as held: accepted
+        w2 = RaceWitness(locks_held_fn=lambda: ["box._lock"])
+        box2 = _Box()
+        w2.watch(box2, guard_locks=("box._lock",))
+        _run_as("voda-rest-x", lambda: setattr(box2, "_val", 1))
+        assert w2.problems(pinned) == []
+
+    def test_immutable_write_always_violates(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        _run_as("voda-rest-x", lambda: setattr(box, "_frozen", "oops"))
+        problems = w.problems(_pinned(immutable={"_Box": ["_frozen"]}))
+        assert problems and "immutable-after-__init__" in problems[0]
+
+    def test_immutable_read_free(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        _run_as("voda-rest-x", lambda: getattr(box, "_frozen"))
+        assert w.problems(_pinned(immutable={"_Box": ["_frozen"]})) == []
+
+    def test_lock_attrs_not_recorded(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+
+        def touch_lock():
+            with box._lock:
+                pass
+
+        _run_as("voda-rest-x", touch_lock)
+        assert w.observations() == []
+
+    def test_unwatch_restores_class(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        assert type(box) is not _Box
+        w.unwatch(box)
+        assert type(box) is _Box
+        _run_as("voda-rest-x", lambda: setattr(box, "_val", 3))
+        assert w.observations() == []
+
+    def test_behavior_transparent_under_watch(self):
+        w = RaceWitness()
+        box = _Box()
+        w.watch(box)
+        box._val = 41
+        assert box._val == 41
+        with box._lock:
+            box._val += 1
+        assert box._val == 42
+
+
+class TestLockRemovalFailsSomewhere:
+    """Acceptance criterion: removing a lock named in the pinned map
+    must fail EITHER the static checker OR the witness — the two halves
+    cover for each other."""
+
+    def test_static_half_catches_metrics_lock_removal(self):
+        rel, transform, rules = vodarace.VARIANTS["metrics-unlocked-accessor"]
+        with open(os.path.join(PKG, rel), encoding="utf-8") as f:
+            src = f.read()
+        fs = vodarace.race_findings(
+            vodarace.analyze_package(PKG, overrides={rel: transform(src)}))
+        assert any(f.rule in rules for f in fs)
+
+    def test_witness_half_catches_lock_gone_at_runtime(self):
+        # The map pins Scheduler's table accesses as guarded; a run that
+        # reaches them without the instrumented lock held (exactly what
+        # a deleted `with self._lock:` produces) must fail the witness.
+        with open(THREAD_ROLES, encoding="utf-8") as f:
+            pinned = json.load(f)
+        guarded_attr = None
+        decide = pinned["roles"]["decide"]["access"].get("Scheduler", {})
+        for attr, kinds in sorted(decide.items()):
+            if kinds.get("write") == "guarded":
+                guarded_attr = attr
+                break
+        assert guarded_attr, "map should pin guarded Scheduler writes"
+        w = RaceWitness(locks_held_fn=lambda: [])  # lock never held
+
+        class Scheduler:  # noqa: D401 - label stands in for the real one
+            pass
+
+        sched = Scheduler()
+        w.watch(sched, cls_name="Scheduler",
+                guard_locks=("scheduler._lock",))
+        _run_as("voda-scheduler-daemon-x",
+                lambda: setattr(sched, guarded_attr, 1))
+        problems = w.problems(pinned)
+        assert problems and "the map pins this access as guarded" in \
+            problems[0]

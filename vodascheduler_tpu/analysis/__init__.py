@@ -24,11 +24,69 @@ prose. This package machine-checks them:
   it records the global lock-acquisition-order graph, fails on cycles
   and on locks held across backend calls, and pins the witnessed graph
   as doc/lock_order.json.
+- `vodarace`: a thread-role × shared-state race checker — discovers
+  thread entry points package-wide, labels each with a role (rest,
+  decide, actuate-worker, drainer, timer, standby, collector), then
+  classifies every `self._x` access reachable from each role as
+  guarded or unguarded and flags unguarded shared writes. Pins the
+  inferred ownership map as doc/thread_roles.json. Run as
+  `python -m vodascheduler_tpu.analysis.vodarace` or `make racecheck`.
+- `racewitness`: the runtime sibling of lockwitness for vodarace —
+  instruments attribute access on witnessed objects during the
+  concurrency stress test and requires every observed
+  (role, class, attribute) access to appear in doc/thread_roles.json.
 
 Rule catalogs, the invariant catalog, and artifact formats:
 doc/static-analysis.md; the transition relation itself:
 doc/design/lifecycle.md.
 """
+
+from typing import Dict, List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def findings_to_sarif(tool: str, findings: List[object],
+                      rules: Optional[Dict[str, str]] = None,
+                      uri_prefix: str = "vodascheduler_tpu/") -> dict:
+    """Render Finding objects (anything with .path/.line/.rule/.message)
+    as a minimal SARIF 2.1.0 log — one run, one result per finding —
+    so CI can annotate PRs inline. Shared by vodalint, vodacheck and
+    vodarace (`--format sarif`); the jsonl format stays the byte-stable
+    one used for baselines."""
+    rules = rules or {}
+    rule_ids = sorted({f.rule for f in findings} | set(rules))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool,
+                "informationUri":
+                    "https://example.invalid/doc/static-analysis.md",
+                "rules": [{
+                    "id": rid,
+                    "shortDescription": {"text": rules.get(rid, rid)},
+                } for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": uri_prefix + f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(1, int(f.line))},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
 
 # NOTE: vodalint/vodacheck/modelcheck are deliberately NOT imported
 # here — each doubles as a `python -m ...` entry point, and an eager
@@ -38,3 +96,14 @@ from vodascheduler_tpu.analysis.lockwitness import (  # noqa: F401
     LockOrderViolation,
     LockOrderWitness,
 )
+
+
+def __getattr__(name):
+    # RaceWitness/RaceViolation are lazy (PEP 562): racewitness imports
+    # vodarace for the role table, and an eager import here would
+    # shadow `python -m vodascheduler_tpu.analysis.vodarace` (same
+    # runpy-shadowing reason the linters above are not imported).
+    if name in ("RaceViolation", "RaceWitness"):
+        from vodascheduler_tpu.analysis import racewitness
+        return getattr(racewitness, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -136,6 +136,13 @@ class LockOrderWitness:
             stack = self._tls.stack = []
         return stack
 
+    def held(self) -> List[str]:
+        """Witnessed locks the CALLING thread currently holds. This is
+        what RaceWitness's `locks_held_fn` should be wired to — the two
+        witnesses share one instrumentation layer (wrapping a lock in
+        both would double-report every acquire)."""
+        return list(self._stack())
+
     def _on_acquired(self, name: str) -> None:
         stack = self._stack()
         if name not in stack:  # reentrant re-acquire records no edges

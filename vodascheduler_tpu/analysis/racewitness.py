@@ -1,0 +1,210 @@
+"""Runtime shared-state access witness: the dynamic half of vodarace.
+
+vodarace proves lexically which (thread role, class, attribute, kind)
+accesses can happen and whether each runs under the owner's lock; this
+witness observes the accesses that actually happen in the concurrency
+stress test and requires them to be a subset of the pinned ownership
+map (doc/thread_roles.json). The two halves pin each other:
+
+  * a NEW runtime access (role touching an attribute the static map
+    never attributed to it) fails the witness until `make thread-roles`
+    regenerates the artifact — and a reviewer sees the ownership change;
+  * an attribute the map calls "guarded" that is observed WITHOUT the
+    owner's instrumented lock held fails immediately — so deleting a
+    `with self._lock:` that the map depends on is caught even when the
+    interleaving happens not to corrupt anything.
+
+Usage (tests opt in, mirroring LockOrderWitness):
+
+    lock_witness = LockOrderWitness()
+    wl = lock_witness.instrument(sched, "_lock", "scheduler._lock")
+    witness = RaceWitness(locks_held_fn=lock_witness._stack)
+    witness.watch(sched, cls_name="Scheduler",
+                  guard_locks=("scheduler._lock",))
+    ... run the scenario ...
+    witness.check(pinned_map)   # raises RaceViolation on any problem
+
+Implementation: `watch` swaps the object's ``__class__`` for a
+generated subclass whose ``__getattribute__``/``__setattr__`` report
+private-attribute accesses. Thread role comes from the thread's name
+(vodarace.ROLE_PREFIXES — satellite work role-prefixes every thread the
+package starts); accesses from un-prefixed threads ("main": pytest's
+driver, bare Thread-N helpers tests spawn themselves) are ignored, as
+the static map deliberately has no "main" section. Lock state comes
+from `locks_held_fn` — feed it the LockOrderWitness TLS stack so one
+instrumentation layer serves both witnesses (wrapping the same lock
+twice would report each acquire twice).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from .vodarace import _is_lock_attr, role_for_thread_name
+
+SCHEMA_VERSION = 1
+
+# (role, class, attr, kind, guarded)
+Observation = Tuple[str, str, str, str, bool]
+
+
+class RaceViolation(AssertionError):
+    """A runtime access outside the pinned ownership map, or an access
+    the map requires guarded observed without the owner's lock held."""
+
+
+def _interesting(attr: str) -> bool:
+    return (attr.startswith("_") and not attr.startswith("__")
+            and not _is_lock_attr(attr))
+
+
+class RaceWitness:
+    """Thread-safe recorder of (role, class, attribute, kind, guarded)
+    access observations on watched objects."""
+
+    def __init__(self,
+                 locks_held_fn: Optional[Callable[[], Iterable[str]]] = None
+                 ) -> None:
+        self._mu = threading.Lock()
+        self._locks_held_fn = locks_held_fn or (lambda: ())
+        self._observed: Set[Observation] = set()
+        # class label -> witness lock names whose being-held means
+        # "guarded" for that object's attributes. Classes watched with
+        # no guard_locks get guarded-enforcement disabled (we cannot
+        # tell guarded from unguarded without an instrumented lock).
+        self._guards: Dict[str, Tuple[str, ...]] = {}
+        self._tls = threading.local()
+        self._shadow: Dict[type, type] = {}
+
+    # ---- instrumentation -------------------------------------------------
+
+    def watch(self, obj, cls_name: Optional[str] = None,
+              guard_locks: Iterable[str] = ()) -> None:
+        """Start witnessing `obj`'s private-attribute accesses.
+
+        `cls_name` is the label used in doc/thread_roles.json (defaults
+        to the object's class name). `guard_locks` are LockOrderWitness
+        lock names (e.g. "scheduler._lock") that count as the owner's
+        guard; leave empty to record accesses without enforcing the
+        map's guarded-ness for this class.
+        """
+        label = cls_name or type(obj).__name__
+        with self._mu:
+            self._guards[label] = tuple(guard_locks)
+        obj.__class__ = self._shadow_class(type(obj), label)
+
+    def unwatch(self, obj) -> None:
+        base = getattr(type(obj), "_race_witness_base", None)
+        if base is not None:
+            obj.__class__ = base
+
+    def _shadow_class(self, base: type, label: str) -> type:
+        if getattr(base, "_race_witness_base", None) is not None:
+            return base  # already a shadow (re-watch keeps the label)
+        key = base
+        cached = self._shadow.get(key)
+        if cached is not None:
+            return cached
+        witness = self
+
+        def __getattribute__(inner_self, name):
+            value = object.__getattribute__(inner_self, name)
+            if _interesting(name) and \
+                    name in object.__getattribute__(inner_self, "__dict__"):
+                # Instance state only: a method lookup (`self._helper()`)
+                # resolves on the class and is a call edge in the static
+                # model, not an attribute access.
+                witness._record(label, name, "read")
+            return value
+
+        def __setattr__(inner_self, name, value):
+            if _interesting(name):
+                witness._record(label, name, "write")
+            object.__setattr__(inner_self, name, value)
+
+        shadow = type(base.__name__, (base,), {
+            "__getattribute__": __getattribute__,
+            "__setattr__": __setattr__,
+            "_race_witness_base": base,
+        })
+        self._shadow[key] = shadow
+        return shadow
+
+    # ---- recording -------------------------------------------------------
+
+    def _record(self, label: str, attr: str, kind: str) -> None:
+        tls = self._tls
+        if getattr(tls, "busy", False):
+            return  # re-entrant: the recording path itself reads attrs
+        tls.busy = True
+        try:
+            role = role_for_thread_name(threading.current_thread().name)
+            if role == "main":
+                return
+            guards = self._guards.get(label, ())
+            held = set(self._locks_held_fn() or ())
+            guarded = bool(guards) and any(g in held for g in guards)
+            obs = (role, label, attr, kind, guarded)
+            seen = getattr(tls, "seen", None)
+            if seen is None:
+                seen = tls.seen = set()
+            if obs in seen:
+                return
+            seen.add(obs)
+            with self._mu:
+                self._observed.add(obs)
+        finally:
+            tls.busy = False
+
+    # ---- queries ---------------------------------------------------------
+
+    def observations(self) -> List[Observation]:
+        with self._mu:
+            return sorted(self._observed)
+
+    def problems(self, pinned: dict) -> List[str]:
+        """Observations not covered by a pinned thread_roles.json map.
+
+        Coverage rules:
+          * attr listed immutable for the class: reads are free,
+            a write is always a violation;
+          * otherwise the map's roles[role].access[class][attr] must
+            list the kind (a runtime container mutation surfaces as a
+            read of the attribute — vodarace records a read alongside
+            every mutator-call write, so subset still holds);
+          * if the map says the kind is "guarded" and this class has
+            guard locks instrumented, an unguarded observation is a
+            violation ("mixed"/"unguarded" accept either).
+        """
+        roles = pinned.get("roles") or {}
+        immutable = pinned.get("immutable") or {}
+        out: List[str] = []
+        for role, label, attr, kind, guarded in self.observations():
+            if attr in (immutable.get(label) or ()):
+                if kind == "write":
+                    out.append(
+                        f"[{role}] wrote {label}.{attr} — pinned "
+                        f"immutable-after-__init__")
+                continue
+            entry = (((roles.get(role) or {}).get("access") or {})
+                     .get(label) or {}).get(attr) or {}
+            state = entry.get(kind)
+            if state is None:
+                out.append(
+                    f"[{role}] {kind} of {label}.{attr} is not in the "
+                    f"pinned ownership map (doc/thread_roles.json) — "
+                    f"regenerate with `make thread-roles` and review")
+                continue
+            if state == "guarded" and not guarded \
+                    and self._guards.get(label):
+                out.append(
+                    f"[{role}] {kind} of {label}.{attr} observed without "
+                    f"{'/'.join(self._guards[label])} held — the map "
+                    f"pins this access as guarded")
+        return out
+
+    def check(self, pinned: dict) -> None:
+        problems = self.problems(pinned)
+        if problems:
+            raise RaceViolation("; ".join(problems))

@@ -27,7 +27,7 @@ against the tables in `common/lifecycle.py`:
 
 Usage:
     python -m vodascheduler_tpu.analysis.vodacheck [paths...]
-        [--format text|jsonl]
+        [--format text|jsonl|sarif]
 
 No baseline and no suppressions: the transition relation is exact, so
 the tree is either clean or wrong. Rule catalog: doc/static-analysis.md.
@@ -389,6 +389,13 @@ def run(paths: List[str], fmt: str = "text", stream=None) -> int:
             with open(path, encoding="utf-8") as f:
                 findings.extend(check_source(f.read(), rel))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if fmt == "sarif":
+        from vodascheduler_tpu.analysis import findings_to_sarif
+        json.dump(findings_to_sarif("vodacheck", findings,
+                                    rules=dict(RULES)),
+                  stream, indent=2, sort_keys=True)
+        stream.write("\n")
+        return 1 if findings else 0
     for f in findings:
         if fmt == "jsonl":
             print(json.dumps(f.to_dict(), sort_keys=True), file=stream)
@@ -408,7 +415,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or package dirs (default: the "
                              "installed vodascheduler_tpu package)")
-    parser.add_argument("--format", choices=("text", "jsonl"),
+    parser.add_argument("--format", choices=("text", "jsonl", "sarif"),
                         default="text")
     args = parser.parse_args(argv)
     paths = args.paths or [_package_dir()]

@@ -10,7 +10,8 @@ doc/observability.md prose fail the build instead of a code review.
 
 Usage:
     python -m vodascheduler_tpu.analysis.vodalint [paths...]
-        [--format text|jsonl] [--baseline FILE] [--write-baseline FILE]
+        [--format text|jsonl|sarif] [--baseline FILE]
+        [--write-baseline FILE]
 
 Suppression (inline, per finding line, reason REQUIRED):
     time.sleep(x)  # vodalint: ignore[clock-discipline] modeled wall pause
@@ -49,8 +50,10 @@ RULES: Dict[str, str] = {
         "migrate_workers) and no event emit() inside a `with self._lock:`/"
         "`with self._state_lock:` block in scheduler/ or cluster/ — the "
         "decide/actuate split's contract; emitting under a lock inverts "
-        "lock order against scheduler→backend calls. Checked through one "
-        "level of self-method indirection (call-graph-lite)."),
+        "lock order against scheduler→backend calls. Checked through "
+        "self-method AND module-level helper indirection to a fixpoint "
+        "(call-graph-lite) — laundering an emit() through a bare-name "
+        "helper no longer hides it."),
     "vocab": (
         "Audit vocabulary is closed: every literal reason code "
         "(_add_reason), trigger (trigger_resched), span name "
@@ -79,6 +82,14 @@ RULES: Dict[str, str] = {
         "(daemon=True kwarg, or an immediate `.daemon = True` on the "
         "assigned name) — a non-daemon control-plane thread blocks "
         "process exit and wedges the tier-1 driver."),
+    "thread-name": (
+        "Every threading.Thread/threading.Timer must carry a stable "
+        "role-prefixed name (`name=\"voda-...\"` kwarg, or an immediate "
+        "`.name = ...` on the assigned variable), and every "
+        "ThreadPoolExecutor a `thread_name_prefix=\"voda-...\"` — the "
+        "thread name IS the role ground truth vodarace and the runtime "
+        "race witness key on (doc/thread_roles.json); an unnamed "
+        "thread's accesses are unattributable."),
     "executor-context": (
         "Executor submissions (.submit) must propagate the tracer "
         "context into the worker (obs_tracer.use_context/"
@@ -127,6 +138,18 @@ METRICS_PROTECTED = {"_values", "_value", "_sum", "_count", "_counts",
 
 _SUPPRESS_RE = re.compile(
     r"#\s*vodalint:\s*ignore\[([a-z\-,\s]+)\]\s*(.*)$")
+
+# Sibling tools (vodarace) share the suppression contract — same
+# syntax, same reason-required rule — under their own tool name, so a
+# vodalint suppression can never silence a race finding by accident.
+_SUPPRESS_RES: Dict[str, "re.Pattern[str]"] = {"vodalint": _SUPPRESS_RE}
+
+
+def _suppress_re(tool: str) -> "re.Pattern[str]":
+    if tool not in _SUPPRESS_RES:
+        _SUPPRESS_RES[tool] = re.compile(
+            r"#\s*" + re.escape(tool) + r":\s*ignore\[([a-z\-,\s]+)\]\s*(.*)$")
+    return _SUPPRESS_RES[tool]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -294,44 +317,84 @@ def _direct_danger(call: ast.Call) -> Optional[str]:
 
 
 class _MethodInfo:
-    __slots__ = ("dangers", "callees")
+    __slots__ = ("dangers", "callees", "mod_callees")
 
     def __init__(self) -> None:
         self.dangers: List[Tuple[int, str]] = []   # (line, why)
         self.callees: Set[str] = set()
+        self.mod_callees: Set[str] = set()  # bare-name module-func calls
 
 
-def _class_method_map(cls: ast.ClassDef) -> Dict[str, _MethodInfo]:
+def _collect_dangers(body: Iterable[ast.stmt]) -> _MethodInfo:
+    """Direct dangers + self-call and bare-name call edges of one
+    function body (not descending into nested defs/lambdas — deferred
+    work doesn't run in this frame)."""
+    info = _MethodInfo()
+
+    def collect(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            why = _direct_danger(node)
+            if why is not None:
+                info.dangers.append((node.lineno, why))
+            callee = _self_method_name(node.func)
+            if callee:
+                info.callees.add(callee)
+            elif isinstance(node.func, ast.Name):
+                info.mod_callees.add(node.func.id)
+        for child in ast.iter_child_nodes(node):
+            collect(child)
+
+    for stmt in body:
+        collect(stmt)
+    return info
+
+
+def _module_function_map(tree: ast.AST) -> Dict[str, _MethodInfo]:
+    """Module-level functions' danger map: the lock-discipline blind
+    spot is a `with self._lock:` block laundering its emit() through a
+    helper (`_notify(self.bus, ...)` where `_notify` calls bus.emit) —
+    one hop the self-call map can never see. Same fixpoint as the class
+    map, over bare-name call edges (helpers calling helpers)."""
+    funcs: Dict[str, _MethodInfo] = {}
+    for item in getattr(tree, "body", []):
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[item.name] = _collect_dangers(item.body)
+    changed = True
+    while changed:
+        changed = False
+        for info in funcs.values():
+            if info.dangers:
+                continue
+            for callee in info.mod_callees:
+                sub = funcs.get(callee)
+                if sub is not None and sub.dangers:
+                    line, why = sub.dangers[0]
+                    info.dangers.append(
+                        (line, f"calls {callee}() which {why}"))
+                    changed = True
+                    break
+    return funcs
+
+
+def _class_method_map(cls: ast.ClassDef,
+                      modfuncs: Optional[Dict[str, _MethodInfo]] = None
+                      ) -> Dict[str, _MethodInfo]:
     """Per-method direct dangers + self-call edges, then a fixpoint so a
-    method 'is dangerous' if anything it (transitively) calls on self
-    is. One file, one class at a time: deliberately 'call-graph-lite'."""
+    method 'is dangerous' if anything it (transitively) calls on self —
+    or any module-level helper it calls by name — is. One file at a
+    time: deliberately 'call-graph-lite'."""
+    modfuncs = modfuncs or {}
     methods: Dict[str, _MethodInfo] = {}
     for item in cls.body:
         if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        info = _MethodInfo()
-
-        def collect(node: ast.AST, info: _MethodInfo = info) -> None:
-            # Nested defs/lambdas are DEFERRED work (timer callbacks,
-            # wave tasks): they don't run in this method's frame, so
-            # they contribute no call-graph edges and no dangers here.
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                return
-            if isinstance(node, ast.Call):
-                why = _direct_danger(node)
-                if why is not None:
-                    info.dangers.append((node.lineno, why))
-                callee = _self_method_name(node.func)
-                if callee:
-                    info.callees.add(callee)
-            for child in ast.iter_child_nodes(node):
-                collect(child, info)
-
-        for stmt in item.body:
-            collect(stmt)
-        methods[item.name] = info
-    # Fixpoint: propagate danger through self-call edges.
+        methods[item.name] = _collect_dangers(item.body)
+    # Fixpoint: propagate danger through self-call edges and into
+    # module-function helpers (themselves already a fixpoint, so a
+    # method -> helper -> helper -> emit chain of any depth resolves).
     changed = True
     while changed:
         changed = False
@@ -344,6 +407,16 @@ def _class_method_map(cls: ast.ClassDef) -> Dict[str, _MethodInfo]:
                     line, why = sub.dangers[0]
                     info.dangers.append(
                         (line, f"calls self.{callee}() which {why}"))
+                    changed = True
+                    break
+            if info.dangers:
+                continue
+            for callee in info.mod_callees:
+                sub = modfuncs.get(callee)
+                if sub is not None and sub.dangers:
+                    line, why = sub.dangers[0]
+                    info.dangers.append(
+                        (line, f"calls {callee}() which {why}"))
                     changed = True
                     break
     return methods
@@ -361,17 +434,23 @@ def _lock_items(node: ast.With) -> bool:
 
 def _walk_lock_block(stmts: Iterable[ast.stmt], rel: str,
                      methods: Dict[str, _MethodInfo],
-                     out: List[Finding]) -> None:
+                     out: List[Finding],
+                     modfuncs: Optional[Dict[str, _MethodInfo]] = None
+                     ) -> None:
     """Scan a lock block's statements for dangerous calls, NOT
     descending into nested function/lambda definitions (those are
     defined under the lock, not executed under it)."""
     for stmt in stmts:
-        _scan_stmt_for_dangers(stmt, rel, methods, out)
+        _scan_stmt_for_dangers(stmt, rel, methods, out, modfuncs)
 
 
 def _scan_stmt_for_dangers(stmt: ast.stmt, rel: str,
                            methods: Dict[str, _MethodInfo],
-                           out: List[Finding]) -> None:
+                           out: List[Finding],
+                           modfuncs: Optional[Dict[str, _MethodInfo]] = None
+                           ) -> None:
+    modfuncs = modfuncs or {}
+
     def visit(node: ast.AST) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
@@ -389,6 +468,14 @@ def _scan_stmt_for_dangers(stmt: ast.stmt, rel: str,
                     out.append(Finding(
                         rel, node.lineno, "lock-discipline",
                         f"self.{callee}() under a table lock: {sub_why}"))
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in modfuncs
+                        and modfuncs[node.func.id].dangers):
+                    _, sub_why = modfuncs[node.func.id].dangers[0]
+                    out.append(Finding(
+                        rel, node.lineno, "lock-discipline",
+                        f"{node.func.id}() under a table lock: "
+                        f"{sub_why}"))
         for child in ast.iter_child_nodes(node):
             visit(child)
 
@@ -399,11 +486,25 @@ def _check_lock_discipline(tree: ast.AST, rel: str,
                            out: List[Finding]) -> None:
     if not rel.startswith(LOCKED_PREFIXES):
         return
+    modfuncs = _module_function_map(tree)
+    # A module-level helper's own `with <owner>._lock:` block is a lock
+    # region too (the foreign-lock guard idiom — there is no `self` at
+    # module scope, so match any `<name>._lock`-family acquisition).
+    def _module_lock_items(node: ast.With) -> bool:
+        return any(isinstance(item.context_expr, ast.Attribute)
+                   and item.context_expr.attr in LOCK_ATTRS
+                   for item in node.items)
+
+    for item in getattr(tree, "body", []):
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(item):
+                if isinstance(node, ast.With) and _module_lock_items(node):
+                    _walk_lock_block(node.body, rel, {}, out, modfuncs)
     for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
-        methods = _class_method_map(cls)
+        methods = _class_method_map(cls, modfuncs)
         for node in ast.walk(cls):
             if isinstance(node, ast.With) and _lock_items(node):
-                _walk_lock_block(node.body, rel, methods, out)
+                _walk_lock_block(node.body, rel, methods, out, modfuncs)
             # _locked_or_deferred(self._fn, ...) runs its target under
             # the scheduler lock WHEREVER the call itself sits — check
             # the referenced mutator's closure too.
@@ -703,6 +804,117 @@ def _check_thread_daemon(tree: ast.AST, imports: _Imports, rel: str,
                         "(non-daemon control-plane threads block exit)"))
 
 
+def _check_thread_name(tree: ast.AST, imports: _Imports, rel: str,
+                       out: List[Finding]) -> None:
+    """`thread-daemon`'s sibling: a daemonized-but-anonymous thread is
+    invisible to the role plane (vodarace attributes accesses by thread
+    name prefix), so construction must pin a stable voda-* name."""
+
+    def voda_prefixed(node: ast.AST) -> bool:
+        # Statically judgeable names must start with "voda-"; a dynamic
+        # expression we cannot read is accepted (the runtime witness
+        # still classifies it — just as role "main").
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value.startswith("voda-")
+        if isinstance(node, ast.JoinedStr) and node.values:
+            first = node.values[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                return first.value.startswith("voda-")
+        return True
+
+    def name_kwarg(call: ast.Call, kwarg: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == kwarg:
+                return kw.value
+        return None
+
+    def named_later(body: List[ast.stmt], idx: int,
+                    target_names: Set[str]) -> Optional[ast.AST]:
+        for follow in body[idx + 1:idx + 4]:  # same window as .daemon
+            if isinstance(follow, ast.Assign):
+                for t in follow.targets:
+                    if (isinstance(t, ast.Attribute) and t.attr == "name"
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in target_names):
+                        return follow.value
+        return None
+
+    def shallow_calls(stmt: ast.stmt) -> List[ast.Call]:
+        calls: List[ast.Call] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    continue
+                if isinstance(child, ast.Call):
+                    calls.append(child)
+                visit(child)
+
+        visit(stmt)
+        return calls
+
+    def kind_of(call: ast.Call) -> Optional[str]:
+        flat = imports.flat_call_name(call.func)
+        if flat in ("threading.Thread", "threading.Timer"):
+            return "thread"
+        if flat in ("concurrent.futures.ThreadPoolExecutor",
+                    "futures.ThreadPoolExecutor", "ThreadPoolExecutor"):
+            return "executor"
+        return None
+
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for block in (node.body,
+                      getattr(node, "orelse", []) or [],
+                      getattr(node, "finalbody", []) or []):
+            if not isinstance(block, list):
+                continue
+            for idx, stmt in enumerate(block):
+                for call in shallow_calls(stmt):
+                    kind = kind_of(call)
+                    if kind == "executor":
+                        prefix = name_kwarg(call, "thread_name_prefix")
+                        if prefix is None:
+                            out.append(Finding(
+                                rel, call.lineno, "thread-name",
+                                "ThreadPoolExecutor without "
+                                "thread_name_prefix=\"voda-...\" — "
+                                "worker accesses are role-"
+                                "unattributable (doc/thread_roles.json)"
+                            ))
+                        elif not voda_prefixed(prefix):
+                            out.append(Finding(
+                                rel, call.lineno, "thread-name",
+                                "thread_name_prefix must start with "
+                                "\"voda-\" (vodarace.ROLE_PREFIXES)"))
+                        continue
+                    if kind != "thread":
+                        continue
+                    name_val = name_kwarg(call, "name")
+                    if name_val is None:
+                        targets: Set[str] = set()
+                        if isinstance(stmt, ast.Assign):
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    targets.add(t.id)
+                        name_val = named_later(block, idx, targets)
+                    if name_val is None:
+                        out.append(Finding(
+                            rel, call.lineno, "thread-name",
+                            "threading.Thread/Timer without a name "
+                            "(name= kwarg or immediate `.name =`) — "
+                            "the voda-* name prefix is the thread's "
+                            "role identity (doc/thread_roles.json)"))
+                    elif not voda_prefixed(name_val):
+                        out.append(Finding(
+                            rel, call.lineno, "thread-name",
+                            "thread name must start with \"voda-\" "
+                            "(vodarace.ROLE_PREFIXES)"))
+
+
 def _check_executor_context(tree: ast.AST, rel: str,
                             out: List[Finding]) -> None:
     def fn_propagates(fn: ast.AST) -> bool:
@@ -747,22 +959,23 @@ def _check_executor_context(tree: ast.AST, rel: str,
 
 
 def _apply_suppressions(findings: List[Finding], src: str,
-                        rel: str) -> List[Finding]:
+                        rel: str, tool: str = "vodalint") -> List[Finding]:
     lines = src.splitlines()
+    pattern = _suppress_re(tool)
 
     def suppression_for(lineno: int) -> Optional[Tuple[Set[str], str, int]]:
         """Same-line suppression, else one inside the contiguous
         pure-comment block directly above (multi-line reasons).
         Returns (rules, reason, suppression_line)."""
         if 1 <= lineno <= len(lines):
-            m = _SUPPRESS_RE.search(lines[lineno - 1])
+            m = pattern.search(lines[lineno - 1])
             if m:
                 return ({r.strip() for r in m.group(1).split(",")},
                         m.group(2).strip(), lineno)
         cand = lineno - 1
         while 1 <= cand <= len(lines) and \
                 lines[cand - 1].lstrip().startswith("#"):
-            m = _SUPPRESS_RE.search(lines[cand - 1])
+            m = pattern.search(lines[cand - 1])
             if m:
                 return ({r.strip() for r in m.group(1).split(",")},
                         m.group(2).strip(), cand)
@@ -823,6 +1036,7 @@ def lint_source(src: str, rel: str,
     _check_journal_seam(tree, rel, findings)
     _check_metrics_lock(tree, rel, findings)
     _check_thread_daemon(tree, imports, rel, findings)
+    _check_thread_name(tree, imports, rel, findings)
     _check_executor_context(tree, rel, findings)
     findings = _apply_suppressions(findings, src, rel)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -993,6 +1207,13 @@ def run(paths: List[str], fmt: str = "text",
         return 0
     if baseline:
         findings = subtract_baseline(findings, load_baseline(baseline))
+    if fmt == "sarif":
+        from vodascheduler_tpu.analysis import findings_to_sarif
+        json.dump(findings_to_sarif("vodalint", findings,
+                                    rules={k: v for k, v in RULES.items()}),
+                  stream, indent=2, sort_keys=True)
+        stream.write("\n")
+        return 1 if findings else 0
     for f in findings:
         if fmt == "jsonl":
             print(json.dumps(f.to_dict(), sort_keys=True), file=stream)
@@ -1012,7 +1233,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or package dirs (default: the "
                              "installed vodascheduler_tpu package)")
-    parser.add_argument("--format", choices=("text", "jsonl"),
+    parser.add_argument("--format", choices=("text", "jsonl", "sarif"),
                         default="text")
     parser.add_argument("--baseline", default=None,
                         help="JSONL baseline of accepted findings to "

@@ -1,0 +1,1247 @@
+"""vodarace — a thread-role × shared-state race checker.
+
+The control plane is a fixed cast of thread roles (doc/observability.md
+"Scheduler concurrency model"): REST handler threads, the scheduler
+daemon's decide loop, actuation-wave workers, event drainers, clock
+timers, standby appliers, and metrics collectors, all touching the same
+scheduler/cluster state. vodalint's `lock-discipline` and `metrics-lock`
+rules are *lexical* — they check what happens inside a `with self._lock`
+block, not whether an access that should be inside one ever got a lock
+at all. vodarace closes that gap:
+
+1. **Entry points.** Every `threading.Thread(target=...)`,
+   `threading.Timer`, executor `.submit(...)`, `clock.call_at/call_later`
+   deferral, `bus.subscribe(...)` callback, REST handler and
+   standby-loop method is discovered and labelled with a role. Thread
+   names are the ground truth: the role comes from the stable
+   `voda-*` name prefix (ROLE_PREFIXES), which is why vodalint's
+   `thread-name` rule insists every thread is named.
+2. **Propagation.** Roles flow through self-method calls,
+   module-function calls, bound-method references handed to executors
+   and name-based cross-object method edges, to a fixpoint
+   (call-graph-lite: names, not types — deliberately the same
+   trade-off as vodalint, two levels of indirection and beyond).
+3. **Classification.** For each class under RACE_PREFIXES, every
+   `self._x` attribute access reachable from a role is classified as
+   guarded (lexically under a `with self.<..._lock>` family member, or
+   inside a method only ever invoked under the lock /
+   via `_locked_or_deferred`) or unguarded. Immutable-after-`__init__`
+   attributes are exempt; documented lock-free seams carry
+   `# vodarace: ignore[rule] reason` suppressions (same syntax and
+   reason-required contract as vodalint).
+4. **Findings.** `unguarded-shared-write` (an attribute multiple roles
+   touch has an unguarded write) and `guarded-read-unguarded-write`
+   (an attribute the code bothers to guard elsewhere is written without
+   the lock) are reported against a zero-entry baseline.
+
+The inferred map is pinned as doc/thread_roles.json (`make
+thread-roles`), and `analysis/racewitness.py` validates it against real
+interleavings: during tests/test_concurrency_stress.py every observed
+(role, class, attribute) access must be a subset of the map — the same
+static → runtime-witness → pinned-artifact loop as lock-discipline →
+lockwitness → doc/lock_order.json.
+
+Run: `python -m vodascheduler_tpu.analysis.vodarace` or `make racecheck`;
+seeded-bug teeth: `--selftest` / `make racecheck-selftest`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from vodascheduler_tpu.analysis.vodalint import (
+    Finding,
+    _Imports,
+    _apply_suppressions,
+    _iter_py_files,
+    _package_dir,
+    _rel_root,
+    _self_method_name,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+
+SCHEMA_VERSION = 1
+
+# Classes defined in these package subtrees get their shared-state
+# accesses classified. Entry-point discovery runs package-wide.
+RACE_PREFIXES = ("scheduler/", "cluster/", "service/",
+                 "durability/", "obs/", "common/")
+
+# Offline tooling — checkers, benchmark drivers, replay harnesses — is
+# not part of the runtime thread cast: its call sites must not create
+# role edges into the control plane (a modelcheck driver calling
+# crash_after() is not a production thread).
+ANALYZE_EXCLUDE = ("analysis/", "benchrunner/", "replay/")
+
+RULES: Dict[str, str] = {
+    "unguarded-shared-write":
+        "an attribute reachable from two or more thread roles is "
+        "written without any lock held — the textbook data race the "
+        "lock-discipline rule (lexical) cannot see",
+    "guarded-read-unguarded-write":
+        "an attribute the code guards elsewhere is written without the "
+        "lock — either the guarded sites are wasted work or this write "
+        "tears state under a concurrent reader",
+    "suppression-empty-reason":
+        "a # vodarace: ignore[...] without a reason — accepted "
+        "lock-free seams must say why they are safe",
+    "parse-error":
+        "a module the race checker cannot parse is a module it cannot "
+        "check",
+}
+
+ROLES: Dict[str, str] = {
+    "rest": "HTTP handler threads of the service/scheduler REST servers",
+    "decide": "the scheduling decide loop (daemon tick, fleet workers, "
+              "what-if planner) — holds the table lock to mutate",
+    "actuate-worker": "actuation-wave executor threads — re-acquire the "
+                      "lock only for bookkeeping",
+    "drainer": "event-bus drainer threads delivering batched events",
+    "timer": "clock callbacks (window opens, retries, tickers)",
+    "standby": "hot-standby appliers, journal tailers and recovery",
+    "collector": "metrics collectors and cluster monitor loops",
+    "main": "the owning/test thread — excluded from race findings",
+}
+
+# Thread-name prefix -> role. The runtime ground truth: RaceWitness
+# resolves threading.current_thread().name through this same table, so
+# the static and dynamic sides cannot drift apart.
+ROLE_PREFIXES: Dict[str, str] = {
+    "voda-rest": "rest",
+    "voda-scheduler-daemon": "decide",
+    "voda-fleet": "decide",
+    "voda-whatif": "decide",
+    "voda-actuate": "actuate-worker",
+    "voda-event-drain": "drainer",
+    "voda-timer": "timer",
+    "voda-periodic": "collector",
+    "voda-monitor": "collector",
+    "voda-recover": "standby",
+    "voda-standby": "standby",
+    "voda-ship": "standby",
+    "voda-native-warmup": "main",
+}
+
+
+def role_for_thread_name(name: Optional[str]) -> str:
+    """Longest-prefix match into ROLE_PREFIXES; unknown names (pytest's
+    MainThread, bare Thread-N) are 'main'."""
+    if not name:
+        return "main"
+    best = ""
+    for prefix in ROLE_PREFIXES:
+        if name.startswith(prefix) and len(prefix) > len(best):
+            best = prefix
+    return ROLE_PREFIXES[best] if best else "main"
+
+
+# Attributes that ARE locks (or condition vars) — they guard state, they
+# are not state.
+def _is_lock_attr(attr: str) -> bool:
+    return attr.endswith("_lock") or attr in ("_mu", "_cond", "_tls")
+
+
+# Receiver-method names too generic for name-based cross-object edges —
+# a `.get()` on a dict must not edge into every class defining get().
+_CHA_SKIP = frozenset({
+    "get", "put", "pop", "append", "appendleft", "add", "remove",
+    "clear", "update", "items", "keys", "values", "copy", "join",
+    "start", "close", "flush", "read", "write", "acquire", "release",
+    "wait", "notify", "notify_all", "set", "send", "recv", "submit",
+    "result", "done", "cancel", "shutdown", "sort", "strip", "split",
+    "encode", "decode", "format", "lower", "upper", "extend", "insert",
+    "discard", "popleft", "count", "index", "setdefault", "group",
+    "match", "search", "is_set", "empty", "qsize", "getvalue",
+    "stop", "poll", "size",
+})
+
+# Method calls on a `self._x` receiver that mutate the container — the
+# receiver Load is really a write for race purposes
+# (`self._q.append(...)` races exactly like `self._q = ...`).
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "remove", "discard", "clear",
+    "update", "pop", "popleft", "popitem", "setdefault", "insert",
+    "extend", "sort", "reverse", "put",
+})
+
+# (rel-path, class-or-None, method-or-None, role): entry points that
+# exist but are driven by a caller the AST cannot see (poll loops the
+# leader/standby process pumps, REST handler closures dispatched by the
+# stdlib server). '*' semantics: class given, method None => every
+# method of the class.
+ENTRY_HINTS: Tuple[Tuple[str, Optional[str], Optional[str], str], ...] = (
+    ("durability/standby.py", "PoolStandby", None, "standby"),
+    ("durability/standby.py", "HotStandby", None, "standby"),
+    ("durability/standby.py", None, "finish_takeover", "standby"),
+    ("durability/shipping.py", "JournalTailer", None, "standby"),
+    ("durability/shipping.py", "JournalShipper", None, "standby"),
+    ("durability/recover.py", None, "recover_pool", "standby"),
+)
+
+# service/rest.py is the REST layer: every handler closure and
+# dispatcher method in it runs on a server thread. Exceptions are the
+# *clients* that happen to live in the same module.
+_REST_MODULE = "service/rest.py"
+_REST_NONHANDLER_CLASSES = frozenset({"RemoteAllocator"})
+
+
+# ---- per-function facts ----------------------------------------------------
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "guarded", "line", "receiver_cls")
+
+    def __init__(self, attr: str, kind: str, guarded: bool, line: int,
+                 receiver_cls: Optional[str] = None):
+        self.attr = attr
+        self.kind = kind            # "read" | "write"
+        self.guarded = guarded      # lexically under a lock
+        self.line = line
+        self.receiver_cls = receiver_cls  # None => self receiver
+
+
+class _Func:
+    __slots__ = ("rel", "module_key", "cls", "qual", "name", "lineno",
+                 "accesses", "foreign", "calls_self", "refs_self",
+                 "calls_local", "name_loads", "calls_attr", "attr_loads",
+                 "exec_prefixes", "entry_roles", "inbound",
+                 "locked_context", "is_init", "is_property", "children",
+                 "timer_defer", "self_call_sites")
+
+    def __init__(self, rel: str, module_key: str, cls: Optional[str],
+                 qual: str, name: str, lineno: int):
+        self.rel = rel
+        self.module_key = module_key
+        self.cls = cls
+        self.qual = qual
+        self.name = name
+        self.lineno = lineno
+        self.accesses: List[_Access] = []   # self-receiver accesses
+        self.foreign: List[_Access] = []    # non-self receiver (attr owner TBD)
+        self.calls_self: Set[str] = set()
+        self.refs_self: Set[str] = set()    # bound-method refs (deferred work)
+        self.calls_local: Set[str] = set()  # bare-name calls
+        self.name_loads: Set[str] = set()   # bare-name refs (filtered later)
+        self.calls_attr: Set[str] = set()   # <expr>.m() names for CHA
+        self.attr_loads: Set[str] = set()   # public attr loads (property CHA)
+        self.exec_prefixes: List[str] = []  # executor thread_name_prefix here
+        self.entry_roles: Set[str] = set()
+        self.inbound: List[Tuple[str, bool]] = []  # (caller key, under lock)
+        self.locked_context = False
+        self.is_init = False
+        self.is_property = False
+        self.children: Dict[str, str] = {}  # nested def name -> func key
+        self.timer_defer = False
+        # (method name, call site under a lock?) — per-site, so the
+        # locked-context fixpoint knows whether EVERY call path into a
+        # helper holds the lock.
+        self.self_call_sites: List[Tuple[str, bool]] = []
+
+    @property
+    def key(self) -> str:
+        return f"{self.rel}:{self.qual}"
+
+
+class _Class:
+    __slots__ = ("rel", "name", "bases", "methods", "init_attrs",
+                 "exec_prefixes", "timer_defer")
+
+    def __init__(self, rel: str, name: str, bases: List[str]):
+        self.rel = rel
+        self.name = name
+        self.bases = bases
+        self.methods: Dict[str, str] = {}     # method name -> func key
+        self.init_attrs: Set[str] = set()     # attrs assigned in __init__/body
+        self.exec_prefixes: List[str] = []
+        self.timer_defer = False
+
+
+# ---- collection ------------------------------------------------------------
+
+
+def _name_const_prefix(node: ast.AST) -> Optional[str]:
+    """Literal thread name, or the literal head of an f-string name
+    (f'voda-actuate-{label}' -> 'voda-actuate-')."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_self_name(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+class _ModuleFacts:
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.funcs: Dict[str, _Func] = {}       # key -> func
+        self.classes: Dict[str, _Class] = {}
+        self.modfuncs: Dict[str, str] = {}      # bare name -> func key
+        # (site func key, target spec, role-or-None, kind)
+        # target spec: ("self", cls, meth) | ("local", name) | ("child", key)
+        #            | ("opaque", text)
+        self.entry_sites: List[Tuple[str, Tuple, Optional[str], str]] = []
+
+
+class _Collector:
+    """One module -> _ModuleFacts. A hand-rolled recursive walk (not a
+    NodeVisitor) because guardedness is lexical context the visitor
+    pattern makes awkward."""
+
+    def __init__(self, rel: str, tree: ast.AST, imports: _Imports):
+        self.rel = rel
+        self.imports = imports
+        self.facts = _ModuleFacts(rel)
+        self._collect_module(tree)
+
+    # -- structure ----------------------------------------------------------
+
+    def _collect_module(self, tree: ast.AST) -> None:
+        for node in tree.body if hasattr(tree, "body") else []:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._collect_func(node, cls=None, qual=node.name)
+                self.facts.modfuncs[node.name] = fn.key
+
+    def _collect_class(self, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        cls = _Class(self.rel, node.name, bases)
+        self.facts.classes[node.name] = cls
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._collect_func(
+                    item, cls=node.name, qual=f"{node.name}.{item.name}")
+                cls.methods[item.name] = fn.key
+                if item.name in ("__init__", "__post_init__"):
+                    fn.is_init = True
+                for dec in item.decorator_list:
+                    dname = dec.attr if isinstance(dec, ast.Attribute) \
+                        else getattr(dec, "id", None)
+                    if dname in ("property", "cached_property", "getter",
+                                 "setter"):
+                        fn.is_property = True
+            elif isinstance(item, (ast.Assign, ast.AnnAssign)):
+                targets = item.targets if isinstance(item, ast.Assign) \
+                    else [item.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        cls.init_attrs.add(t.id)
+
+    def _collect_func(self, node, cls: Optional[str], qual: str) -> _Func:
+        fn = _Func(self.rel, self.rel, cls, qual, getattr(node, "name", qual),
+                   node.lineno)
+        self.facts.funcs[fn.key] = fn
+        body = node.body if not isinstance(node, ast.Lambda) else [node.body]
+        for stmt in body:
+            self._walk(stmt, fn, guarded=False)
+        return fn
+
+    def _child_func(self, node, parent: _Func) -> _Func:
+        name = getattr(node, "name", f"<lambda:{node.lineno}>")
+        child = self._collect_func(
+            node, cls=parent.cls, qual=f"{parent.qual}.{name}")
+        parent.children[name] = child.key
+        return child
+
+    # -- statements / expressions -------------------------------------------
+
+    def _walk(self, node: ast.AST, fn: _Func, guarded: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # Deferred work: defined here, not run here (and not run
+            # under this lock).
+            self._child_func(node, fn)
+            return
+        if isinstance(node, ast.With):
+            locked = guarded or self._lock_items(node)
+            for item in node.items:
+                self._walk(item.context_expr, fn, guarded)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars, fn, guarded)
+            for stmt in node.body:
+                self._walk(stmt, fn, locked)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, fn, guarded)
+            return
+        if isinstance(node, ast.AugAssign):
+            # `self._x += 1` is a getattr THEN a setattr at runtime —
+            # the access witness observes both, so record both.
+            if isinstance(node.target, ast.Attribute):
+                self._record_attr_node(node.target, fn, guarded,
+                                       force_write=True)
+                self._walk(node.target.value, fn, guarded)
+            elif isinstance(node.target, ast.Subscript):
+                self._record_attr_node(node.target.value, fn, guarded,
+                                       force_write=True)
+                self._walk(node.target.slice, fn, guarded)
+            else:
+                self._walk(node.target, fn, guarded)
+            self._walk(node.value, fn, guarded)
+            return
+        if isinstance(node, (ast.Subscript,)) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            # self._d[k] = v mutates self._d.
+            self._record_attr_node(node.value, fn, guarded, force_write=True)
+            self._walk(node.slice, fn, guarded)
+            if not isinstance(node.value, ast.Attribute):
+                self._walk(node.value, fn, guarded)
+            return
+        if isinstance(node, ast.Attribute):
+            self._record_attr_node(node, fn, guarded)
+            if isinstance(node.ctx, ast.Load) and \
+                    not node.attr.startswith("_"):
+                # A public attribute load may be a @property call in
+                # disguise (`sched.resched_pending` runs the getter's
+                # lock-free read) — kept for property CHA edges.
+                fn.attr_loads.add(node.attr)
+            self._walk(node.value, fn, guarded)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                fn.name_loads.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, fn, guarded)
+
+    def _lock_items(self, node: ast.With) -> bool:
+        # `with self._lock:` is the idiom, but module functions guard
+        # foreign state with the OWNER's lock (`with sched._lock:`) —
+        # any lock-family attribute acquisition opens a guarded region.
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) and _is_lock_attr(e.attr):
+                return True
+            if isinstance(e, ast.Name) and _is_lock_attr(f"_{e.id}"):
+                return True
+        return False
+
+    def _record_attr_node(self, node: ast.AST, fn: _Func, guarded: bool,
+                          force_write: bool = False) -> None:
+        if not isinstance(node, ast.Attribute):
+            return
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__") or \
+                _is_lock_attr(attr):
+            return
+        kind = "write" if (force_write or
+                           isinstance(node.ctx, (ast.Store, ast.Del))) \
+            else "read"
+        bucket = fn.accesses if _is_self_name(node.value) else fn.foreign
+        bucket.append(_Access(attr, kind, guarded, node.lineno))
+        if force_write:
+            # A container mutation (`self._q.append(x)`, `self._d[k]=v`)
+            # is a getattr-then-mutate at runtime: the access witness
+            # observes a READ of the attribute, so the map must carry
+            # one alongside the write.
+            bucket.append(_Access(attr, "read", guarded, node.lineno))
+
+    # -- calls --------------------------------------------------------------
+
+    def _target_spec(self, node: ast.AST, fn: _Func) -> Optional[Tuple]:
+        """What a callable-valued argument points at."""
+        if isinstance(node, ast.Attribute) and _is_self_name(node.value):
+            return ("self", fn.cls, node.attr)
+        if isinstance(node, ast.Name):
+            if node.id in fn.children:
+                return ("child", fn.children[node.id])
+            return ("local", node.id)
+        if isinstance(node, ast.Lambda):
+            child = self._child_func(node, fn)
+            return ("child", child.key)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return ("child", f"{fn.rel}:{fn.qual}.{node.name}")
+        try:
+            return ("opaque", ast.unparse(node))
+        except Exception:
+            return ("opaque", "<expr>")
+
+    def _handle_call(self, call: ast.Call, fn: _Func, guarded: bool) -> None:
+        func = call.func
+        flat = self.imports.flat_call_name(func) or ""
+
+        # -- thread / executor / deferral entry patterns --
+        if flat.endswith("threading.Thread") or flat == "Thread":
+            role = role_for_thread_name(
+                _name_const_prefix(_kwarg(call, "name") or ast.Constant("")))
+            target = _kwarg(call, "target")
+            spec = self._target_spec(target, fn) if target is not None \
+                else ("opaque", "<no target>")
+            self.facts.entry_sites.append((fn.key, spec, role, "thread"))
+        elif flat.endswith("threading.Timer") or flat == "Timer":
+            spec = self._target_spec(call.args[1], fn) \
+                if len(call.args) >= 2 else ("opaque", "<timer>")
+            self.facts.entry_sites.append((fn.key, spec, "timer", "timer"))
+        elif flat.endswith("ThreadPoolExecutor"):
+            prefix = _name_const_prefix(
+                _kwarg(call, "thread_name_prefix") or ast.Constant(""))
+            if prefix:
+                fn.exec_prefixes.append(prefix)
+        elif isinstance(func, ast.Attribute) and func.attr == "submit":
+            if call.args:
+                spec = self._target_spec(call.args[0], fn)
+                self.facts.entry_sites.append((fn.key, spec, None, "submit"))
+        elif isinstance(func, ast.Attribute) and \
+                func.attr in ("call_at", "call_later"):
+            fn.timer_defer = True
+            for arg in call.args:
+                if isinstance(arg, (ast.Lambda, ast.Name)) or (
+                        isinstance(arg, ast.Attribute)
+                        and _is_self_name(arg.value)):
+                    spec = self._target_spec(arg, fn)
+                    if spec and spec[0] != "opaque":
+                        self.facts.entry_sites.append(
+                            (fn.key, spec, "timer", "clock"))
+        elif isinstance(func, ast.Attribute) and func.attr == "subscribe":
+            cb = call.args[1] if len(call.args) >= 2 \
+                else _kwarg(call, "callback")
+            if cb is not None:
+                spec = self._target_spec(cb, fn)
+                self.facts.entry_sites.append(
+                    (fn.key, spec, "drainer", "subscribe"))
+
+        # -- ordinary call edges --
+        if isinstance(func, ast.Attribute):
+            if _is_self_name(func.value):
+                fn.calls_self.add(func.attr)
+                fn.self_call_sites.append((func.attr, guarded))
+                if func.attr == "_locked_or_deferred" and call.args:
+                    tgt = _self_method_name(call.args[0])
+                    if tgt:
+                        fn.calls_self.add(tgt)
+                        # runs under the lock wherever the call sits;
+                        # consume the bound-method arg so it is not
+                        # ALSO treated as executor-deferred work below
+                        fn.self_call_sites.append((tgt, True))
+                        skip_args = call.args[0]
+                        self._walk(func.value, fn, guarded)
+                        for arg in call.args:
+                            if arg is not skip_args:
+                                self._walk(arg, fn, guarded)
+                        for kw in call.keywords:
+                            self._walk(kw.value, fn, guarded)
+                        return
+            else:
+                fn.calls_attr.add(func.attr)
+                # container mutation through a self attr receiver
+                if func.attr in _MUTATOR_METHODS:
+                    self._record_attr_node(func.value, fn, guarded,
+                                           force_write=True)
+            self._walk(func.value, fn, guarded)
+        elif isinstance(func, ast.Name):
+            fn.calls_local.add(func.id)
+        else:
+            self._walk(func, fn, guarded)
+
+        for arg in call.args:
+            self._walk(arg, fn, guarded)
+        for kw in call.keywords:
+            self._walk(kw.value, fn, guarded)
+
+
+# ---- whole-package analysis ------------------------------------------------
+
+
+class Analysis:
+    """Parsed facts + resolved call graph + role closure for one tree."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, _Func] = {}
+        self.classes: Dict[str, _Class] = {}          # name -> class
+        self.modfuncs_by_rel: Dict[str, Dict[str, str]] = {}
+        self.modfunc_names: Dict[str, List[str]] = {}  # name -> func keys
+        self.method_names: Dict[str, List[str]] = {}   # name -> func keys
+        self.property_names: Dict[str, List[str]] = {}  # @property CHA
+        self.attr_owner: Dict[str, Set[str]] = {}      # attr -> class names
+        self.subclasses: Dict[str, Set[str]] = {}      # class -> descendants
+        self.roles: Dict[str, Set[str]] = {}           # func key -> roles
+        self.entry_points: Dict[str, Set[str]] = {}    # role -> "rel:qual"
+        self.sources: Dict[str, str] = {}
+        self.parse_failures: List[Finding] = []
+        self.entry_sites: List[Tuple[str, Tuple, Optional[str], str]] = []
+
+    # -- membership helpers --
+
+    def classified(self, cls: _Class) -> bool:
+        return cls.rel.startswith(RACE_PREFIXES)
+
+    def class_of_func(self, fn: _Func) -> Optional[_Class]:
+        if fn.cls is None:
+            return None
+        c = self.classes.get(fn.cls)
+        # class names are package-unique in practice; guard rel anyway
+        if c is not None and c.rel != fn.rel:
+            c2 = next((cc for cc in self.classes.values()
+                       if cc.name == fn.cls and cc.rel == fn.rel), None)
+            return c2 or c
+        return c
+
+    def resolve_method(self, cls_name: Optional[str],
+                       meth: str) -> List[str]:
+        """Method lookup through analyzed base classes."""
+        seen: Set[str] = set()
+        out: List[str] = []
+        stack = [cls_name] if cls_name else []
+        while stack:
+            name = stack.pop()
+            if not name or name in seen:
+                continue
+            seen.add(name)
+            cls = self.classes.get(name)
+            if cls is None:
+                continue
+            if meth in cls.methods:
+                out.append(cls.methods[meth])
+                continue
+            stack.extend(cls.bases)
+        return out
+
+
+def _load_tree(pkg_dir: str, overrides: Optional[Dict[str, str]]
+               ) -> Iterable[Tuple[str, str]]:
+    rel_root = _rel_root(pkg_dir)
+    seen: Set[str] = set()
+    for full, rel in _iter_py_files(pkg_dir, rel_root):
+        if rel.startswith(ANALYZE_EXCLUDE):
+            continue
+        seen.add(rel)
+        if overrides and rel in overrides:
+            yield rel, overrides[rel]
+        else:
+            with open(full, encoding="utf-8") as f:
+                yield rel, f.read()
+    if overrides:
+        for rel in sorted(set(overrides) - seen):
+            yield rel, overrides[rel]
+
+
+def analyze_package(pkg_dir: Optional[str] = None,
+                    overrides: Optional[Dict[str, str]] = None) -> Analysis:
+    pkg_dir = os.path.abspath(pkg_dir or _package_dir())
+    an = Analysis()
+
+    # 1. parse + collect
+    for rel, src in _load_tree(pkg_dir, overrides):
+        an.sources[rel] = src
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            an.parse_failures.append(Finding(
+                rel, e.lineno or 1, "parse-error",
+                f"unparseable module: {e.msg}"))
+            continue
+        facts = _Collector(rel, tree, _Imports(tree)).facts
+        an.funcs.update(facts.funcs)
+        an.modfuncs_by_rel[rel] = facts.modfuncs
+        an.entry_sites.extend(facts.entry_sites)
+        for cname, cls in facts.classes.items():
+            an.classes[cname] = cls
+
+    # 2. global indexes
+    for cls in an.classes.values():
+        for mname, fkey in cls.methods.items():
+            an.method_names.setdefault(mname, []).append(fkey)
+            if an.funcs[fkey].is_property:
+                an.property_names.setdefault(mname, []).append(fkey)
+    for rel, mf in an.modfuncs_by_rel.items():
+        for name, fkey in mf.items():
+            an.modfunc_names.setdefault(name, []).append(fkey)
+    for fn in an.funcs.values():
+        cls = an.class_of_func(fn)
+        if cls is None:
+            continue
+        for acc in fn.accesses:
+            an.attr_owner.setdefault(acc.attr, set()).add(cls.name)
+    # subclass closure (by name)
+    parents: Dict[str, Set[str]] = {}
+    for cls in an.classes.values():
+        for b in cls.bases:
+            if b in an.classes:
+                parents.setdefault(b, set()).add(cls.name)
+    def descendants(name: str) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(parents.get(name, ()))
+        while stack:
+            n = stack.pop()
+            if n in out:
+                continue
+            out.add(n)
+            stack.extend(parents.get(n, ()))
+        return out
+    an.subclasses = {name: descendants(name) for name in an.classes}
+
+    # executor/timer deferral aggregation per class
+    for fn in an.funcs.values():
+        cls = an.class_of_func(fn)
+        if cls is not None:
+            cls.exec_prefixes.extend(fn.exec_prefixes)
+            cls.timer_defer = cls.timer_defer or fn.timer_defer
+
+    # 3. resolve refs_self (name loads that match methods) and
+    #    refs to module functions
+    for fn in an.funcs.values():
+        cls = an.class_of_func(fn)
+        # self.X loads where X is a method: recorded as accesses with a
+        # method name — reclassify as bound-method references.
+        if cls is not None:
+            keep: List[_Access] = []
+            for acc in fn.accesses:
+                if an.resolve_method(cls.name, acc.attr):
+                    fn.refs_self.add(acc.attr)
+                else:
+                    keep.append(acc)
+            fn.accesses = keep
+        mf = an.modfuncs_by_rel.get(fn.rel, {})
+        for name in fn.name_loads:
+            if name in mf and name not in fn.calls_local:
+                fn.calls_local.add(name)
+
+    # 4. same-class call-site graph, then entries (entry seeding adds an
+    #    unlocked inbound path — a thread invokes the entry without the
+    #    lock), THEN the locked-context fixpoint over both.
+    _compute_inbound(an)
+    _resolve_entries(an)
+    _locked_fixpoint(an)
+
+    # 5. role closure
+    _propagate_roles(an)
+    return an
+
+
+def _compute_inbound(an: Analysis) -> None:
+    """Same-class call sites only: a cross-class caller's lock is a
+    DIFFERENT lock, so it contributes nothing to locked-context."""
+    for fn in an.funcs.values():
+        if fn.cls is None:
+            continue
+        for meth, locked in fn.self_call_sites:
+            for fkey in an.resolve_method(fn.cls, meth):
+                an.funcs[fkey].inbound.append((fn.key, locked))
+
+
+def _locked_fixpoint(an: Analysis) -> None:
+    # Greatest fixpoint: start optimistic (locked if any caller exists),
+    # prune any method reachable through an unlocked call site whose
+    # caller is itself not locked-context. Cycles of mutually
+    # locked-called helpers stay locked; one unlocked entry path prunes
+    # the whole chain.
+    for fn in an.funcs.values():
+        fn.locked_context = bool(fn.inbound)
+    changed = True
+    while changed:
+        changed = False
+        for fn in an.funcs.values():
+            if not fn.locked_context:
+                continue
+            for caller, locked in fn.inbound:
+                if locked:
+                    continue
+                c = an.funcs.get(caller)
+                if c is None or not c.locked_context:
+                    fn.locked_context = False
+                    changed = True
+                    break
+
+
+def _class_deferred_roles(an: Analysis, cls: _Class) -> Set[str]:
+    roles: Set[str] = set()
+    for p in cls.exec_prefixes:
+        r = role_for_thread_name(p)
+        if r != "main":
+            roles.add(r)
+    if cls.timer_defer:
+        roles.add("timer")
+    return roles
+
+
+def _resolve_entries(an: Analysis) -> None:
+    def seed(fkey: str, role: str, record: bool = True) -> None:
+        if role == "main":
+            return
+        an.roles.setdefault(fkey, set()).add(role)
+        fn = an.funcs[fkey]
+        # An entry is invoked by its thread WITHOUT the class lock —
+        # feed that into the locked-context fixpoint.
+        if ("__entry__", False) not in fn.inbound:
+            fn.inbound.append(("__entry__", False))
+        if record:
+            an.entry_points.setdefault(role, set()).add(
+                f"{fn.rel}:{fn.qual}")
+
+    def seed_spec(site_fn: _Func, spec: Tuple, role: str) -> None:
+        kind = spec[0]
+        if kind == "self":
+            for fkey in an.resolve_method(spec[1], spec[2]):
+                seed(fkey, role)
+        elif kind == "child":
+            if spec[1] in an.funcs:
+                seed(spec[1], role)
+        elif kind == "local":
+            fkey = an.modfuncs_by_rel.get(site_fn.rel, {}).get(spec[1])
+            if fkey is None:
+                fkey = site_fn.children.get(spec[1])
+            if fkey and fkey in an.funcs:
+                seed(fkey, role)
+        elif kind == "opaque" and role != "main":
+            an.entry_points.setdefault(role, set()).add(
+                f"{site_fn.rel}:{site_fn.qual} -> {spec[1]}")
+
+    for site_key, spec, role, kind in an.entry_sites:
+        site_fn = an.funcs[site_key]
+        if kind == "submit" and role is None:
+            prefixes = site_fn.exec_prefixes
+            if not prefixes:
+                cls = an.class_of_func(site_fn)
+                prefixes = cls.exec_prefixes if cls is not None else []
+            roles = {role_for_thread_name(p) for p in prefixes} - {"main"}
+            for r in roles:
+                seed_spec(site_fn, spec, r)
+            continue
+        if role:
+            seed_spec(site_fn, spec, role)
+
+    # REST layer: every function in service/rest.py outside the client
+    # classes runs on (or builds closures run on) server threads.
+    for fn in an.funcs.values():
+        if fn.rel == _REST_MODULE and \
+                fn.cls not in _REST_NONHANDLER_CLASSES:
+            seed(fn.key, "rest", record=fn.cls is None)
+
+    # Hinted entries (poll loops pumped from outside the tree).
+    for rel, cls_name, meth, role in ENTRY_HINTS:
+        if cls_name is not None:
+            cls = an.classes.get(cls_name)
+            if cls is None or cls.rel != rel:
+                continue
+            for mname, fkey in cls.methods.items():
+                if meth is None or mname == meth:
+                    seed(fkey, role, record=(meth is not None
+                                             or mname in ("poll",
+                                                          "poll_once",
+                                                          "run_until_leader")))
+        else:
+            fkey = an.modfuncs_by_rel.get(rel, {}).get(meth or "")
+            if fkey:
+                seed(fkey, role)
+
+    # Bound-method references are deferred work: a method handed to an
+    # executor/timer of its class runs under that class's deferred
+    # roles, not (only) the referencing thread's role.
+    for fn in an.funcs.values():
+        cls = an.class_of_func(fn)
+        if cls is None or not fn.refs_self:
+            continue
+        droles = _class_deferred_roles(an, cls)
+        for meth in fn.refs_self:
+            for fkey in an.resolve_method(cls.name, meth):
+                for r in droles:
+                    seed(fkey, r, record=False)
+
+
+def _propagate_roles(an: Analysis) -> None:
+    work = list(an.roles.items())
+    queue = [k for k, _ in work]
+    while queue:
+        fkey = queue.pop()
+        fn = an.funcs.get(fkey)
+        if fn is None:
+            continue
+        roles = an.roles.get(fkey, set())
+        if not roles:
+            continue
+        targets: Set[str] = set()
+        for meth in fn.calls_self | fn.refs_self:
+            targets.update(an.resolve_method(fn.cls, meth))
+        mf = an.modfuncs_by_rel.get(fn.rel, {})
+        for name in fn.calls_local:
+            if name in fn.children:
+                targets.add(fn.children[name])
+            elif name in mf:
+                targets.add(mf[name])
+            elif name in an.modfunc_names and name not in _CHA_SKIP:
+                targets.update(an.modfunc_names[name])
+        for name in fn.calls_attr:
+            if name in _CHA_SKIP or name.startswith("__"):
+                continue
+            targets.update(an.method_names.get(name, ()))
+            targets.update(an.modfunc_names.get(name, ()))
+        # A public attribute load that names a @property runs the getter
+        # (often a deliberately lock-free read) — CHA edge like a call.
+        for name in fn.attr_loads:
+            if name not in _CHA_SKIP:
+                targets.update(an.property_names.get(name, ()))
+        # Nested defs and lambdas are deferred work: whether they run
+        # inline, on a timer, or on a worker pool, the defining role (or
+        # a role the class defers to, seeded separately) executes them —
+        # so the parent's roles flow in.
+        targets.update(fn.children.values())
+        for t in targets:
+            cur = an.roles.setdefault(t, set())
+            if not roles <= cur:
+                cur |= roles
+                queue.append(t)
+
+
+# ---- findings --------------------------------------------------------------
+
+
+class _AttrSummary:
+    __slots__ = ("roles", "guarded_sites", "unguarded_writes", "reads",
+                 "writes", "init_only")
+
+    def __init__(self) -> None:
+        self.roles: Set[str] = set()
+        self.guarded_sites = 0
+        self.unguarded_writes: List[Tuple[str, int, Set[str]]] = []
+        self.reads: Dict[str, Set[str]] = {}   # role -> {"guarded",...}
+        self.writes: Dict[str, Set[str]] = {}
+        self.init_only = True
+
+
+def _summarize(an: Analysis) -> Dict[Tuple[str, str], _AttrSummary]:
+    """(class name, attr) -> access summary across the role closure.
+    Accesses from a base-class method are charged to the base AND every
+    analyzed descendant (the runtime witness sees the concrete type)."""
+    out: Dict[Tuple[str, str], _AttrSummary] = {}
+
+    def class_targets(cls: _Class) -> List[str]:
+        return [cls.name] + sorted(an.subclasses.get(cls.name, ()))
+
+    def note(cls_names: List[str], acc: _Access, fn: _Func,
+             roles: Set[str], guarded: bool, is_self: bool) -> None:
+        for cname in cls_names:
+            c = an.classes.get(cname)
+            if c is None or not an.classified(c):
+                continue
+            s = out.setdefault((cname, acc.attr), _AttrSummary())
+            s.roles |= roles
+            if guarded:
+                s.guarded_sites += 1
+            if not (fn.is_init and is_self):
+                if acc.kind == "write":
+                    s.init_only = False
+                    if not guarded:
+                        s.unguarded_writes.append((fn.rel, acc.line, roles))
+            for role in roles or {"(unreached)"}:
+                bucket = s.writes if acc.kind == "write" else s.reads
+                bucket.setdefault(role, set()).add(
+                    "guarded" if guarded else "unguarded")
+
+    for fn in an.funcs.values():
+        roles = {r for r in an.roles.get(fn.key, set()) if r != "main"}
+        cls = an.class_of_func(fn)
+        if cls is not None:
+            for acc in fn.accesses:
+                note(class_targets(cls), acc, fn, roles,
+                     acc.guarded or fn.locked_context, is_self=True)
+        for acc in fn.foreign:
+            owners = an.attr_owner.get(acc.attr, set())
+            if len(owners) == 1:
+                owner = next(iter(owners))
+                oc = an.classes.get(owner)
+                if oc is not None:
+                    note(class_targets(oc), acc, fn, roles, acc.guarded,
+                         is_self=False)
+    return out
+
+
+def race_findings(an: Analysis) -> List[Finding]:
+    findings: List[Finding] = list(an.parse_failures)
+    summaries = _summarize(an)
+    for (cname, attr), s in sorted(summaries.items()):
+        if s.init_only or not s.unguarded_writes:
+            continue
+        roles = s.roles
+        shared = len(roles) >= 2
+        guarded_elsewhere = s.guarded_sites > 0
+        if not shared and not guarded_elsewhere:
+            continue
+        seen_lines: Set[Tuple[str, int]] = set()
+        for rel, line, wroles in s.unguarded_writes:
+            if not wroles:
+                continue  # write not reachable from any thread role
+            if (rel, line) in seen_lines:
+                continue
+            seen_lines.add((rel, line))
+            role_list = ", ".join(sorted(roles))
+            if guarded_elsewhere:
+                findings.append(Finding(
+                    rel, line, "guarded-read-unguarded-write",
+                    f"{cname}.{attr} is guarded at {s.guarded_sites} "
+                    f"site(s) but written here without the lock "
+                    f"(roles touching it: {role_list})"))
+            elif shared:
+                findings.append(Finding(
+                    rel, line, "unguarded-shared-write",
+                    f"{cname}.{attr} is touched by roles "
+                    f"[{role_list}] and written here without any "
+                    f"lock"))
+    # suppressions (same contract as vodalint, tool name 'vodarace')
+    out: List[Finding] = []
+    by_rel: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_rel.setdefault(f.path, []).append(f)
+    for rel, fs in sorted(by_rel.items()):
+        src = an.sources.get(rel, "")
+        out.extend(_apply_suppressions(fs, src, rel, tool="vodarace"))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# ---- the pinned map --------------------------------------------------------
+
+
+def build_map(an: Analysis) -> dict:
+    """roles -> entry points -> per-class attribute ownership, plus the
+    immutable-after-__init__ attribute list. Deterministic (sorted) so
+    `make thread-roles` diffs are reviewable like doc/lock_order.json."""
+    summaries = _summarize(an)
+    roles_out: Dict[str, dict] = {}
+    for role in sorted(ROLES):
+        if role == "main":
+            continue
+        access: Dict[str, Dict[str, Dict[str, str]]] = {}
+        for (cname, attr), s in summaries.items():
+            for kind, bucket in (("read", s.reads), ("write", s.writes)):
+                g = bucket.get(role)
+                if not g:
+                    continue
+                state = "mixed" if len(g) > 1 else next(iter(g))
+                access.setdefault(cname, {}).setdefault(attr, {})[kind] = \
+                    state
+        entries = sorted(an.entry_points.get(role, ()))
+        if not entries and not access:
+            continue
+        roles_out[role] = {
+            "entry_points": entries,
+            "access": {c: {a: dict(sorted(k.items()))
+                           for a, k in sorted(attrs.items())}
+                       for c, attrs in sorted(access.items())},
+        }
+    immutable: Dict[str, List[str]] = {}
+    for (cname, attr), s in sorted(summaries.items()):
+        if s.init_only:
+            immutable.setdefault(cname, []).append(attr)
+    return {
+        "schema": SCHEMA_VERSION,
+        "role_prefixes": dict(sorted(ROLE_PREFIXES.items())),
+        "roles": roles_out,
+        "immutable": {c: sorted(a) for c, a in sorted(immutable.items())},
+    }
+
+
+def write_map(path: str, an: Optional[Analysis] = None) -> dict:
+    an = an or analyze_package()
+    m = build_map(an)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(m, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return m
+
+
+# ---- seeded-bug selftest ---------------------------------------------------
+
+
+def _patch(src: str, old: str, new: str, label: str) -> str:
+    if old not in src:
+        raise AssertionError(
+            f"selftest anchor missing for {label!r}: {old!r} — the live "
+            f"tree moved; update vodarace.VARIANTS")
+    return src.replace(old, new, 1)
+
+
+def _v_metrics_unlocked(src: str) -> str:
+    """Counter.inc with its lock removed — the exact race metrics-lock's
+    docstring warns about, reintroduced."""
+    return _patch(
+        src,
+        "        key = tuple(labels.get(n, \"\") for n in self.label_names)\n"
+        "        with self._lock:\n"
+        "            self._values[key] = self._values.get(key, 0.0) + amount",
+        "        key = tuple(labels.get(n, \"\") for n in self.label_names)\n"
+        "        if True:\n"
+        "            self._values[key] = self._values.get(key, 0.0) + amount",
+        "metrics-unlocked-accessor")
+
+
+def _v_rest_direct_write(src: str) -> str:
+    """A REST handler reaching straight into a scheduler table instead
+    of going through the locked API."""
+    return _patch(
+        src,
+        "    def put_algorithm(body, query):",
+        "    def put_algorithm(body, query):\n"
+        "        pick(body, query)._last_resize_at.clear()",
+        "rest-writes-scheduler-table")
+
+
+def _v_actuate_unlocked(src: str) -> str:
+    """An actuation worker writing shared bookkeeping outside its
+    re-acquired lock: `_scale_job` runs the backend call unlocked by
+    design and re-acquires `self._lock` for the bookkeeping — drop that
+    re-acquire and the pass-delta table is written bare."""
+    return _patch(
+        src,
+        "        self.h_resize_duration.observe(took, path=path_label)\n"
+        "        with self._lock:\n"
+        "            self._bump_state_version()\n"
+        "            self._pass_resize_seconds[name] = took",
+        "        self.h_resize_duration.observe(took, path=path_label)\n"
+        "        if True:\n"
+        "            self._bump_state_version()\n"
+        "            self._pass_resize_seconds[name] = took",
+        "actuate-write-outside-reacquire")
+
+
+# name -> (module rel path, source transform, rules that must fire)
+VARIANTS = {
+    "metrics-unlocked-accessor": (
+        "common/metrics.py", _v_metrics_unlocked,
+        ("guarded-read-unguarded-write", "unguarded-shared-write")),
+    "rest-writes-scheduler-table": (
+        "service/rest.py", _v_rest_direct_write,
+        ("guarded-read-unguarded-write", "unguarded-shared-write")),
+    "actuate-write-outside-reacquire": (
+        "scheduler/scheduler.py", _v_actuate_unlocked,
+        ("guarded-read-unguarded-write", "unguarded-shared-write")),
+}
+
+
+def selftest(stream=None) -> int:
+    """Seeded-bug teeth, mirroring modelcheck.VARIANTS: the live tree
+    must be clean, and each deliberately reintroduced race must be
+    CAUGHT with a file:line finding."""
+    stream = stream or sys.stdout
+    pkg = _package_dir()
+    live = race_findings(analyze_package(pkg))
+    ok = True
+    if live:
+        ok = False
+        print(f"selftest live-tree: {len(live)} unexpected finding(s):",
+              file=stream)
+        for f in live:
+            print(f"  {f.path}:{f.line}: [{f.rule}] {f.message}",
+                  file=stream)
+    else:
+        print("selftest live-tree: clean", file=stream)
+    for name, (rel, transform, rules) in sorted(VARIANTS.items()):
+        with open(os.path.join(pkg, rel), encoding="utf-8") as f:
+            src = f.read()
+        patched = transform(src)
+        fs = race_findings(analyze_package(pkg, overrides={rel: patched}))
+        hits = [f for f in fs if f.path == rel and f.rule in rules]
+        if hits:
+            h = hits[0]
+            print(f"selftest {name}: CAUGHT {h.path}:{h.line} "
+                  f"[{h.rule}]", file=stream)
+        else:
+            ok = False
+            near = [f for f in fs if f.path == rel]
+            print(f"selftest {name}: MISSED (findings in {rel}: "
+                  f"{[(f.line, f.rule) for f in near]})", file=stream)
+    print(f"vodarace selftest: {'OK' if ok else 'FAILED'}", file=stream)
+    return 0 if ok else 1
+
+
+# ---- CLI -------------------------------------------------------------------
+
+
+def run(paths: List[str], fmt: str = "text",
+        baseline: Optional[str] = None,
+        write_baseline_path: Optional[str] = None,
+        stream=None) -> int:
+    stream = stream or sys.stdout
+    findings: List[Finding] = []
+    for path in paths:
+        findings.extend(race_findings(analyze_package(os.path.abspath(path))))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if write_baseline_path:
+        write_baseline(write_baseline_path, findings)
+        print(f"wrote {len(findings)} accepted finding(s) to "
+              f"{write_baseline_path}", file=stream)
+        return 0
+    if baseline:
+        findings = subtract_baseline(findings, load_baseline(baseline))
+    if fmt == "sarif":
+        from vodascheduler_tpu.analysis import findings_to_sarif
+        json.dump(findings_to_sarif("vodarace", findings, RULES), stream,
+                  indent=2, sort_keys=True)
+        print(file=stream)
+    else:
+        for f in findings:
+            if fmt == "jsonl":
+                print(json.dumps(f.to_dict(), sort_keys=True), file=stream)
+            else:
+                print(f"{f.path}:{f.line}: [{f.rule}] {f.message}",
+                      file=stream)
+        if fmt == "text":
+            print(f"vodarace: {len(findings)} finding(s)", file=stream)
+    return 1 if findings else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vodarace",
+        description="thread-role × shared-state race checker "
+                    "(doc/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None)
+    parser.add_argument("--format", choices=("text", "jsonl", "sarif"),
+                        default="text")
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--write-baseline", default=None)
+    parser.add_argument("--write-map", default=None, metavar="PATH",
+                        help="regenerate doc/thread_roles.json and exit")
+    parser.add_argument("--check-map", default=None, metavar="PATH",
+                        help="fail if PATH differs from a fresh inference")
+    parser.add_argument("--selftest", action="store_true")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.write_map:
+        m = write_map(args.write_map)
+        n = sum(len(r["access"]) for r in m["roles"].values())
+        print(f"wrote {args.write_map}: {len(m['roles'])} role(s), "
+              f"{n} role-class ownership entrie(s)")
+        return 0
+    if args.check_map:
+        with open(args.check_map, encoding="utf-8") as f:
+            pinned = json.load(f)
+        fresh = build_map(analyze_package())
+        if pinned != fresh:
+            print(f"{args.check_map} is stale — regenerate with "
+                  f"`make thread-roles` and review the diff",
+                  file=sys.stderr)
+            return 1
+        print(f"{args.check_map} matches a fresh inference")
+        return 0
+    paths = args.paths or [_package_dir()]
+    return run(paths, fmt=args.format, baseline=args.baseline,
+               write_baseline_path=args.write_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

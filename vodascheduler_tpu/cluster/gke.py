@@ -138,6 +138,8 @@ class InClusterKube:
                 > self.TOKEN_REFRESH_SECONDS):
             try:
                 self.token = self._read_token()
+                # vodarace: ignore[unguarded-shared-write] last-writer-wins
+                # token-cache stamp: a stale read costs one extra re-read
                 self._token_read_at = time.monotonic()
             except OSError:  # keep the old token; maybe a transient blip
                 LOG.warning("serviceaccount token re-read failed; "
@@ -633,8 +635,9 @@ class GkeBackend(ClusterBackend):
     def _ensure_monitor(self) -> None:
         with self._lock:
             if self._monitor is None or not self._monitor.is_alive():
-                self._monitor = threading.Thread(target=self._monitor_loop,
-                                                 daemon=True)
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop,
+                    name="voda-monitor-gke", daemon=True)
                 self._monitor.start()
 
     def poll_once(self) -> None:

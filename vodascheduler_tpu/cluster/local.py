@@ -303,8 +303,9 @@ class LocalBackend(ClusterBackend):
     def _ensure_monitor(self) -> None:
         with self._lock:
             if self._monitor is None or not self._monitor.is_alive():
-                self._monitor = threading.Thread(target=self._monitor_loop,
-                                                 daemon=True)
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop,
+                    name="voda-monitor-local", daemon=True)
                 self._monitor.start()
 
     def _monitor_loop(self) -> None:
@@ -316,10 +317,13 @@ class LocalBackend(ClusterBackend):
                     if code is None or proc.expected_stop:
                         continue
                     self._procs.pop(name)
+                    # Drop the spec while still under the lock —
+                    # start_job writes _specs under it from scheduler
+                    # threads, and an unlocked pop here would race.
+                    self._specs.pop(name, None)
                     exited.append((name, code))
             for name, code in exited:
                 if code == 0:
-                    self._specs.pop(name, None)
                     self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED,
                                            name,
                                            timestamp=self.clock.now()))
@@ -327,7 +331,6 @@ class LocalBackend(ClusterBackend):
                     # Includes a PREEMPTED exit the backend did not request
                     # (external SIGTERM): surface it rather than stranding
                     # a job the scheduler still believes is running.
-                    self._specs.pop(name, None)
                     detail = (f"preempted outside scheduler control "
                               f"(exit code {code})"
                               if code == PREEMPTED_EXIT_CODE

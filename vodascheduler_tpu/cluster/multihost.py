@@ -343,8 +343,9 @@ class MultiHostBackend(ClusterBackend):
     def _ensure_monitor(self) -> None:
         with self._lock:
             if self._monitor is None or not self._monitor.is_alive():
-                self._monitor = threading.Thread(target=self._monitor_loop,
-                                                 daemon=True)
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop,
+                    name="voda-monitor-multihost", daemon=True)
                 self._monitor.start()
 
     def _monitor_loop(self) -> None:
@@ -366,10 +367,15 @@ class MultiHostBackend(ClusterBackend):
                         # running is just completion stagger.
                         if any(c is not None and c != 0 for c in codes):
                             self._reap_locked(name, pset)
+                            self._specs.pop(name, None)
                             failed.append(
                                 (name, f"exit codes {codes}"))
                         continue
                     self._jobs.pop(name)
+                    # Drop the spec while still under the lock —
+                    # start_job writes _specs under it from scheduler
+                    # threads, and an unlocked pop here would race.
+                    self._specs.pop(name, None)
                     if all(c == 0 for c in codes):
                         completed.append(name)
                     elif all(c in (0, PREEMPTED_EXIT_CODE) for c in codes):
@@ -383,11 +389,9 @@ class MultiHostBackend(ClusterBackend):
                     else:
                         failed.append((name, f"exit codes {codes}"))
             for name in completed:
-                self._specs.pop(name, None)
                 self.emit(ClusterEvent(ClusterEventKind.JOB_COMPLETED, name,
                                        timestamp=self.clock.now()))
             for name, detail in failed:
-                self._specs.pop(name, None)
                 self.emit(ClusterEvent(ClusterEventKind.JOB_FAILED, name,
                                        detail=detail, timestamp=self.clock.now()))
             with self._lock:

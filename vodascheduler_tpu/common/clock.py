@@ -41,6 +41,9 @@ class Clock:
     def call_later(self, delay: float, fn: Callable[[], None]) -> None:
         timer = threading.Timer(max(0.0, delay), fn)
         timer.daemon = True
+        # threading.Timer has no name= kwarg; the role-prefixed name
+        # (doc/thread_roles.json) must be assigned before start().
+        timer.name = f"voda-timer-{id(timer):x}"
         timer.start()
 
 

@@ -204,9 +204,15 @@ class FileStorage:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        # vodarace: ignore[unguarded-shared-write] replace() runs on the
+        # journal owner's thread (compaction fold under the scheduler
+        # lock, or single-threaded recovery); _broken/_fd are that
+        # owner's file-handle state
         self._broken = False
         if self._fd is not None:
             os.close(self._fd)
+            # vodarace: ignore[unguarded-shared-write] same owner-thread
+            # file-handle state as _broken above
             self._fd = None
 
     def size(self) -> int:

@@ -138,6 +138,8 @@ def compact(journal) -> dict:
         if int(rec.get("seq", 0)) > state.last_seq:
             keep.extend(frame(json.dumps(
                 rec, separators=(",", ":"), default=str).encode()))
+    # vodarace: ignore[guarded-read-unguarded-write] atomically-swapped
+    # snapshot cache: a single store of None; readers rebuild on miss
     journal._records_cache = None
     journal.storage.replace(bytes(keep))
     journal.append("jsnap", {"snapshot_seq": state.last_seq})

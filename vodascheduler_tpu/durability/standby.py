@@ -123,6 +123,9 @@ def finish_takeover(sched, pool_standby: PoolStandby,
             "divergences", ())),
     }
     sched.tracer.emit(dict(rec))
+    # vodarace: ignore[unguarded-shared-write] written once at takeover,
+    # before the promoted pool serves traffic; REST readers see either
+    # None or the complete report (atomic reference swap)
     sched._last_takeover = {k: v for k, v in rec.items() if k != "kind"}
     if registry is not None:
         registry.gauge(

@@ -86,6 +86,8 @@ class PhaseTimer:
         """Close the decide half (first call wins; the allocation-failure
         early return and the normal decide-block exit both mark)."""
         if self._decide_end is None:
+            # vodarace: ignore[unguarded-shared-write] first-call-wins
+            # marker on a per-pass timer owned by the decide thread
             self._decide_end = time.monotonic() - self.wall_start
 
     @property

@@ -159,6 +159,8 @@ class Span:
     def end(self) -> None:
         if self._ended:
             return
+        # vodarace: ignore[unguarded-shared-write] idempotence latch on a
+        # per-span object; a span ends exactly once on its owning thread
         self._ended = True
         self.end_time = self.tracer.clock.now()
         self.tracer._record_span(self)

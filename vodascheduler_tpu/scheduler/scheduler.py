@@ -595,6 +595,9 @@ class Scheduler:
         and the new leader owns the journal's committed prefix."""
         j = self.journal
         if j is not None and j.fenced:
+            # vodarace: ignore[guarded-read-unguarded-write] monotonic
+            # stop latch: a one-way False->True store, read lock-free by
+            # design (deposed-leader fencing)
             self._stopped = True
             return True
         return False
@@ -609,6 +612,9 @@ class Scheduler:
         landing MID-pass still fences at the first append, as before."""
         j = self.journal
         if j is not None and j.probe_fence():
+            # vodarace: ignore[guarded-read-unguarded-write] monotonic
+            # stop latch: a one-way False->True store, read lock-free by
+            # design (deposed-leader fencing)
             self._stopped = True
             return True
         return False
@@ -1008,6 +1014,9 @@ class Scheduler:
             # journal's committed prefix.
             log.warning("pool %s: journal fenced mid-pass — deposed "
                         "leader stopping", self.pool_id)
+            # vodarace: ignore[guarded-read-unguarded-write] monotonic
+            # stop latch: a one-way False->True store, read lock-free by
+            # design (deposed-leader fencing)
             self._stopped = True
         finally:
             with self._lock:
@@ -1054,6 +1063,8 @@ class Scheduler:
                 try:
                     self.journal.maybe_compact()
                 except FencedOut:
+                    # vodarace: ignore[guarded-read-unguarded-write] a
+                    # monotonic stop latch (see _check_fence)
                     self._stopped = True
                 except OSError:
                     log.exception("journal compaction failed; the "
@@ -1504,6 +1515,9 @@ class Scheduler:
             job = self.ready_jobs.get(name)
             if job is None:
                 return False  # unknown here; don't cache a guess
+            # vodarace: ignore[guarded-read-unguarded-write] idempotent
+            # memo: recomputation stores the identical value, and a dict
+            # item store is atomic under the GIL
             got = self._fractional_class[name] = (
                 resolve_resource_class(
                     getattr(job.spec, "resource_class", "auto"),
@@ -1752,6 +1766,9 @@ class Scheduler:
                         else default_restart_seconds())
             except Exception:  # noqa: BLE001 - pricing must never wedge a pass
                 cost = 30.0
+            # vodarace: ignore[unguarded-shared-write] idempotent memo:
+            # recomputation stores the identical value, and a dict item
+            # store is atomic under the GIL
             self._migration_cost_cache[category] = cost
         return cost
 
@@ -2529,6 +2546,10 @@ class Scheduler:
         """Invalidate the read-path snapshot cache. Called under the
         scheduler lock by every mutation a status_table() reader could
         observe (status, chips, priority, time accounting)."""
+        # vodarace: ignore[unguarded-shared-write] generation token:
+        # every steady-state caller holds the scheduler lock (docstring
+        # contract); the one unlocked path is single-threaded recovery,
+        # before the pool serves. Readers are lock-free by design.
         self._state_version += 1
 
     @property

@@ -417,7 +417,8 @@ class VodaApp:
         import threading
 
         from vodascheduler_tpu import native
-        threading.Thread(target=native.get_lib, daemon=True).start()
+        threading.Thread(target=native.get_lib,
+                         name="voda-native-warmup", daemon=True).start()
 
         self.service_server = make_service_server(
             self.admission, self.registry, host=host, port=service_port)

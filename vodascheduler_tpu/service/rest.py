@@ -219,12 +219,21 @@ class RestServer:
             # otherwise hang shutdown.
             daemon_threads = True
 
+            def process_request_thread(self, request, client_address):
+                # ThreadingMixIn names its threads Thread-N; rename so
+                # RaceWitness and stack dumps attribute handler work to
+                # the rest role (ROLE_PREFIXES in analysis/vodarace.py).
+                threading.current_thread().name = \
+                    f"voda-rest-{self.server_address[1]}"
+                super().process_request_thread(request, client_address)
+
         self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name=f"voda-rest-accept-{self.port}",
                                         daemon=True)
         self._thread.start()
 
